@@ -1,0 +1,62 @@
+"""patrol_tpu — a TPU-native distributed rate-limiting framework.
+
+Re-imagines calavera/patrol (a Go distributed rate-limiting HTTP sidecar whose
+token buckets are CRDT PN-counters replicated eventually-consistently over
+≤256-byte UDP full-state packets; reference at /root/reference) as a TPU-first
+system:
+
+* Bucket state is a dense ``(buckets × nodes × 2)`` int64 array of fixed-point
+  "nanotokens" on device, plus an int64 elapsed G-counter per bucket. Instead
+  of the reference's lock-per-bucket concurrency (bucket.go:21, repo.go:173),
+  takes and CvRDT max-merges are batched, branch-free JAX kernels.
+* The reference's lossy scalar max-merge (bucket.go:240-263) becomes a true
+  PN-counter: one (added, taken) slot per node, elementwise max on merge,
+  bucket value = capacity + Σadded − Σtaken.
+* Replication within a TPU slice rides ICI (`lax.pmax` across a mesh axis);
+  replication between hosts keeps the reference's 25-byte-header / 256-byte
+  UDP wire format (bucket.go:34-91) for interop.
+* A host runtime microbatches HTTP takes and incoming UDP deltas into single
+  device calls; the keystone `Repo` seam (repo.go:13-18) is preserved.
+
+Reference parity map (file:line cites refer to the Go reference):
+
+====================  ==================================================
+bucket.go:186-225     Bucket.Take        -> patrol_tpu.ops.take.take_batch
+bucket.go:240-263     Bucket.Merge       -> patrol_tpu.ops.merge.merge_batch
+bucket.go:96-153      Rate / ParseRate   -> patrol_tpu.ops.rate
+bucket.go:34-91       wire codec         -> patrol_tpu.ops.wire
+repo.go:171-235       LocalRepo          -> patrol_tpu.runtime.bucket (host)
+repo.go:13-18         Repo seam          -> patrol_tpu.runtime.repo
+repo.go:20-169        ReplicatedRepo/UDP -> patrol_tpu.net.replication
+api.go:14-86          HTTP /take API     -> patrol_tpu.net.api
+command.go:17-83      supervisor         -> patrol_tpu.command
+cmd/patrol/main.go    CLI                -> patrol_tpu.cli
+====================  ==================================================
+"""
+
+import jax
+
+# int64 bucket state is the core invariant: fixed-point "nanotokens" make the
+# CvRDT max-merge bit-deterministic across replicas (float64 max on mixed
+# hardware is not). This must run before any tracing happens.
+jax.config.update("jax_enable_x64", True)
+
+from patrol_tpu.ops.rate import (  # noqa: E402
+    Rate,
+    parse_rate,
+    parse_duration,
+    format_duration,
+)
+from patrol_tpu.runtime.bucket import Bucket, LocalRepo  # noqa: E402
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Rate",
+    "parse_rate",
+    "parse_duration",
+    "format_duration",
+    "Bucket",
+    "LocalRepo",
+    "__version__",
+]

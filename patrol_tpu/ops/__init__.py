@@ -1,0 +1,1 @@
+"""Pure computational ops: rate algebra, wire codec, take/merge kernels."""

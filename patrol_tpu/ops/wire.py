@@ -1,0 +1,151 @@
+"""Wire codec: the reference's 25-byte-header / ≤256-byte UDP packet format.
+
+Byte layout (bucket.go:34-91):
+
+====  =====  =====================================================
+off   size   field
+====  =====  =====================================================
+0     8      added, big-endian IEEE-754 float64 (tokens)
+8     8      taken, big-endian IEEE-754 float64 (tokens)
+16    8      elapsed, big-endian uint64 (nanoseconds, two's compl.)
+24    1      name length L (≤ 231)
+25    L      name bytes
+====  =====  =====================================================
+
+``created`` is deliberately NOT serialized (bucket.go:28-31): only relative
+elapsed time crosses the wire, which is what makes the protocol clock-skew
+independent (README.md:49-62).
+
+This module adds a *backward-compatible* v2 extension: because the reference
+decoder reads exactly ``data[25:25+L]`` and ignores any trailing bytes, we
+may append a 6-byte trailer carrying the origin node slot. Reference nodes
+interoperate unchanged; patrol_tpu nodes use the slot to address the sender's
+PN-counter lane. Trailer layout: ``b"P2" | u8 flags | u16 slot | u8 checksum``
+(checksum = sum of the 5 preceding trailer bytes mod 256, a guard against a
+name that happens to end in "P2").
+
+The device state is int64 nanotokens; the wire is float64 tokens — this codec
+is the conversion boundary. float64 represents integers exactly up to 2^53,
+i.e. ~9.0e6 tokens at nanotoken resolution; beyond that the wire value is
+rounded (observable semantics are preserved within float64's own precision,
+which is all the reference ever had).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Optional
+
+NANO = 1_000_000_000
+
+FIXED_SIZE = 25  # 8 + 8 + 8 + 1 (bucket.go:36)
+PACKET_SIZE = 256  # no-fragmentation bound (bucket.go:38-41)
+MAX_NAME_LENGTH = PACKET_SIZE - FIXED_SIZE - 6  # leave room for the v2 trailer
+MAX_NAME_LENGTH_V1 = PACKET_SIZE - FIXED_SIZE  # the reference's 231 (bucket.go:43-44)
+
+_HEADER = struct.Struct(">ddQ")
+_TRAILER = struct.Struct(">2sBHB")
+_TRAILER_MAGIC = b"P2"
+TRAILER_SIZE = _TRAILER.size
+
+
+class NameTooLargeError(ValueError):
+    """Bucket name exceeds the wire limit (bucket.go:46-48)."""
+
+    def __init__(self, limit: int = MAX_NAME_LENGTH_V1) -> None:
+        super().__init__(f"bucket name larger than {limit}")
+
+
+class ShortBufferError(ValueError):
+    """Packet shorter than its self-described size (bucket.go:72-74,83-85)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class WireState:
+    """One bucket state as it crosses the wire."""
+
+    name: str
+    added: float  # tokens (float64, as on the wire)
+    taken: float
+    elapsed_ns: int  # signed int64 nanoseconds
+    origin_slot: Optional[int] = None  # v2 trailer; None for v1 packets
+
+    def is_zero(self) -> bool:
+        """The incast-request marker (bucket.go:163-170, repo.go:78-90)."""
+        return self.added == 0 and self.taken == 0 and self.elapsed_ns == 0
+
+    @property
+    def added_nt(self) -> int:
+        return round(self.added * NANO)
+
+    @property
+    def taken_nt(self) -> int:
+        return round(self.taken * NANO)
+
+
+def from_nanotokens(
+    name: str,
+    added_nt: int,
+    taken_nt: int,
+    elapsed_ns: int,
+    origin_slot: Optional[int] = None,
+) -> WireState:
+    return WireState(
+        name=name,
+        added=added_nt / NANO,
+        taken=taken_nt / NANO,
+        elapsed_ns=elapsed_ns,
+        origin_slot=origin_slot,
+    )
+
+
+def encode(state: WireState) -> bytes:
+    """Serialize to the reference wire format (bucket.go:51-68), appending the
+    v2 origin-slot trailer when ``origin_slot`` is set."""
+    name_bytes = state.name.encode("utf-8")
+    limit = MAX_NAME_LENGTH if state.origin_slot is not None else MAX_NAME_LENGTH_V1
+    if len(name_bytes) > limit:
+        raise NameTooLargeError(limit)
+
+    elapsed_u64 = state.elapsed_ns & 0xFFFFFFFFFFFFFFFF  # two's-complement wrap
+    out = bytearray(_HEADER.pack(state.added, state.taken, elapsed_u64))
+    out.append(len(name_bytes))
+    out += name_bytes
+    if state.origin_slot is not None:
+        trailer = bytearray(
+            _TRAILER.pack(_TRAILER_MAGIC, 0, state.origin_slot, 0)
+        )
+        trailer[-1] = sum(trailer[:-1]) & 0xFF
+        out += trailer
+    assert len(out) <= PACKET_SIZE
+    return bytes(out)
+
+
+def decode(data: bytes) -> WireState:
+    """Deserialize a packet (bucket.go:71-91), detecting the v2 trailer."""
+    if len(data) < FIXED_SIZE:
+        raise ShortBufferError("short buffer")
+
+    added, taken, elapsed_u64 = _HEADER.unpack_from(data)
+    name_len = data[24]
+    if len(data) - FIXED_SIZE < name_len:
+        raise ShortBufferError("short buffer")
+    name = data[FIXED_SIZE : FIXED_SIZE + name_len].decode("utf-8", errors="replace")
+
+    elapsed_ns = elapsed_u64 - (1 << 64) if elapsed_u64 >= 1 << 63 else elapsed_u64
+
+    origin_slot: Optional[int] = None
+    tail = data[FIXED_SIZE + name_len :]
+    if len(tail) >= TRAILER_SIZE and tail[:2] == _TRAILER_MAGIC:
+        magic, _flags, slot, checksum = _TRAILER.unpack_from(tail)
+        if checksum == sum(tail[: TRAILER_SIZE - 1]) & 0xFF:
+            origin_slot = slot
+
+    return WireState(
+        name=name,
+        added=added,
+        taken=taken,
+        elapsed_ns=elapsed_ns,
+        origin_slot=origin_slot,
+    )

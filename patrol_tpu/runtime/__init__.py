@@ -1,0 +1,1 @@
+"""Host runtime: bucket directory, microbatcher, repos (host and TPU)."""

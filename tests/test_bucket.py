@@ -1,0 +1,212 @@
+"""Host bucket model tests.
+
+Port of the reference's test intent (bucket_test.go): the deterministic
+hand-advanced-clock take table (bucket_test.go:35-66) and the CRDT law
+permutation test (bucket_test.go:68-114), rebuilt with hypothesis.
+"""
+
+import random
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from patrol_tpu.ops.rate import Rate
+from patrol_tpu.runtime.bucket import Bucket, LocalRepo
+
+NANO = 1_000_000_000
+
+
+class TestTake:
+    def test_take_table(self):
+        """The 8-step scenario from bucket_test.go:35-66: burst drain,
+        sub-interval starvation, refill, over-take rejection, full replenish.
+        Rate 5:1s ⇒ capacity 5, one token per 200ms."""
+        b = Bucket(name="test", created_ns=0)
+        rate = Rate(freq=5, per_ns=NANO)
+        now = 0
+
+        # Burst drain: 5 takes of 1 succeed immediately.
+        for i in range(5):
+            remaining, ok = b.take(now, rate, 1)
+            assert ok, f"take {i}"
+            assert remaining == 4 - i
+
+        # Starvation within the refill interval.
+        now += 100_000_000  # +100ms < 200ms interval ⇒ only 0.5 tokens
+        remaining, ok = b.take(now, rate, 1)
+        assert not ok
+        assert remaining == 0
+
+        # One interval elapsed ⇒ one token refilled.
+        now += 100_000_000
+        remaining, ok = b.take(now, rate, 1)
+        assert ok
+        assert remaining == 0
+
+        # Over-take larger than capacity is rejected even when full.
+        now += 10 * NANO
+        remaining, ok = b.take(now, rate, 6)
+        assert not ok
+        assert remaining == 5  # fully replenished, capped at capacity
+
+        # Full replenish allows taking the whole capacity at once.
+        remaining, ok = b.take(now, rate, 5)
+        assert ok
+        assert remaining == 0
+
+    def test_lazy_capacity_init_commits_on_failure(self):
+        """bucket.go:194-196: the capacity init mutates state even when the
+        take fails, so a failed first take leaves a non-zero bucket."""
+        b = Bucket(name="x", created_ns=0)
+        _, ok = b.take(0, Rate(freq=5, per_ns=NANO), 6)
+        assert not ok
+        assert not b.is_zero()
+        assert b.added_nt == 5 * NANO
+
+    def test_zero_rate_always_rejects(self):
+        b = Bucket(name="x", created_ns=0)
+        remaining, ok = b.take(0, Rate(), 1)
+        assert not ok
+        assert remaining == 0
+
+    def test_clock_rewind_guard(self):
+        """now before created+elapsed clamps last to now (bucket.go:198-201):
+        time moving backwards must not produce negative refills."""
+        b = Bucket(name="x", created_ns=1000 * NANO)
+        rate = Rate(freq=5, per_ns=NANO)
+        b.take(1000 * NANO, rate, 5)
+        remaining, ok = b.take(500 * NANO, rate, 1)  # clock jumped back
+        assert not ok
+        assert remaining == 0
+
+    def test_over_capacity_merge_forfeits_excess(self):
+        """When a merge pushes tokens above capacity, the next take's refill
+        cap is negative and the excess is forfeited (bucket.go:211-213)."""
+        b = Bucket(name="x", created_ns=0)
+        rate = Rate(freq=5, per_ns=NANO)
+        other = Bucket(name="x", added_nt=50 * NANO)
+        b.merge(other)
+        remaining, ok = b.take(0, rate, 1)
+        assert ok
+        # Excess above capacity(5) is forfeited; 5 - 1 = 4 remain.
+        assert remaining == 4
+
+
+def random_bucket(rng: random.Random, name: str = "b") -> Bucket:
+    return Bucket(
+        name=name,
+        added_nt=rng.randrange(0, 10**15),
+        taken_nt=rng.randrange(0, 10**15),
+        elapsed_ns=rng.randrange(0, 10**15),
+    )
+
+
+class TestMerge:
+    def test_merge_permutation_invariance(self):
+        """The crown-jewel CRDT law test (bucket_test.go:68-114): merging 100
+        random buckets in any permutation, each merged twice, yields a
+        bit-identical result."""
+        rng = random.Random(42)
+        buckets = [random_bucket(rng) for _ in range(100)]
+
+        expected = Bucket(name="m")
+        expected.merge(*buckets)
+        want = (expected.added_nt, expected.taken_nt, expected.elapsed_ns)
+
+        for _ in range(200):
+            perm = buckets[:]
+            rng.shuffle(perm)
+            got = Bucket(name="m")
+            for b in perm:
+                got.merge(b)
+                got.merge(b)  # idempotence under re-delivery
+            assert (got.added_nt, got.taken_nt, got.elapsed_ns) == want
+
+    @given(
+        st.lists(
+            st.tuples(
+                st.integers(0, 2**53),
+                st.integers(0, 2**53),
+                st.integers(0, 2**53),
+            ),
+            min_size=1,
+            max_size=20,
+        ),
+        st.randoms(use_true_random=False),
+    )
+    @settings(max_examples=200, deadline=None)
+    def test_merge_laws_hypothesis(self, states, rnd):
+        buckets = [
+            Bucket(name="b", added_nt=a, taken_nt=t, elapsed_ns=e)
+            for a, t, e in states
+        ]
+        ref = Bucket(name="b")
+        ref.merge(*buckets)
+
+        perm = buckets[:]
+        rnd.shuffle(perm)
+        got = Bucket(name="b")
+        for b in perm:
+            got.merge(b)
+            got.merge(b)
+        assert (got.added_nt, got.taken_nt, got.elapsed_ns) == (
+            ref.added_nt,
+            ref.taken_nt,
+            ref.elapsed_ns,
+        )
+
+    def test_merge_self_is_noop(self):
+        b = Bucket(name="b", added_nt=5)
+        b.merge(b)
+        assert b.added_nt == 5
+
+    def test_skew_independence(self):
+        """Nodes with skewed clocks converge: only relative elapsed is merged;
+        created stays local (README.md:49-62)."""
+        rate = Rate(freq=10, per_ns=NANO)
+        skew = 3600 * NANO  # one hour apart
+        a = Bucket(name="k", created_ns=0)
+        b = Bucket(name="k", created_ns=skew)
+
+        a.take(0, rate, 10)  # drain a at its local time 0
+        b.merge(a)
+        # b sees the drain despite the skew: a take at b's local "now"
+        # (= skew, i.e. zero elapsed on b's clock) must find zero tokens.
+        remaining, ok = b.take(skew, rate, 1)
+        assert not ok
+        assert remaining == 0
+
+
+class TestLocalRepo:
+    def test_get_creates_with_clock(self):
+        repo = LocalRepo(clock=lambda: 12345)
+        b, existed = repo.get_bucket("k")
+        assert not existed
+        assert b.created_ns == 12345
+        b2, existed = repo.get_bucket("k")
+        assert existed
+        assert b2 is b
+
+    def test_upsert_identity_fast_path(self):
+        repo = LocalRepo(clock=lambda: 0)
+        b, _ = repo.get_bucket("k")
+        got, existed = repo.upsert_bucket(b)
+        assert existed
+        assert got is b
+
+    def test_upsert_merges(self):
+        repo = LocalRepo(clock=lambda: 0)
+        b, _ = repo.get_bucket("k")
+        b.added_nt = 5
+        incoming = Bucket(name="k", added_nt=9, taken_nt=2)
+        got, existed = repo.upsert_bucket(incoming)
+        assert existed
+        assert got is b
+        assert (got.added_nt, got.taken_nt) == (9, 2)
+
+    def test_upsert_new_stamps_created(self):
+        repo = LocalRepo(clock=lambda: 777)
+        incoming = Bucket(name="new", added_nt=1)
+        got, existed = repo.upsert_bucket(incoming)
+        assert not existed
+        assert got.created_ns == 777

@@ -1,0 +1,126 @@
+"""Rate algebra parity tests (reference: bucket.go:96-153)."""
+
+import pytest
+
+from patrol_tpu.ops.rate import (
+    Rate,
+    format_duration,
+    parse_duration,
+    parse_rate,
+)
+
+NANO = 1_000_000_000
+
+
+class TestParseDuration:
+    @pytest.mark.parametrize(
+        "s,want",
+        [
+            ("0", 0),
+            ("1s", NANO),
+            ("1.5s", NANO + NANO // 2),
+            ("300ms", 300_000_000),
+            ("2h45m", (2 * 3600 + 45 * 60) * NANO),
+            ("1h30m10s", (3600 + 30 * 60 + 10) * NANO),
+            ("10ns", 10),
+            ("1us", 1_000),
+            ("1µs", 1_000),
+            ("1μs", 1_000),  # Greek mu, accepted by Go's unitMap
+            ("1ms", 1_000_000),
+            ("1m", 60 * NANO),
+            ("1h", 3600 * NANO),
+            ("-1s", -NANO),
+            ("+1s", NANO),
+            (".5s", NANO // 2),
+            ("1.s", NANO),
+            ("90m", 90 * 60 * NANO),
+        ],
+    )
+    def test_valid(self, s, want):
+        assert parse_duration(s) == want
+
+    @pytest.mark.parametrize("s", ["", "1", "s1", "x5s", "1d", "1ss1", "-", "1.2.3s"])
+    def test_invalid(self, s):
+        with pytest.raises(ValueError):
+            parse_duration(s)
+
+
+class TestFormatDuration:
+    @pytest.mark.parametrize(
+        "ns,want",
+        [
+            (0, "0s"),
+            (1, "1ns"),
+            (1_100, "1.1µs"),
+            (2_200_000, "2.2ms"),
+            (NANO, "1s"),
+            (NANO + NANO // 2, "1.5s"),
+            (60 * NANO, "1m0s"),
+            (90 * NANO, "1m30s"),
+            (3600 * NANO, "1h0m0s"),
+            (3600 * NANO + 90 * NANO, "1h1m30s"),
+            (-NANO, "-1s"),
+            (1500, "1.5µs"),
+        ],
+    )
+    def test_format(self, ns, want):
+        assert format_duration(ns) == want
+
+    def test_roundtrip(self):
+        for ns in [0, 1, 999, 12345, 10**6 + 1, NANO * 7919 + 13, -NANO * 3]:
+            assert parse_duration(format_duration(ns)) == ns
+
+
+class TestParseRate:
+    @pytest.mark.parametrize(
+        "s,freq,per_ns",
+        [
+            ("50:1s", 50, NANO),
+            ("100:1s", 100, NANO),
+            ("1:1ms", 1, 1_000_000),
+            ("5", 5, NANO),  # missing duration defaults to 1s (bucket.go:104-106)
+            ("5:s", 5, NANO),  # bare unit shorthand (bucket.go:116-119)
+            ("5:ms", 5, 1_000_000),
+            ("5:h", 5, 3600 * NANO),
+            ("0:1s", 0, NANO),
+            ("-1:1s", -1, NANO),
+            ("10:1.5s", 10, NANO + NANO // 2),
+        ],
+    )
+    def test_valid(self, s, freq, per_ns):
+        assert parse_rate(s) == Rate(freq=freq, per_ns=per_ns)
+
+    @pytest.mark.parametrize("s", ["", "x:1s", "1:", "1:xs", "1.5:1s", ":1s"])
+    def test_invalid(self, s):
+        with pytest.raises(ValueError):
+            parse_rate(s)
+
+
+class TestRate:
+    def test_zero(self):
+        assert Rate().is_zero()
+        assert Rate(freq=1).is_zero()
+        assert Rate(per_ns=1).is_zero()
+        assert not Rate(freq=1, per_ns=1).is_zero()
+        assert Rate().tokens(NANO) == 0.0
+
+    def test_interval_truncates(self):
+        # Go int64 division truncates: 1s / 3 = 333333333ns (bucket.go:146-148).
+        assert Rate(freq=3, per_ns=NANO).interval_ns() == 333_333_333
+
+    def test_interval_zero_guard(self):
+        # freq > per makes the truncated interval 0; tokens must return 0
+        # rather than dividing by zero (bucket.go:137-140).
+        r = Rate(freq=10, per_ns=5)
+        assert r.interval_ns() == 0
+        assert r.tokens(NANO) == 0.0
+
+    def test_tokens(self):
+        r = Rate(freq=100, per_ns=NANO)  # one token per 10ms
+        assert r.tokens(NANO) == pytest.approx(100.0)
+        assert r.tokens(10_000_000) == pytest.approx(1.0)
+        assert r.tokens(5_000_000) == pytest.approx(0.5)
+
+    def test_str(self):
+        assert str(Rate(freq=50, per_ns=NANO)) == "50:1s"
+        assert str(Rate(freq=1, per_ns=90 * NANO)) == "1:1m30s"

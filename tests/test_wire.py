@@ -1,0 +1,148 @@
+"""Wire codec tests: golden bytes against the exact Go layout
+(bucket.go:34-91) plus roundtrip properties (≙ bucket_test.go:10-34)."""
+
+import math
+import struct
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from patrol_tpu.ops import wire
+from patrol_tpu.ops.wire import (
+    FIXED_SIZE,
+    MAX_NAME_LENGTH_V1,
+    PACKET_SIZE,
+    NameTooLargeError,
+    ShortBufferError,
+    WireState,
+    decode,
+    encode,
+    from_nanotokens,
+)
+
+
+class TestGolden:
+    def test_golden_layout(self):
+        """Byte-for-byte check of the header layout the Go code produces:
+        big-endian float64 added, float64 taken, uint64 elapsed, name-length
+        byte, name (bucket.go:51-68)."""
+        s = WireState(name="api", added=5.0, taken=2.5, elapsed_ns=1_500_000_000)
+        data = encode(s)
+        assert data[0:8] == struct.pack(">d", 5.0)
+        assert data[8:16] == struct.pack(">d", 2.5)
+        assert data[16:24] == struct.pack(">Q", 1_500_000_000)
+        assert data[24] == 3
+        assert data[25:28] == b"api"
+        assert len(data) == FIXED_SIZE + 3
+
+    def test_golden_bytes(self):
+        """A fully pinned packet — any byte change breaks interop."""
+        s = WireState(name="k", added=1.0, taken=0.0, elapsed_ns=0)
+        assert encode(s) == bytes(
+            [0x3F, 0xF0, 0, 0, 0, 0, 0, 0]  # 1.0 be float64
+            + [0] * 8  # 0.0
+            + [0] * 8  # elapsed 0
+            + [1]  # name length
+            + [0x6B]  # "k"
+        )
+
+    def test_negative_elapsed_wraps_two_complement(self):
+        """Go casts Duration→uint64 on the wire (bucket.go:62); a negative
+        elapsed wraps and must roundtrip back to the same signed value."""
+        s = WireState(name="n", added=0.0, taken=0.0, elapsed_ns=-5)
+        out = decode(encode(s))
+        assert out.elapsed_ns == -5
+
+
+class TestRoundtrip:
+    @given(
+        name=st.text(
+            alphabet=st.characters(min_codepoint=32, max_codepoint=126),
+            max_size=100,
+        ),
+        added=st.floats(allow_nan=False, allow_infinity=False),
+        taken=st.floats(allow_nan=False, allow_infinity=False),
+        elapsed=st.integers(-(2**63), 2**63 - 1),
+    )
+    @settings(max_examples=500, deadline=None)
+    def test_roundtrip_v1(self, name, added, taken, elapsed):
+        s = WireState(name=name, added=added, taken=taken, elapsed_ns=elapsed)
+        out = decode(encode(s))
+        assert out.name == s.name
+        assert out.added == s.added or (math.isnan(out.added) and math.isnan(s.added))
+        assert out.taken == s.taken
+        assert out.elapsed_ns == s.elapsed_ns
+        assert out.origin_slot is None
+
+    @given(
+        name=st.text(
+            alphabet=st.characters(min_codepoint=32, max_codepoint=126),
+            max_size=100,
+        ),
+        slot=st.integers(0, 65535),
+    )
+    @settings(max_examples=200, deadline=None)
+    def test_roundtrip_v2_origin_slot(self, name, slot):
+        s = WireState(name=name, added=1.5, taken=0.5, elapsed_ns=7, origin_slot=slot)
+        out = decode(encode(s))
+        assert out.origin_slot == slot
+        assert out.name == name
+
+    def test_name_ending_in_magic_is_not_mistaken_for_trailer(self):
+        # A v1 packet whose name ends with "P2" must not decode a trailer
+        # (there are no trailing bytes beyond the name at all).
+        s = WireState(name="xP2", added=1.0, taken=0.0, elapsed_ns=0)
+        out = decode(encode(s))
+        assert out.name == "xP2"
+        assert out.origin_slot is None
+
+
+class TestLimits:
+    def test_name_too_large_v1(self):
+        s = WireState(name="x" * (MAX_NAME_LENGTH_V1 + 1), added=0, taken=0, elapsed_ns=0)
+        with pytest.raises(NameTooLargeError):
+            encode(s)
+
+    def test_max_name_fits_packet(self):
+        s = WireState(name="x" * MAX_NAME_LENGTH_V1, added=0, taken=0, elapsed_ns=0)
+        assert len(encode(s)) == PACKET_SIZE
+
+    def test_short_buffer(self):
+        with pytest.raises(ShortBufferError):
+            decode(b"\x00" * (FIXED_SIZE - 1))
+
+    def test_truncated_name(self):
+        s = WireState(name="hello", added=0, taken=0, elapsed_ns=0)
+        data = encode(s)[:-2]
+        with pytest.raises(ShortBufferError):
+            decode(data)
+
+    def test_reference_decoder_ignores_trailer(self):
+        """The compat contract: a v2 packet parsed by reference rules
+        (read exactly name_len bytes after the header, ignore the rest,
+        bucket.go:82-88) yields the same state."""
+        data = encode(WireState(name="bkt", added=3.0, taken=1.0, elapsed_ns=9, origin_slot=7))
+        # Simulate the reference decoder:
+        added, taken, elapsed = struct.unpack_from(">ddQ", data)
+        name_len = data[24]
+        name = data[25 : 25 + name_len].decode()
+        assert (name, added, taken, elapsed) == ("bkt", 3.0, 1.0, 9)
+
+
+class TestNanotokenBoundary:
+    def test_from_nanotokens(self):
+        s = from_nanotokens("k", 5 * wire.NANO, wire.NANO // 2, 3, origin_slot=1)
+        assert s.added == 5.0
+        assert s.taken == 0.5
+        assert s.added_nt == 5 * wire.NANO
+        assert s.taken_nt == wire.NANO // 2
+
+    @given(nt=st.integers(0, 2**50))
+    @settings(max_examples=200, deadline=None)
+    def test_exact_below_2_50(self, nt):
+        """Nanotoken counts up to 2^50 (~1.1M tokens) cross the float64 wire
+        exactly (two correctly-rounded float64 ops keep the absolute error
+        under 0.5 nanotokens in that range)."""
+        s = from_nanotokens("k", nt, 0, 0)
+        assert decode(encode(s)).added_nt == nt
