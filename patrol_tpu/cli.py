@@ -1,0 +1,106 @@
+"""CLI entry point (reference: cmd/patrol/main.go).
+
+Flags mirror the reference: ``--api-addr``, ``--node-addr``, repeatable
+``--peer-addr`` (host:port-validated, main.go:59-75), ``--clock-offset``
+(skew fault injection, main.go:30), ``--log-env`` (main.go:31,40-47) —
+plus the TPU-native knobs: ``--buckets`` / ``--node-lanes`` (state shape)
+and ``--platform`` to pin the JAX backend.
+
+Run as ``python -m patrol_tpu [flags]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+
+
+def _addr(value: str) -> str:
+    host, sep, port = value.rpartition(":")
+    if not sep or not host or not port.isdigit():
+        raise argparse.ArgumentTypeError(f"address {value!r} is not host:port")
+    return value
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="patrol-tpu",
+        description="TPU-native distributed rate-limiting sidecar "
+        "(POST /take/:bucket?rate=F:D&count=N)",
+    )
+    p.add_argument("--api-addr", type=_addr, default="127.0.0.1:8080", help="HTTP API address")
+    p.add_argument("--node-addr", type=_addr, default="127.0.0.1:16000", help="replication UDP address")
+    p.add_argument(
+        "--peer-addr",
+        type=_addr,
+        action="append",
+        default=[],
+        dest="peer_addrs",
+        help="peer node address (repeatable; include all cluster members)",
+    )
+    p.add_argument(
+        "--clock-offset",
+        default="0",
+        help="offset added to clock timestamps, Go duration syntax (testing)",
+    )
+    p.add_argument(
+        "--log-env",
+        choices=["development", "production"],
+        default="production",
+        help="logging environment",
+    )
+    p.add_argument("--buckets", type=int, default=65536, help="bucket-slot pool size")
+    p.add_argument("--node-lanes", type=int, default=64, help="PN lanes (max cluster size)")
+    p.add_argument("--platform", default=None, help="JAX platform override (tpu|cpu)")
+    p.add_argument(
+        "--shutdown-timeout",
+        default="30s",
+        help="graceful shutdown timeout, Go duration syntax",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+
+    # Heavy imports after platform selection.
+    from patrol_tpu.command import Command
+    from patrol_tpu.models.limiter import LimiterConfig
+    from patrol_tpu.ops.rate import parse_duration
+    from patrol_tpu.runtime.bucket import offset_clock, system_clock
+    from patrol_tpu.utils.logging import configure
+
+    try:
+        offset_ns = parse_duration(args.clock_offset)
+    except ValueError as exc:
+        print(f"bad --clock-offset: {exc}", file=sys.stderr)
+        return 2
+    try:
+        shutdown_ns = parse_duration(args.shutdown_timeout)
+    except ValueError as exc:
+        print(f"bad --shutdown-timeout: {exc}", file=sys.stderr)
+        return 2
+
+    log = configure(args.log_env)
+    cmd = Command(
+        api_addr=args.api_addr,
+        node_addr=args.node_addr,
+        peer_addrs=args.peer_addrs,
+        clock=offset_clock(offset_ns) if offset_ns else system_clock,
+        shutdown_timeout_s=shutdown_ns / 1e9,
+        config=LimiterConfig(buckets=args.buckets, nodes=args.node_lanes),
+        log=log,
+    )
+    try:
+        asyncio.run(cmd.run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""Process supervisor (reference: ``Command``, command.go:17-83).
+
+Wires storage (device engine) + replication (UDP) + API (HTTP) into one
+process and supervises them — the reference's ``oklog/run`` actor group
+becomes an asyncio task group with signal handling and a graceful-shutdown
+timeout. Used by both the CLI (cmd/patrol/main.go) and the in-process
+multi-node cluster tests (≙ command_test.go:13-77).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import dataclasses
+import logging
+import signal
+from typing import Callable, List, Optional
+
+from patrol_tpu.models.limiter import LimiterConfig, SMALL
+from patrol_tpu.net.api import API, serve
+from patrol_tpu.net.replication import Replicator, SlotTable
+from patrol_tpu.runtime.bucket import ClockFn, system_clock
+from patrol_tpu.runtime.engine import DeviceEngine
+from patrol_tpu.runtime.repo import TPURepo
+
+
+@dataclasses.dataclass
+class Command:
+    """All runtime config funnels into this struct (≙ command.go:18-25),
+    which doubles as the test-harness entry point."""
+
+    api_addr: str = "127.0.0.1:8080"
+    node_addr: str = "127.0.0.1:16000"
+    peer_addrs: List[str] = dataclasses.field(default_factory=list)
+    clock: ClockFn = system_clock  # the injected-clock seam (command.go:23)
+    shutdown_timeout_s: float = 30.0
+    config: LimiterConfig = SMALL
+    log: Optional[logging.Logger] = None
+    handle_signals: bool = True
+
+    # Populated by run() for tests/introspection.
+    engine: Optional[DeviceEngine] = None
+    repo: Optional[TPURepo] = None
+    replicator: Optional[Replicator] = None
+
+    async def run(self, stop: Optional[asyncio.Event] = None) -> None:
+        """Run until ``stop`` is set or SIGINT/SIGTERM arrives; then shut
+        down gracefully (drain HTTP, stop engine) within the timeout
+        (command.go:46-82)."""
+        if self.shutdown_timeout_s <= 0:
+            raise ValueError("shutdown_timeout_s must be set")
+        log = self.log or logging.getLogger("patrol")
+        stop = stop or asyncio.Event()
+
+        slots = SlotTable(
+            self.node_addr, self.peer_addrs, max_slots=self.config.nodes
+        )
+        engine = DeviceEngine(self.config, node_slot=slots.self_slot, clock=self.clock)
+        replicator = await Replicator.create(
+            self.node_addr, self.peer_addrs, slots, log=log
+        )
+        repo = TPURepo(engine, send_incast=replicator.send_incast_request)
+        replicator.repo = repo
+        engine.on_broadcast = replicator.broadcast_states
+        log.debug(
+            "peers",
+            extra={
+                "self": self.node_addr,
+                "slot": slots.self_slot,
+                "others": [f"{h}:{p}" for h, p in replicator.peers],
+            },
+        )
+
+        def stats() -> dict:
+            return {
+                "engine_ticks": engine.ticks,
+                "buckets": len(engine.directory),
+                "node_slot": slots.self_slot,
+                **replicator.stats(),
+            }
+
+        api = API(repo, log=log, stats=stats)
+        host, _, port = self.api_addr.rpartition(":")
+        server = await serve(api, host or "127.0.0.1", int(port))
+
+        self.engine, self.repo, self.replicator = engine, repo, replicator
+
+        if self.handle_signals:
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                with contextlib.suppress(NotImplementedError, RuntimeError):
+                    loop.add_signal_handler(sig, stop.set)
+
+        log.info("API serving", extra={"addr": self.api_addr})
+        try:
+            await stop.wait()
+        finally:
+            log.info("shutting down")
+            server.close()
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(
+                    server.wait_closed(), timeout=self.shutdown_timeout_s
+                )
+            replicator.close()
+            engine.stop()
+            for handler in (self.log.handlers if self.log else []):
+                with contextlib.suppress(Exception):
+                    handler.flush()  # ≙ Log.Sync() (command.go:38)

@@ -1,0 +1,1 @@
+"""Limiter state models: dense device-resident CRDT state and configs."""

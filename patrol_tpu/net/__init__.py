@@ -1,0 +1,1 @@
+"""Network layer: HTTP API front and UDP replication backend."""

@@ -1,0 +1,247 @@
+"""The Patrol HTTP API (reference: api.go:14-86) on an asyncio front.
+
+Route semantics are byte-compatible with the reference:
+
+* ``POST /take/:name?rate=F:D&count=N`` → get-or-create bucket, take at the
+  injected clock, reply ``200``/``429`` with the remaining whole tokens as
+  the body (api.go:51-86).
+* Name longer than 231 bytes → ``400`` with the error text
+  (api.go:55-58).
+* Malformed ``rate``/``count`` are silently ignored: a bad rate behaves as
+  the zero Rate (unconditional 429), a bad/zero count becomes 1
+  (api.go:60-65, pinned by api_test.go:42-49).
+
+Debug routes replace the reference's pprof suite (api.go:29-39) with
+host+device-aware equivalents (see utils/profiling.py), plus Prometheus
+text metrics — which the reference lists as future work (README.md:117).
+
+The server is a hand-rolled asyncio.Protocol HTTP/1.1 implementation
+(keep-alive, no external deps): the request hot path does one dict lookup
+and one string split before handing off to the repo, and responses are
+single ``transport.write`` calls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Callable, Optional, Tuple
+from urllib.parse import parse_qs, unquote
+
+from patrol_tpu.ops.rate import Rate, parse_rate
+from patrol_tpu.ops.wire import MAX_NAME_LENGTH_V1
+from patrol_tpu.runtime.repo import TPURepo
+
+_STATUS_TEXT = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    405: "Method Not Allowed",
+    429: "Too Many Requests",
+    500: "Internal Server Error",
+}
+
+
+class API:
+    """Routing + handlers. ``repo`` is any object with ``take_async`` and
+    the introspection hooks of :class:`TPURepo`."""
+
+    def __init__(self, repo: TPURepo, log=None, stats: Optional[Callable[[], dict]] = None):
+        self.repo = repo
+        self.log = log
+        self.stats = stats or (lambda: {})
+        self.started_at = time.time()
+
+    async def handle(
+        self, method: str, path: str, query: str
+    ) -> Tuple[int, bytes, str]:
+        """Returns (status, body, content_type)."""
+        if path.startswith("/take/"):
+            if method != "POST":
+                return 405, b"method not allowed\n", "text/plain"
+            return await self._take(path[len("/take/") :], query)
+        if path.startswith("/debug/") or path == "/metrics":
+            return await self._debug(method, path, query)
+        return 404, b"not found\n", "text/plain"
+
+    # -- the hot route (api.go:51-86) ---------------------------------------
+
+    async def _take(self, raw_name: str, query: str) -> Tuple[int, bytes, str]:
+        name = unquote(raw_name)
+        if len(name.encode("utf-8", "surrogatepass")) > MAX_NAME_LENGTH_V1:
+            # api.go:55-58 → 400 with the error text.
+            return (
+                400,
+                f"bucket name larger than {MAX_NAME_LENGTH_V1}".encode(),
+                "text/plain",
+            )
+
+        q = parse_qs(query, keep_blank_values=True)
+        try:
+            rate = parse_rate(q.get("rate", [""])[0])
+        except ValueError:
+            rate = Rate()  # parse errors silently ignored (api.go:61)
+        try:
+            count = int(q.get("count", ["0"])[0])
+            if count < 0:
+                count = 0
+        except ValueError:
+            count = 0
+        if count == 0:
+            count = 1  # api.go:63-65
+
+        remaining, ok = await self.repo.take_async(name, rate, count)
+        status = 200 if ok else 429
+        if self.log is not None:
+            self.log.debug(
+                "take",
+                extra={"code": status, "count": count, "rate": str(rate), "bucket": name},
+            )
+        return status, str(remaining).encode(), "text/plain"
+
+    # -- debug / observability (≙ api.go:29-39) -----------------------------
+
+    async def _debug(self, method: str, path: str, query: str) -> Tuple[int, bytes, str]:
+        from patrol_tpu.utils import profiling
+
+        q = parse_qs(query)
+        loop = asyncio.get_running_loop()
+
+        if path == "/metrics" or path == "/debug/vars":
+            body = self._metrics() if path == "/metrics" else json.dumps(
+                self.stats(), indent=2
+            ).encode()
+            ctype = "text/plain; version=0.0.4" if path == "/metrics" else "application/json"
+            return 200, body, ctype
+        if path == "/debug/pprof/" or path == "/debug/pprof":
+            index = (
+                "patrol_tpu debug index\n\n"
+                "/debug/pprof/profile?seconds=N  sampling CPU profile (all threads)\n"
+                "/debug/pprof/goroutine          thread stack dump\n"
+                "/debug/pprof/heap               allocation summary\n"
+                "/debug/pprof/allocs             allocation summary\n"
+                "/debug/jax/trace?seconds=N      JAX device trace (XPlane)\n"
+                "/debug/vars                     engine stats JSON\n"
+                "/metrics                        prometheus text metrics\n"
+            )
+            return 200, index.encode(), "text/plain"
+        if path == "/debug/pprof/profile":
+            seconds = float(q.get("seconds", ["5"])[0])
+            prof = profiling.SamplingProfiler(duration_s=seconds)
+            body = await loop.run_in_executor(None, prof.run)
+            return 200, body.encode(), "text/plain"
+        if path in ("/debug/pprof/goroutine", "/debug/pprof/threadcreate"):
+            return 200, profiling.thread_dump().encode(), "text/plain"
+        if path in ("/debug/pprof/heap", "/debug/pprof/allocs", "/debug/pprof/block", "/debug/pprof/mutex"):
+            return 200, profiling.heap_summary().encode(), "text/plain"
+        if path == "/debug/jax/trace":
+            seconds = float(q.get("seconds", ["2"])[0])
+            out = await loop.run_in_executor(None, profiling.jax_trace, seconds)
+            return 200, f"jax trace written to {out}\n".encode(), "text/plain"
+        if path == "/debug/pprof/cmdline":
+            import sys
+
+            return 200, "\x00".join(sys.argv).encode(), "text/plain"
+        return 404, b"not found\n", "text/plain"
+
+    def _metrics(self) -> bytes:
+        stats = self.stats()
+        lines = []
+        for key, val in sorted(stats.items()):
+            if isinstance(val, (int, float)):
+                name = f"patrol_{key}"
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {val}")
+        lines.append("# TYPE patrol_uptime_seconds gauge")
+        lines.append(f"patrol_uptime_seconds {time.time() - self.started_at:.3f}")
+        return ("\n".join(lines) + "\n").encode()
+
+
+class _HTTPProtocol(asyncio.Protocol):
+    """Minimal HTTP/1.1 with keep-alive. Requests with bodies are accepted
+    (drained by Content-Length) but bodies are ignored — /take carries all
+    its input in the URL, like the reference."""
+
+    def __init__(self, api: API):
+        self.api = api
+        self.buf = b""
+        self.transport: Optional[asyncio.Transport] = None
+        self._body_to_skip = 0
+        # FIFO lock: pipelined requests are handled concurrently but their
+        # responses are written in request order.
+        self._write_order = asyncio.Lock()
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        try:
+            import socket
+
+            sock = transport.get_extra_info("socket")
+            if sock is not None:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+    def data_received(self, data: bytes) -> None:
+        self.buf += data
+        while True:
+            if self._body_to_skip:
+                skip = min(self._body_to_skip, len(self.buf))
+                self.buf = self.buf[skip:]
+                self._body_to_skip -= skip
+                if self._body_to_skip:
+                    return
+            end = self.buf.find(b"\r\n\r\n")
+            if end < 0:
+                if len(self.buf) > 65536:
+                    self.transport.close()
+                return
+            head, self.buf = self.buf[:end], self.buf[end + 4 :]
+            lines = head.split(b"\r\n")
+            try:
+                method, target, _version = lines[0].decode("latin-1").split(" ", 2)
+            except ValueError:
+                self.transport.close()
+                return
+            clen = 0
+            keep_alive = True
+            for line in lines[1:]:
+                low = line.lower()
+                if low.startswith(b"content-length:"):
+                    try:
+                        clen = int(line.split(b":", 1)[1])
+                    except ValueError:
+                        clen = 0
+                elif low.startswith(b"connection:") and b"close" in low:
+                    keep_alive = False
+            self._body_to_skip = clen
+            path, _, query = target.partition("?")
+            asyncio.ensure_future(self._respond(method, path, query, keep_alive))
+
+    async def _respond(self, method: str, path: str, query: str, keep_alive: bool) -> None:
+        async with self._write_order:
+            try:
+                status, body, ctype = await self.api.handle(method, path, query)
+            except Exception as exc:  # pragma: no cover
+                if self.api.log is not None:
+                    self.api.log.error("api error", extra={"error": repr(exc)})
+                status, body, ctype = 500, b"internal error\n", "text/plain"
+        if self.transport is None or self.transport.is_closing():
+            return
+        reason = _STATUS_TEXT.get(status, "Unknown")
+        head = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n"
+            "\r\n"
+        ).encode("latin-1")
+        self.transport.write(head + body)
+        if not keep_alive:
+            self.transport.close()
+
+
+async def serve(api: API, host: str, port: int) -> asyncio.AbstractServer:
+    loop = asyncio.get_running_loop()
+    return await loop.create_server(lambda: _HTTPProtocol(api), host, port)

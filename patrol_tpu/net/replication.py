@@ -1,0 +1,220 @@
+"""UDP replication backend (reference: ``ReplicatedRepo``, repo.go:20-169).
+
+Protocol (identical on the wire): every state change broadcasts the sender's
+full bucket state as one ≤256-byte datagram to every peer; a *zero-state*
+packet is an incast request — receivers that know the bucket unicast their
+state back (repo.go:78-90). No acks, no ordering, no retries: loss tolerance
+comes from the CRDT (every later broadcast subsumes a lost one).
+
+Differences by design:
+
+* Received deltas are not merged one-at-a-time on the receive thread
+  (the reference's throughput ceiling, repo.go:54-92); they are queued into
+  the device engine and scatter-max-merged in microbatches.
+* Outgoing packets carry the v2 origin-slot trailer so the receiver can
+  address the sender's PN lane; packets from reference nodes (no trailer)
+  fall back to a sender-address→slot table.
+* The reference resolves each peer address on every broadcast in a goroutine
+  per peer (repo.go:142-151) — and checks a shadowed error, attempting sends
+  with a nil address on resolve failure (known bug, SURVEY §2). Here peers
+  are resolved once at startup and sends are synchronous nonblocking
+  ``sendto`` calls on the event loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from patrol_tpu.ops import wire
+
+Addr = Tuple[str, int]
+
+
+def parse_addr(addr: str) -> Addr:
+    host, _, port = addr.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def _resolve(addr: str) -> Addr:
+    host, port = parse_addr(addr)
+    try:
+        infos = socket.getaddrinfo(host, port, socket.AF_INET, socket.SOCK_DGRAM)
+        return infos[0][4][:2]
+    except socket.gaierror:
+        return (host, port)
+
+
+class SlotTable:
+    """Deterministic node-slot assignment: rank in the sorted static member
+    list (peers ∪ self), identical on every correctly-configured node.
+    Unknown senders (e.g. reference nodes not in the static list) get
+    dynamic slots from the remainder of the lane space — membership is
+    static in the reference too (README.md:78-86)."""
+
+    def __init__(self, self_addr: str, peers: Iterable[str], max_slots: int):
+        members = sorted(set(peers) | {self_addr})
+        if len(members) > max_slots:
+            raise ValueError(
+                f"{len(members)} members exceed {max_slots} node lanes; "
+                "raise LimiterConfig.nodes"
+            )
+        self.max_slots = max_slots
+        self._mu = threading.Lock()
+        self.slot_of: Dict[Addr, int] = {_resolve(a): i for i, a in enumerate(members)}
+        self.self_slot = self.slot_of[_resolve(self_addr)]
+        self._next_dynamic = len(members)
+
+    def resolve(self, addr: Addr) -> Optional[int]:
+        slot = self.slot_of.get(addr)
+        if slot is not None:
+            return slot
+        with self._mu:
+            slot = self.slot_of.get(addr)
+            if slot is not None:
+                return slot
+            if self._next_dynamic >= self.max_slots:
+                return None
+            slot = self._next_dynamic
+            self._next_dynamic += 1
+            self.slot_of[addr] = slot
+            return slot
+
+
+class Replicator(asyncio.DatagramProtocol):
+    """One UDP socket for send + receive, like the reference's single
+    ``net.PacketConn`` (repo.go:31). Constructed via :meth:`create`."""
+
+    def __init__(self, node_addr: str, peer_addrs: Sequence[str], slots: SlotTable, log=None):
+        self.node_addr = node_addr
+        # Self-filtering peer list (repo.go:36-41).
+        self.peers: List[Addr] = [
+            _resolve(p) for p in dict.fromkeys(peer_addrs) if p != node_addr
+        ]
+        self.slots = slots
+        self.log = log
+        self.transport: Optional[asyncio.DatagramTransport] = None
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.repo = None  # set by the supervisor (TPURepo)
+        self.rx_packets = 0
+        self.rx_errors = 0
+        self.tx_packets = 0
+
+    @classmethod
+    async def create(
+        cls, node_addr: str, peer_addrs: Sequence[str], slots: SlotTable, log=None
+    ) -> "Replicator":
+        loop = asyncio.get_running_loop()
+        self = cls(node_addr, peer_addrs, slots, log)
+        self.loop = loop
+        host, port = parse_addr(node_addr)
+        await loop.create_datagram_endpoint(lambda: self, local_addr=(host, port))
+        return self
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+
+    # -- receive path (repo.go:54-92) ---------------------------------------
+
+    def datagram_received(self, data: bytes, addr: Addr) -> None:
+        self.rx_packets += 1
+        try:
+            state = wire.decode(data)
+        except ValueError:
+            self.rx_errors += 1
+            if self.log:
+                self.log.debug("bad packet", extra={"peer": f"{addr[0]}:{addr[1]}"})
+            return
+        if self.repo is None:
+            return
+        if not state.is_zero():
+            slot = (
+                state.origin_slot
+                if state.origin_slot is not None and state.origin_slot < self.slots.max_slots
+                else self.slots.resolve(addr)
+            )
+            if slot is None:
+                self.rx_errors += 1
+                return
+            self.repo.apply_delta(state, slot)
+            if self.log:
+                self.log.debug(
+                    "received",
+                    extra={"peer": f"{addr[0]}:{addr[1]}", "bucket": state.name, "slot": slot},
+                )
+        else:
+            # Incast request: unicast our state back if we have any
+            # (repo.go:86-90). Device read happens off the event loop.
+            asyncio.ensure_future(self._reply_incast(state.name, addr))
+
+    async def _reply_incast(self, name: str, addr: Addr) -> None:
+        assert self.loop is not None
+        states = await self.loop.run_in_executor(None, self.repo.snapshot, name)
+        for st in states:
+            self._send(wire.encode(st), addr)
+        if states and self.log:
+            self.log.debug(
+                "incast reply",
+                extra={"peer": f"{addr[0]}:{addr[1]}", "bucket": name, "lanes": len(states)},
+            )
+
+    # -- send path (repo.go:123-169) ----------------------------------------
+
+    def _send(self, data: bytes, addr: Addr) -> None:
+        if self.transport is not None and not self.transport.is_closing():
+            self.transport.sendto(data, addr)
+            self.tx_packets += 1
+
+    def _broadcast_now(self, payloads: List[bytes]) -> None:
+        for data in payloads:
+            for peer in self.peers:
+                self._send(data, peer)
+
+    def broadcast_states(self, states: Sequence[wire.WireState]) -> None:
+        """Thread-safe broadcast of full bucket states to every peer —
+        callable from the engine thread (the reference broadcasts from the
+        request goroutine, repo.go:129-158)."""
+        if not self.peers:
+            return
+        payloads = []
+        for st in states:
+            try:
+                payloads.append(wire.encode(st))
+            except wire.NameTooLargeError:
+                # Names in (v2-limit, v1-limit]: drop the trailer, receivers
+                # fall back to the sender-address slot table.
+                payloads.append(
+                    wire.encode(
+                        wire.WireState(
+                            name=st.name,
+                            added=st.added,
+                            taken=st.taken,
+                            elapsed_ns=st.elapsed_ns,
+                        )
+                    )
+                )
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(self._broadcast_now, payloads)
+
+    def send_incast_request(self, name: str) -> None:
+        """Broadcast a zero-state packet: 'send me your state for this
+        bucket' (repo.go:99-103). Thread-safe."""
+        if not self.peers:
+            return
+        data = wire.encode(wire.WireState(name=name, added=0.0, taken=0.0, elapsed_ns=0))
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(self._broadcast_now, [data])
+
+    def close(self) -> None:
+        if self.transport is not None:
+            self.transport.close()
+
+    def stats(self) -> dict:
+        return {
+            "replication_rx_packets": self.rx_packets,
+            "replication_rx_errors": self.rx_errors,
+            "replication_tx_packets": self.tx_packets,
+            "replication_peers": len(self.peers),
+        }

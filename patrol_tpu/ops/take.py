@@ -1,0 +1,161 @@
+"""The batched take kernel — the reference's hot inner computation
+(``Bucket.Take``, bucket.go:186-225) re-expressed as one branch-free JAX
+kernel over a microbatch of requests.
+
+Where the reference serializes takes under a per-bucket mutex
+(bucket.go:21,187), this kernel admits a whole microbatch in one device
+call. Contention on a hot bucket is handled *algebraically* instead of with
+locks: the host batcher coalesces same-(bucket, rate, count) requests into a
+single kernel row carrying ``nreq`` (how many identical requests queued) and
+the kernel computes how many of them fit greedily — exactly the result of
+running the reference's sequential takes at the same timestamp, where only
+the first take refills (delta becomes 0 for the rest).
+
+Fixed-point arithmetic notes: state is int64 nanotokens; the refill grant is
+computed in float64 exactly as the reference does (``float64(d) /
+float64(interval)``, bucket.go:130-143) then floor-quantized to nanotokens,
+so host oracle and device kernel agree bit-for-bit on CPU and to float64
+precision on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from patrol_tpu.models.limiter import ADDED, TAKEN, NANO, LimiterState
+
+# Refill grants are clipped here before the float64→int64 cast to keep the
+# cast defined; any realistic grant is far below (and the capacity cap is
+# applied after, in exact int64).
+_GRANT_CLIP = float(2**62)
+
+
+class TakeRequest(NamedTuple):
+    """A microbatch of K take requests. All arrays have leading dim K.
+
+    Invariants maintained by the host batcher:
+      * ``rows`` are unique among rows with ``nreq > 0`` (duplicates are
+        coalesced into ``nreq``); padding rows have ``nreq == 0`` and commit
+        nothing.
+      * ``cap_base_nt`` is the lazily-initialized capacity base for the row
+        (host-owned mirror of the reference's ``added = capacity`` init,
+        bucket.go:194-196).
+      * ``created_ns`` is the host-owned creation timestamp (repo.go:205).
+    """
+
+    rows: jax.Array  # int32[K] bucket-slot indices
+    now_ns: jax.Array  # int64[K] request clock (the injected-clock seam)
+    freq: jax.Array  # int64[K] rate frequency (capacity in tokens)
+    per_ns: jax.Array  # int64[K] rate period
+    count_nt: jax.Array  # int64[K] tokens per request, in nanotokens
+    nreq: jax.Array  # int64[K] identical requests coalesced into this row
+    cap_base_nt: jax.Array  # int64[K] capacity base (0 ⇒ fresh bucket)
+    created_ns: jax.Array  # int64[K] bucket creation time
+
+
+class TakeResult(NamedTuple):
+    """Per-row outcome. The host fans per-request responses out of this:
+    request i (0-based arrival order) of a row with admitted count k gets
+    ``ok = i < k`` and ``remaining = have − min(i+1, k)·count`` (the
+    reference returns post-commit remaining on success, pre-reject remaining
+    on failure, bucket.go:215-224)."""
+
+    have_nt: jax.Array  # int64[K] tokens after refill, before the batch's takes
+    admitted: jax.Array  # int64[K] how many of nreq were admitted
+    own_added_nt: jax.Array  # int64[K] this node's PN lane after commit …
+    own_taken_nt: jax.Array  # int64[K] … for wire broadcast
+    elapsed_ns: jax.Array  # int64[K] bucket elapsed after commit
+
+
+def take_batch(
+    state: LimiterState, req: TakeRequest, node_slot: int
+) -> tuple[LimiterState, TakeResult]:
+    """Pure function: apply a microbatch of takes, return new state + results.
+
+    Mirrors bucket.go:186-225 step-for-step on each row:
+    capacity base (lazy init is host-side), monotonic-time guard
+    (bucket.go:198-201), refill capped at capacity — cap may be negative,
+    forfeiting excess tokens from merges (bucket.go:211-213) — and a
+    conditional commit of (grant, taken, elapsed) only when at least one
+    request is admitted (bucket.go:217-223).
+    """
+    i64 = jnp.int64
+    rows = req.rows
+
+    pn_rows = state.pn[rows]  # [K, N, 2] gather
+    sum_added = pn_rows[:, :, ADDED].sum(axis=-1)
+    sum_taken = pn_rows[:, :, TAKEN].sum(axis=-1)
+
+    cap_now_nt = req.freq * NANO  # capacity of *this* request (bucket.go:192)
+    tokens_nt = req.cap_base_nt + sum_added - sum_taken
+
+    last = jnp.minimum(req.created_ns + state.elapsed[rows], req.now_ns)
+    delta = req.now_ns - last
+
+    # Refill: float64(delta)/float64(interval) tokens (bucket.go:130-148),
+    # interval being the truncating integer division per/freq.
+    safe_freq = jnp.where(req.freq == 0, 1, req.freq)
+    interval = req.per_ns // safe_freq
+    rate_zero = (req.freq == 0) | (req.per_ns == 0) | (interval == 0)
+    safe_interval = jnp.where(interval == 0, 1, interval)
+    grant_tokens = delta.astype(jnp.float64) / safe_interval.astype(jnp.float64)
+    grant_f = jnp.where(rate_zero, 0.0, grant_tokens * float(NANO))
+    grant_nt = jnp.floor(jnp.clip(grant_f, 0.0, _GRANT_CLIP)).astype(i64)
+    missing_nt = cap_now_nt - tokens_nt
+    grant_nt = jnp.minimum(grant_nt, missing_nt)
+
+    have_nt = tokens_nt + grant_nt
+
+    # Greedy admission of nreq identical requests of count_nt each: the first
+    # take sees the refilled balance; takes 2..n run at the same now (delta 0,
+    # no further refill), so k = clip(have // count, 0, nreq).
+    safe_count = jnp.where(req.count_nt <= 0, 1, req.count_nt)
+    k = jnp.clip(have_nt // safe_count, 0, req.nreq)
+    k = jnp.where(req.count_nt > 0, k, 0)
+    success = k >= 1
+
+    d_added = jnp.where(success, grant_nt, i64(0))
+    d_taken = jnp.where(success, k * req.count_nt, i64(0))
+    d_elapsed = jnp.where(success, delta, i64(0))
+
+    # Padding rows (nreq == 0) contribute zero deltas, so duplicate indices
+    # from padding are harmless under scatter-add.
+    pn = state.pn.at[rows, node_slot, ADDED].add(d_added)
+    pn = pn.at[rows, node_slot, TAKEN].add(d_taken)
+    elapsed = state.elapsed.at[rows].add(d_elapsed)
+
+    result = TakeResult(
+        have_nt=have_nt,
+        admitted=k,
+        own_added_nt=pn_rows[:, node_slot, ADDED] + d_added,
+        own_taken_nt=pn_rows[:, node_slot, TAKEN] + d_taken,
+        elapsed_ns=state.elapsed[rows] + d_elapsed,
+    )
+    return LimiterState(pn=pn, elapsed=elapsed), result
+
+
+take_batch_jit = partial(jax.jit, static_argnames=("node_slot",), donate_argnums=0)(
+    take_batch
+)
+
+
+def remaining_for_request(
+    have_nt: int, admitted: int, count_nt: int, index: int
+) -> tuple[int, bool]:
+    """Host-side fan-out of one coalesced row to per-request responses.
+
+    ``index`` is the request's 0-based arrival position in the coalesced
+    queue. Matches the reference's sequential semantics: admitted requests
+    see the balance after their own commit; rejected ones see the balance
+    left after all admitted requests (bucket.go:215-224). The uint64 cast of
+    the reference is clamped at zero (PN merges can drive the balance
+    negative; Go's negative-float→uint64 cast is UB we do not reproduce).
+    """
+    ok = index < admitted
+    consumed = (index + 1 if ok else admitted) * count_nt
+    remaining_nt = have_nt - consumed
+    return max(remaining_nt, 0) // NANO, ok

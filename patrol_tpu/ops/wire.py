@@ -77,11 +77,30 @@ class WireState:
 
     @property
     def added_nt(self) -> int:
-        return round(self.added * NANO)
+        return _sanitize_nt(self.added)
 
     @property
     def taken_nt(self) -> int:
-        return round(self.taken * NANO)
+        return _sanitize_nt(self.taken)
+
+
+_INT64_MAX = (1 << 63) - 1
+
+
+def _sanitize_nt(tokens: float) -> int:
+    """float64 wire value → int64 nanotokens, hardened against hostile
+    packets: NaN → 0, ±Inf / out-of-range clamp to the int64 edge, negatives
+    clamp to 0 (device state is a non-negative G-counter pair). The float64
+    reference absorbs such values silently (bucket.go:78-79); the int64
+    device path must not crash on them."""
+    if tokens != tokens:  # NaN
+        return 0
+    if tokens <= 0.0:
+        return 0
+    nt = tokens * NANO
+    if nt >= _INT64_MAX:
+        return _INT64_MAX
+    return round(nt)
 
 
 def from_nanotokens(
@@ -103,7 +122,10 @@ def from_nanotokens(
 def encode(state: WireState) -> bytes:
     """Serialize to the reference wire format (bucket.go:51-68), appending the
     v2 origin-slot trailer when ``origin_slot`` is set."""
-    name_bytes = state.name.encode("utf-8")
+    # surrogateescape: reference names are raw bytes (bucket.go:64-88);
+    # non-UTF8 bytes must round-trip exactly or distinct buckets would
+    # collapse into one and fork CRDT state across the cluster.
+    name_bytes = state.name.encode("utf-8", errors="surrogateescape")
     limit = MAX_NAME_LENGTH if state.origin_slot is not None else MAX_NAME_LENGTH_V1
     if len(name_bytes) > limit:
         raise NameTooLargeError(limit)
@@ -131,7 +153,9 @@ def decode(data: bytes) -> WireState:
     name_len = data[24]
     if len(data) - FIXED_SIZE < name_len:
         raise ShortBufferError("short buffer")
-    name = data[FIXED_SIZE : FIXED_SIZE + name_len].decode("utf-8", errors="replace")
+    name = data[FIXED_SIZE : FIXED_SIZE + name_len].decode(
+        "utf-8", errors="surrogateescape"
+    )
 
     elapsed_ns = elapsed_u64 - (1 << 64) if elapsed_u64 >= 1 << 63 else elapsed_u64
 

@@ -150,19 +150,23 @@ class Bucket:
         """Join: field-wise max of added, taken, elapsed.
 
         Commutative, associative, idempotent — the CvRDT laws the property
-        tests pin down (bucket_test.go:68-114).
+        tests pin down (bucket_test.go:68-114). Locks are taken in id() order
+        to avoid the ABBA deadlock the reference's self-then-other ordering
+        permits under concurrent cross-merges (bucket.go:240-263).
         """
-        with self._mu:
-            for other in others:
-                if other is self:
-                    continue
-                with other._mu:
-                    if self.added_nt < other.added_nt:
-                        self.added_nt = other.added_nt
-                    if self.taken_nt < other.taken_nt:
-                        self.taken_nt = other.taken_nt
-                    if self.elapsed_ns < other.elapsed_ns:
-                        self.elapsed_ns = other.elapsed_ns
+        for other in others:
+            if other is self:
+                continue
+            first, second = (
+                (self, other) if id(self) < id(other) else (other, self)
+            )
+            with first._mu, second._mu:
+                if self.added_nt < other.added_nt:
+                    self.added_nt = other.added_nt
+                if self.taken_nt < other.taken_nt:
+                    self.taken_nt = other.taken_nt
+                if self.elapsed_ns < other.elapsed_ns:
+                    self.elapsed_ns = other.elapsed_ns
 
 
 class Repo:

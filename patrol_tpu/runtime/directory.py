@@ -1,0 +1,107 @@
+"""Bucket directory: the host-side name→row mapping for device state.
+
+The reference grows a ``map[string]*Bucket`` on demand under an RWMutex with
+double-checked locking (repo.go:189-211). XLA wants static shapes, so device
+state is a fixed pool of bucket rows and this directory assigns names to
+rows. It also owns the *non-replicated* per-bucket metadata that the
+reference keeps inside ``Bucket``:
+
+* ``created_ns`` — node-local creation timestamp, stamped from the injected
+  clock at assignment (repo.go:205; never serialized, bucket.go:28-31);
+* ``cap_base_nt`` — the lazily-initialized capacity base, the host-side
+  mirror of ``if added == 0 { added = capacity }`` (bucket.go:194-196).
+
+Rows are recycled through an LRU-ish second-chance policy only when the pool
+is exhausted *and* the row is idle (no queued work) — eviction of a bucket
+is semantically safe in this protocol: state is soft (re-hydrated from peers
+via incast on next use, repo.go:96-106), exactly like a node restart.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+class DirectoryFullError(RuntimeError):
+    """All bucket rows are live and none could be reclaimed."""
+
+
+class BucketDirectory:
+    """Thread-safe name→row assignment over a fixed row pool."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._mu = threading.Lock()
+        self._rows: Dict[str, int] = {}
+        self._names: list = [None] * capacity
+        self._next_fresh = 0  # bump allocator; recycling kicks in when spent
+        self._free: list = []  # explicitly released rows
+        self.created_ns = np.zeros(capacity, dtype=np.int64)
+        self.cap_base_nt = np.zeros(capacity, dtype=np.int64)
+        self.last_used_ns = np.zeros(capacity, dtype=np.int64)
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def lookup(self, name: str) -> Optional[int]:
+        # dict reads are atomic under the GIL (cf. the reference's RLock fast
+        # path, repo.go:192-198).
+        return self._rows.get(name)
+
+    def assign(self, name: str, now_ns: int) -> Tuple[int, bool]:
+        """Get-or-create: returns (row, created). Stamps ``created_ns`` from
+        the caller's clock on creation (repo.go:205)."""
+        row = self._rows.get(name)
+        if row is not None:
+            self.last_used_ns[row] = now_ns
+            return row, False
+        with self._mu:
+            row = self._rows.get(name)
+            if row is not None:
+                return row, False
+            row = self._alloc_locked()
+            self._rows[name] = row
+            self._names[row] = name
+            self.created_ns[row] = now_ns
+            self.cap_base_nt[row] = 0
+            self.last_used_ns[row] = now_ns
+            return row, True
+
+    def _alloc_locked(self) -> int:
+        if self._free:
+            return self._free.pop()
+        if self._next_fresh < self.capacity:
+            row = self._next_fresh
+            self._next_fresh += 1
+            return row
+        raise DirectoryFullError(
+            f"bucket directory full ({self.capacity} rows); "
+            "evict or grow the pool"
+        )
+
+    def release(self, name: str) -> Optional[int]:
+        """Drop a name→row binding and recycle the row. The caller must zero
+        the device row before reuse (the engine does this lazily)."""
+        with self._mu:
+            row = self._rows.pop(name, None)
+            if row is None:
+                return None
+            self._names[row] = None
+            self._free.append(row)
+            return row
+
+    def name_of(self, row: int) -> Optional[str]:
+        return self._names[row]
+
+    def init_cap_base(self, row: int, cap_nt: int) -> int:
+        """Lazily pin the capacity base for a row: first non-zero capacity
+        wins, committed even when the take that carried it fails
+        (bucket.go:194-196). Returns the effective base."""
+        base = int(self.cap_base_nt[row])
+        if base == 0 and cap_nt != 0:
+            self.cap_base_nt[row] = cap_nt
+            return cap_nt
+        return base

@@ -1,0 +1,417 @@
+"""Device engine: the microbatching feeder between concurrent host callers
+and single-device kernel calls.
+
+The reference's concurrency story is goroutine-per-request + a mutex per
+bucket + one single-threaded UDP merge loop (bucket.go:21, repo.go:54-92).
+The TPU-native inversion: *batching replaces locking*. All mutation of
+limiter state happens on one engine thread that drains two queues — take
+tickets and replication deltas — into padded, fixed-shape kernel calls:
+
+    submit_take()/ingest_delta()  →  queues  →  engine tick:
+        merge_batch(deltas)   one scatter-max call
+        take_batch(groups)    one fused take call
+        complete tickets, emit broadcast states
+
+Natural batching: the engine dispatches immediately when work exists;
+requests that arrive during a device call form the next batch, so batch size
+adapts to load and idle latency stays at one device round-trip.
+
+Hot buckets are coalesced algebraically (see ops/take.py): identical
+(bucket, rate, count) tickets become one kernel row with ``nreq``; a bucket
+appearing with *different* rate/count in the same tick is deferred one tick
+to preserve the unique-rows kernel invariant (sequential semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from functools import lru_cache
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from patrol_tpu.models.limiter import NANO, LimiterConfig, LimiterState, init_state
+from patrol_tpu.ops import wire
+from patrol_tpu.ops.merge import MergeBatch, merge_batch, read_rows
+from patrol_tpu.ops.rate import Rate
+from patrol_tpu.ops.take import TakeRequest, take_batch, remaining_for_request
+from patrol_tpu.runtime.bucket import ClockFn, system_clock
+from patrol_tpu.runtime.directory import BucketDirectory
+
+log = logging.getLogger("patrol.engine")
+
+# Per-tick caps: at most this many take rows / merge rows per device call;
+# the rest stays queued for the next tick (the loop runs back-to-back).
+MAX_TAKE_ROWS = 4096
+MAX_MERGE_ROWS = 8192
+
+BroadcastFn = Callable[[List[wire.WireState]], None]
+
+
+class TakeTicket:
+    """One pending take request. Completion is observable both from threads
+    (:meth:`wait`) and event loops (:meth:`add_done_callback`), so the
+    asyncio HTTP front never blocks on the engine thread."""
+
+    __slots__ = (
+        "name",
+        "row",
+        "rate",
+        "count",
+        "now_ns",
+        "_event",
+        "_mu",
+        "_callbacks",
+        "remaining",
+        "ok",
+    )
+
+    def __init__(self, name: str, row: int, rate: Rate, count: int, now_ns: int):
+        self.name = name
+        self.row = row
+        self.rate = rate
+        self.count = count
+        self.now_ns = now_ns
+        self._event = threading.Event()
+        self._mu = threading.Lock()
+        self._callbacks: List[Callable[[], None]] = []
+        self.remaining: int = 0
+        self.ok: bool = False
+
+    def complete(self, remaining: int, ok: bool) -> None:
+        with self._mu:
+            self.remaining = remaining
+            self.ok = ok
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            cb()
+
+    def add_done_callback(self, cb: Callable[[], None]) -> None:
+        """Invoke ``cb`` once completed (immediately if already done).
+        ``cb`` must be thread-safe — it runs on the engine thread."""
+        with self._mu:
+            if not self._event.is_set():
+                self._callbacks.append(cb)
+                return
+        cb()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+
+class _Delta:
+    __slots__ = ("row", "slot", "added_nt", "taken_nt", "elapsed_ns")
+
+    def __init__(self, row: int, slot: int, added_nt: int, taken_nt: int, elapsed_ns: int):
+        self.row = row
+        self.slot = slot
+        # Ingest clamp: device state is non-negative by invariant; hostile or
+        # corrupt packets must not be able to poison the max-merge.
+        self.added_nt = max(added_nt, 0)
+        self.taken_nt = max(taken_nt, 0)
+        self.elapsed_ns = max(elapsed_ns, 0)
+
+
+def _pad_size(n: int, lo: int = 8, hi: int = MAX_MERGE_ROWS) -> int:
+    """Next power of two ≥ n, bounded — keeps the jit-variant count ~log."""
+    size = lo
+    while size < n and size < hi:
+        size <<= 1
+    return size
+
+
+@lru_cache(maxsize=64)
+def _jit_take(k: int, node_slot: int):
+    return jax.jit(take_batch, static_argnames=("node_slot",), donate_argnums=0)
+
+
+@lru_cache(maxsize=64)
+def _jit_merge(k: int):
+    return jax.jit(merge_batch, donate_argnums=0)
+
+
+class DeviceEngine:
+    """Owns device state and the feeder thread. Thread-safe entry points:
+    :meth:`submit_take` / :meth:`take`, :meth:`ingest_delta`,
+    :meth:`snapshot`, :meth:`stop`."""
+
+    def __init__(
+        self,
+        config: LimiterConfig,
+        node_slot: int = 0,
+        clock: ClockFn = system_clock,
+        on_broadcast: Optional[BroadcastFn] = None,
+        device=None,
+    ):
+        self.config = config
+        self.node_slot = node_slot
+        self.clock = clock
+        self.on_broadcast = on_broadcast
+        self.directory = BucketDirectory(config.buckets)
+        self.state: LimiterState = init_state(config, device=device)
+
+        self._cond = threading.Condition()
+        # Kernel calls donate the state buffers (zero-copy update); this lock
+        # keeps introspection readers off a donated-and-deleted array.
+        self._state_mu = threading.Lock()
+        self._takes: deque = deque()
+        self._deltas: deque = deque()
+        self._stopped = False
+        self._busy = False
+        self._ticks = 0  # device calls issued (observability)
+        self._thread = threading.Thread(target=self._run, name="patrol-engine", daemon=True)
+        self._thread.start()
+
+    # -- entry points -------------------------------------------------------
+
+    def submit_take(
+        self, name: str, rate: Rate, count: int, now_ns: Optional[int] = None
+    ) -> Tuple[TakeTicket, bool]:
+        """Queue a take; returns (ticket, created). ``created`` mirrors the
+        get-or-create miss signal that triggers incast (repo.go:96-106)."""
+        now = self.clock() if now_ns is None else now_ns
+        row, created = self.directory.assign(name, now)
+        self.directory.init_cap_base(row, rate.freq * NANO)
+        ticket = TakeTicket(name, row, rate, count, now)
+        with self._cond:
+            self._takes.append(ticket)
+            self._cond.notify()
+        return ticket, created
+
+    def take(
+        self, name: str, rate: Rate, count: int, now_ns: Optional[int] = None
+    ) -> Tuple[int, bool, bool]:
+        """Blocking take: returns (remaining, ok, created)."""
+        ticket, created = self.submit_take(name, rate, count, now_ns)
+        ticket.wait()
+        return ticket.remaining, ticket.ok, created
+
+    def ingest_delta(self, state: wire.WireState, slot: int) -> bool:
+        """Queue one replication delta for merge; returns created flag."""
+        now = self.clock()
+        row, created = self.directory.assign(state.name, now)
+        if not 0 <= slot < self.config.nodes:
+            log.warning("delta slot %d out of range, dropped", slot)
+            return created
+        delta = _Delta(row, slot, state.added_nt, state.taken_nt, state.elapsed_ns)
+        with self._cond:
+            self._deltas.append(delta)
+            self._cond.notify()
+        return created
+
+    def read_rows(self, rows) -> tuple:
+        """Donation-safe gather of per-bucket state: returns (pn[K,N,2],
+        elapsed[K]) as host numpy arrays."""
+        idx = jnp.asarray(np.asarray(rows, dtype=np.int32))
+        with self._state_mu:
+            rs = read_rows(self.state, idx)
+            return np.asarray(rs.pn), np.asarray(rs.elapsed)
+
+    def snapshot(self, name: str) -> List[wire.WireState]:
+        """Read one bucket's full PN state as per-slot wire states — the
+        incast reply payload (repo.go:86-90): one packet per non-zero node
+        lane, each tagged with its origin slot."""
+        row = self.directory.lookup(name)
+        if row is None:
+            return []
+        pn_rows, elapsed_rows = self.read_rows([row])
+        pn = pn_rows[0]  # [N, 2]
+        elapsed = int(elapsed_rows[0])
+        out = []
+        for slot in range(pn.shape[0]):
+            a, t = int(pn[slot, 0]), int(pn[slot, 1])
+            if a or t:
+                out.append(wire.from_nanotokens(name, a, t, elapsed, origin_slot=slot))
+        if not out and elapsed:
+            out.append(wire.from_nanotokens(name, 0, 0, elapsed, origin_slot=self.node_slot))
+        return out
+
+    def tokens(self, name: str) -> int:
+        """Whole tokens currently in a bucket (introspection; bucket.go:156)."""
+        row = self.directory.lookup(name)
+        if row is None:
+            return 0
+        pn_rows, _ = self.read_rows([row])
+        pn = pn_rows[0]
+        base = int(self.directory.cap_base_nt[row])
+        nt = base + int(pn[:, 0].sum()) - int(pn[:, 1].sum())
+        return max(nt, 0) // NANO
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until all currently queued work has been applied to device
+        state. Test/introspection helper, not a hot-path call."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cond:
+                if not self._takes and not self._deltas and not self._busy:
+                    return True
+            time.sleep(0.0005)
+        return False
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5)
+
+    @property
+    def ticks(self) -> int:
+        return self._ticks
+
+    # -- engine loop --------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not (self._takes or self._deltas or self._stopped):
+                    self._cond.wait()
+                if self._stopped and not (self._takes or self._deltas):
+                    return
+                deltas = self._drain(self._deltas, MAX_MERGE_ROWS)
+                tickets = self._drain(self._takes, MAX_TAKE_ROWS)
+                self._busy = True
+            try:
+                if deltas:
+                    self._apply_merges(deltas)
+                if tickets:
+                    self._apply_takes(tickets)
+            except Exception:  # pragma: no cover - engine must never die
+                log.exception("engine tick failed")
+                for t in tickets:
+                    t.complete(0, False)
+            finally:
+                with self._cond:
+                    self._busy = False
+
+    @staticmethod
+    def _drain(q: deque, limit: int) -> list:
+        out = []
+        while q and len(out) < limit:
+            out.append(q.popleft())
+        return out
+
+    def _apply_merges(self, deltas: Sequence[_Delta]) -> None:
+        k = _pad_size(len(deltas))
+        rows = np.zeros(k, dtype=np.int32)
+        slots = np.zeros(k, dtype=np.int32)
+        added = np.zeros(k, dtype=np.int64)
+        taken = np.zeros(k, dtype=np.int64)
+        elapsed = np.zeros(k, dtype=np.int64)
+        for i, d in enumerate(deltas):
+            rows[i] = d.row
+            slots[i] = d.slot
+            added[i] = d.added_nt
+            taken[i] = d.taken_nt
+            elapsed[i] = d.elapsed_ns
+        batch = MergeBatch(
+            rows=jnp.asarray(rows),
+            slots=jnp.asarray(slots),
+            added_nt=jnp.asarray(added),
+            taken_nt=jnp.asarray(taken),
+            elapsed_ns=jnp.asarray(elapsed),
+        )
+        with self._state_mu:
+            self.state = _jit_merge(k)(self.state, batch)
+        self._ticks += 1
+
+    def _apply_takes(self, tickets: Sequence[TakeTicket]) -> None:
+        # Group by (row, rate, count), preserving arrival order. A row seen
+        # again with a different key is deferred to the next tick.
+        groups: Dict[tuple, List[TakeTicket]] = {}
+        row_key: Dict[int, tuple] = {}
+        deferred: List[TakeTicket] = []
+        for t in tickets:
+            key = (t.row, t.rate.freq, t.rate.per_ns, t.count)
+            held = row_key.get(t.row)
+            if held is None:
+                row_key[t.row] = key
+                groups[key] = [t]
+            elif held == key:
+                groups[key].append(t)
+            else:
+                deferred.append(t)
+        if deferred:
+            with self._cond:
+                self._takes.extendleft(reversed(deferred))
+                self._cond.notify()
+
+        keys = list(groups.keys())
+        k = _pad_size(len(keys), hi=MAX_TAKE_ROWS)
+        rows = np.zeros(k, dtype=np.int32)
+        now_ns = np.zeros(k, dtype=np.int64)
+        freq = np.zeros(k, dtype=np.int64)
+        per_ns = np.zeros(k, dtype=np.int64)
+        count_nt = np.zeros(k, dtype=np.int64)
+        nreq = np.zeros(k, dtype=np.int64)
+        cap_base = np.zeros(k, dtype=np.int64)
+        created = np.zeros(k, dtype=np.int64)
+        for i, key in enumerate(keys):
+            ts = groups[key]
+            first = ts[0]
+            rows[i] = first.row
+            # Earliest arrival clock for the group: conservative (refills
+            # least); exact when callers share an injected clock tick.
+            now_ns[i] = min(t.now_ns for t in ts)
+            freq[i] = first.rate.freq
+            per_ns[i] = first.rate.per_ns
+            count_nt[i] = first.count * NANO
+            nreq[i] = len(ts)
+            cap_base[i] = self.directory.cap_base_nt[first.row]
+            created[i] = self.directory.created_ns[first.row]
+
+        req = TakeRequest(
+            rows=jnp.asarray(rows),
+            now_ns=jnp.asarray(now_ns),
+            freq=jnp.asarray(freq),
+            per_ns=jnp.asarray(per_ns),
+            count_nt=jnp.asarray(count_nt),
+            nreq=jnp.asarray(nreq),
+            cap_base_nt=jnp.asarray(cap_base),
+            created_ns=jnp.asarray(created),
+        )
+        with self._state_mu:
+            self.state, res = _jit_take(k, self.node_slot)(
+                self.state, req, node_slot=self.node_slot
+            )
+        self._ticks += 1
+
+        have = np.asarray(res.have_nt)  # blocks until device done
+        admitted = np.asarray(res.admitted)
+        own_a = np.asarray(res.own_added_nt)
+        own_t = np.asarray(res.own_taken_nt)
+        elapsed = np.asarray(res.elapsed_ns)
+
+        broadcasts: List[wire.WireState] = []
+        for i, key in enumerate(keys):
+            ts = groups[key]
+            c_nt = int(count_nt[i])
+            for idx, t in enumerate(ts):
+                remaining, ok = remaining_for_request(
+                    int(have[i]), int(admitted[i]), c_nt, idx
+                )
+                t.complete(remaining, ok)
+            # Replicate this node's lane. The reference broadcasts full state
+            # on every take, success or not (api.go:74, README.md:41-43); we
+            # skip only when our lane is still all-zero — a zero state on the
+            # wire is the incast *request* marker (repo.go:78-90).
+            if own_a[i] or own_t[i] or elapsed[i]:
+                broadcasts.append(
+                    wire.from_nanotokens(
+                        ts[0].name,
+                        int(own_a[i]),
+                        int(own_t[i]),
+                        int(elapsed[i]),
+                        origin_slot=self.node_slot,
+                    )
+                )
+        if broadcasts and self.on_broadcast is not None:
+            try:
+                self.on_broadcast(broadcasts)
+            except Exception:  # pragma: no cover
+                log.exception("broadcast hook failed")

@@ -1,0 +1,1 @@
+"""Utilities: structured logging, profiling endpoints, clocks."""

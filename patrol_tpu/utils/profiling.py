@@ -1,0 +1,113 @@
+"""Observability primitives behind the ``/debug`` routes — the equivalent of
+the reference's full ``net/http/pprof`` suite (api.go:29-39) plus mutex-
+profile-style engine stats (cmd/patrol/main.go:24), re-imagined for a
+Python-host + JAX-device process:
+
+* :class:`SamplingProfiler` — a wall-clock sampling CPU profiler over all
+  threads (``sys._current_frames`` at a fixed interval), the analogue of
+  ``pprof.Profile``'s sampled CPU profile.
+* :func:`thread_dump` — all-thread stack dump (≙ ``/debug/pprof/goroutine``).
+* :func:`heap_summary` — allocation summary via ``tracemalloc`` when
+  enabled, else GC stats (≙ ``/debug/pprof/heap`` / ``allocs``).
+* :func:`jax_trace` — captures a JAX profiler trace (XPlane/perfetto dump),
+  the device-side story pprof never had.
+"""
+
+from __future__ import annotations
+
+import gc
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from collections import Counter
+from typing import Dict, Optional
+
+
+class SamplingProfiler:
+    """Sample every thread's stack at ``interval_s`` for ``duration_s``;
+    report leaf-frame and whole-stack counts as text."""
+
+    def __init__(self, duration_s: float = 5.0, interval_s: float = 0.005):
+        self.duration_s = min(duration_s, 120.0)
+        self.interval_s = interval_s
+
+    def run(self) -> str:
+        leaf: Counter = Counter()
+        stacks: Counter = Counter()
+        samples = 0
+        deadline = time.monotonic() + self.duration_s
+        me = threading.get_ident()
+        while time.monotonic() < deadline:
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                samples += 1
+                code = frame.f_code
+                leaf[f"{code.co_qualname} ({code.co_filename}:{frame.f_lineno})"] += 1
+                stack = []
+                f: Optional[object] = frame
+                while f is not None:
+                    stack.append(f.f_code.co_qualname)  # type: ignore[attr-defined]
+                    f = f.f_back  # type: ignore[attr-defined]
+                stacks[";".join(reversed(stack))] += 1
+            time.sleep(self.interval_s)
+
+        lines = [
+            f"sampling cpu profile: {self.duration_s:.1f}s at "
+            f"{1 / self.interval_s:.0f}Hz, {samples} samples",
+            "",
+            "-- hottest frames --",
+        ]
+        for name, n in leaf.most_common(30):
+            lines.append(f"{n:8d}  {name}")
+        lines += ["", "-- hottest stacks --"]
+        for stack, n in stacks.most_common(10):
+            lines.append(f"{n:8d}  {stack}")
+        return "\n".join(lines) + "\n"
+
+
+def thread_dump() -> str:
+    """Stack dump of all live threads (≙ /debug/pprof/goroutine?debug=2)."""
+    names: Dict[int, str] = {t.ident: t.name for t in threading.enumerate() if t.ident}
+    out = [f"threads: {threading.active_count()}", ""]
+    for tid, frame in sys._current_frames().items():
+        out.append(f"thread {tid} [{names.get(tid, '?')}]:")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
+def heap_summary(limit: int = 30) -> str:
+    """Allocation summary (≙ /debug/pprof/heap). Detailed when tracemalloc
+    is active (start the server with PYTHONTRACEMALLOC=1 or POST
+    /debug/pprof/heap/start), GC table otherwise."""
+    import tracemalloc
+
+    lines = []
+    if tracemalloc.is_tracing():
+        snap = tracemalloc.take_snapshot()
+        stats = snap.statistics("lineno")
+        total = sum(s.size for s in stats)
+        lines.append(f"tracemalloc: {total / 1e6:.2f} MB in {len(stats)} sites")
+        for s in stats[:limit]:
+            lines.append(f"{s.size / 1e3:10.1f} kB  {s.count:8d} blocks  {s.traceback}")
+    else:
+        lines.append("tracemalloc not active; gc stats:")
+        for i, gen in enumerate(gc.get_stats()):
+            lines.append(f"gen{i}: {gen}")
+        lines.append(f"objects: {len(gc.get_objects())}")
+    return "\n".join(lines) + "\n"
+
+
+def jax_trace(duration_s: float = 2.0, out_dir: Optional[str] = None) -> str:
+    """Capture a JAX profiler trace (XPlane; viewable in perfetto /
+    tensorboard). Returns the dump directory."""
+    import jax
+
+    out = out_dir or tempfile.mkdtemp(prefix="patrol-jax-trace-")
+    jax.profiler.start_trace(out)
+    time.sleep(min(duration_s, 30.0))
+    jax.profiler.stop_trace()
+    return out

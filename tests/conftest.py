@@ -8,7 +8,10 @@ imports jax.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the deployment environment pins JAX_PLATFORMS to the real
+# TPU tunnel, where every test-sized compile costs ~20s. Unit/integration
+# tests always run on the virtual CPU mesh; only bench.py uses the chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,176 @@
+"""HTTP API behavior tests over a real loopback server — the five cases
+pinned by the reference's api_test.go:15-87, plus debug routes."""
+
+import asyncio
+import socket
+import threading
+
+import pytest
+
+from patrol_tpu.models.limiter import LimiterConfig
+from patrol_tpu.net.api import API, serve
+from patrol_tpu.runtime.engine import DeviceEngine
+from patrol_tpu.runtime.repo import TPURepo
+
+NANO = 1_000_000_000
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class ServerHarness:
+    """Real server on loopback in a background event loop thread."""
+
+    def __init__(self):
+        self.clock_ns = 0
+        self.engine = DeviceEngine(
+            LimiterConfig(buckets=64, nodes=4), node_slot=0, clock=lambda: self.clock_ns
+        )
+        self.repo = TPURepo(self.engine)
+        self.api = API(self.repo, stats=lambda: {"engine_ticks": self.engine.ticks})
+        self.port = free_port()
+        self.loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+        assert self._started.wait(10)
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+
+        async def main():
+            self.server = await serve(self.api, "127.0.0.1", self.port)
+            self._started.set()
+
+        self.loop.run_until_complete(main())
+        self.loop.run_forever()
+
+    def request(self, method: str, target: str) -> tuple:
+        with socket.create_connection(("127.0.0.1", self.port), timeout=5) as s:
+            s.sendall(
+                f"{method} {target} HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n".encode()
+            )
+            data = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        head, _, body = data.partition(b"\r\n\r\n")
+        status = int(head.split(b" ", 2)[1])
+        return status, body.decode()
+
+    def close(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+        self.engine.stop()
+
+
+@pytest.fixture(scope="module")
+def srv():
+    h = ServerHarness()
+    yield h
+    h.close()
+
+
+class TestTakeRoute:
+    """The five api_test.go cases, verbatim semantics."""
+
+    def test_name_too_long_400(self, srv):
+        status, body = srv.request("POST", "/take/" + "x" * 232 + "?rate=1:1s")
+        assert status == 400
+        assert "bucket name larger than 231" in body
+
+    def test_missing_rate_429_body_zero(self, srv):
+        status, body = srv.request("POST", "/take/no-rate")
+        assert (status, body) == (429, "0")
+
+    def test_default_count_is_one(self, srv):
+        status, body = srv.request("POST", "/take/defcount?rate=2:1s")
+        assert (status, body) == (200, "1")
+
+    def test_success_200(self, srv):
+        status, body = srv.request("POST", "/take/ok?rate=2:1s&count=1")
+        assert (status, body) == (200, "1")
+
+    def test_zero_rate_429(self, srv):
+        status, body = srv.request("POST", "/take/zero?rate=0:1s&count=1")
+        assert (status, body) == (429, "0")
+
+    def test_burst_exhaustion_429(self, srv):
+        for i in range(3):
+            status, body = srv.request("POST", "/take/burst?rate=3:1s")
+            assert (status, body) == (200, str(2 - i))
+        status, body = srv.request("POST", "/take/burst?rate=3:1s")
+        assert (status, body) == (429, "0")
+
+    def test_bad_rate_ignored_as_zero(self, srv):
+        status, body = srv.request("POST", "/take/badrate?rate=oops")
+        assert (status, body) == (429, "0")
+
+    def test_bad_count_ignored_as_one(self, srv):
+        status, body = srv.request("POST", "/take/badcount?rate=5:1s&count=wat")
+        assert (status, body) == (200, "4")
+
+    def test_get_method_rejected(self, srv):
+        status, _ = srv.request("GET", "/take/x?rate=1:1s")
+        assert status == 405
+
+    def test_url_escaped_name(self, srv):
+        status, body = srv.request("POST", "/take/sp%20ace?rate=5:1s")
+        assert (status, body) == (200, "4")
+
+
+class TestDebugRoutes:
+    def test_pprof_index(self, srv):
+        status, body = srv.request("GET", "/debug/pprof/")
+        assert status == 200 and "profile" in body
+
+    def test_goroutine_dump(self, srv):
+        status, body = srv.request("GET", "/debug/pprof/goroutine")
+        assert status == 200 and "patrol-engine" in body
+
+    def test_heap(self, srv):
+        status, body = srv.request("GET", "/debug/pprof/heap")
+        assert status == 200
+
+    def test_metrics(self, srv):
+        status, body = srv.request("GET", "/metrics")
+        assert status == 200
+        assert "patrol_engine_ticks" in body
+        assert "patrol_uptime_seconds" in body
+
+    def test_vars(self, srv):
+        status, body = srv.request("GET", "/debug/vars")
+        assert status == 200 and "engine_ticks" in body
+
+    def test_profile_short(self, srv):
+        status, body = srv.request("GET", "/debug/pprof/profile?seconds=0.2")
+        assert status == 200 and "sampling cpu profile" in body
+
+    def test_404(self, srv):
+        status, _ = srv.request("GET", "/nope")
+        assert status == 404
+
+
+class TestKeepAlive:
+    def test_two_requests_one_connection(self, srv):
+        with socket.create_connection(("127.0.0.1", srv.port), timeout=5) as s:
+            for i in range(2):
+                s.sendall(b"POST /take/ka?rate=9:1s HTTP/1.1\r\nHost: x\r\n\r\n")
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    buf += s.recv(65536)
+                head, _, rest = buf.partition(b"\r\n\r\n")
+                clen = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        clen = int(line.split(b":")[1])
+                while len(rest) < clen:
+                    rest += s.recv(65536)
+                assert head.startswith(b"HTTP/1.1 200")

@@ -1,0 +1,308 @@
+"""Device kernel tests: differential parity with the host oracle
+(patrol_tpu.runtime.bucket.Bucket mirrors bucket.go:186-263) plus CRDT law
+tests over the batched merge kernels (≙ bucket_test.go:68-114)."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from patrol_tpu.models.limiter import ADDED, TAKEN, NANO, LimiterConfig, init_state
+from patrol_tpu.ops.merge import (
+    MergeBatch,
+    merge_batch,
+    merge_dense,
+    read_rows,
+)
+from patrol_tpu.ops.rate import Rate
+from patrol_tpu.ops.take import TakeRequest, TakeResult, remaining_for_request, take_batch
+from patrol_tpu.runtime.bucket import Bucket
+
+
+class DeviceHarness:
+    """Single-bucket, single-node driver for differential tests: owns the
+    host-side metadata (cap base, created) exactly as the runtime directory
+    will, and issues one-row batches."""
+
+    def __init__(self, nodes: int = 4, node_slot: int = 0):
+        self.state = init_state(LimiterConfig(buckets=8, nodes=nodes))
+        self.node_slot = node_slot
+        self.cap_base_nt = {}
+        self.created_ns = {}
+
+    def take(self, row: int, now_ns: int, rate: Rate, n: int, nreq: int = 1):
+        if row not in self.created_ns:
+            self.created_ns[row] = now_ns
+        if self.cap_base_nt.get(row, 0) == 0:
+            # Lazy capacity init, committed even on failure (bucket.go:194-196).
+            self.cap_base_nt[row] = rate.freq * NANO
+        req = TakeRequest(
+            rows=jnp.array([row], dtype=jnp.int32),
+            now_ns=jnp.array([now_ns], dtype=jnp.int64),
+            freq=jnp.array([rate.freq], dtype=jnp.int64),
+            per_ns=jnp.array([rate.per_ns], dtype=jnp.int64),
+            count_nt=jnp.array([n * NANO], dtype=jnp.int64),
+            nreq=jnp.array([nreq], dtype=jnp.int64),
+            cap_base_nt=jnp.array([self.cap_base_nt[row]], dtype=jnp.int64),
+            created_ns=jnp.array([self.created_ns[row]], dtype=jnp.int64),
+        )
+        self.state, res = take_batch(self.state, req, self.node_slot)
+        return res
+
+    def take_one(self, row: int, now_ns: int, rate: Rate, n: int):
+        res = self.take(row, now_ns, rate, n)
+        return remaining_for_request(
+            int(res.have_nt[0]), int(res.admitted[0]), n * NANO, 0
+        )
+
+
+class TestTakeKernelTable:
+    def test_take_table_matches_reference_scenario(self):
+        """The bucket_test.go:35-66 table, on device."""
+        h = DeviceHarness()
+        rate = Rate(freq=5, per_ns=NANO)
+        now = 0
+
+        for i in range(5):
+            remaining, ok = h.take_one(0, now, rate, 1)
+            assert ok
+            assert remaining == 4 - i
+
+        now += 100_000_000
+        remaining, ok = h.take_one(0, now, rate, 1)
+        assert not ok and remaining == 0
+
+        now += 100_000_000
+        remaining, ok = h.take_one(0, now, rate, 1)
+        assert ok and remaining == 0
+
+        now += 10 * NANO
+        remaining, ok = h.take_one(0, now, rate, 6)
+        assert not ok and remaining == 5
+
+        remaining, ok = h.take_one(0, now, rate, 5)
+        assert ok and remaining == 0
+
+    def test_zero_rate_rejects(self):
+        h = DeviceHarness()
+        remaining, ok = h.take_one(0, 0, Rate(), 1)
+        assert not ok and remaining == 0
+
+    def test_clock_rewind(self):
+        h = DeviceHarness()
+        rate = Rate(freq=5, per_ns=NANO)
+        h.take_one(0, 1000 * NANO, rate, 5)
+        remaining, ok = h.take_one(0, 500 * NANO, rate, 1)
+        assert not ok and remaining == 0
+
+
+class TestDifferentialVsOracle:
+    """Random op sequences: device kernel vs host oracle must agree exactly
+    (both quantize the float64 refill grant identically)."""
+
+    @given(
+        seed=st.integers(0, 2**32 - 1),
+        freq=st.integers(1, 1000),
+        per_ms=st.integers(1, 10_000),
+    )
+    @settings(max_examples=30, deadline=None)
+    def test_random_sequences(self, seed, freq, per_ms):
+        rng = random.Random(seed)
+        rate = Rate(freq=freq, per_ns=per_ms * 1_000_000)
+        h = DeviceHarness()
+        oracle = Bucket(name="b", created_ns=0)
+        # Oracle buckets are created at the first get (repo.go:205); harness
+        # stamps created at first take. Align them at t=0.
+        now = 0
+        h.created_ns[0] = 0
+
+        for _ in range(40):
+            now += rng.randrange(0, 2 * rate.per_ns)
+            n = rng.randrange(1, max(2, 2 * freq))
+            want = oracle.take(now, rate, n)
+            got = h.take_one(0, now, rate, n)
+            assert got == want, f"divergence at now={now} n={n}"
+
+    def test_varying_rates_same_bucket(self):
+        """Capacity base is pinned at first take; later takes with other
+        rates refill toward *their* capacity (bucket.go:192,211)."""
+        h = DeviceHarness()
+        oracle = Bucket(name="b", created_ns=0)
+        h.created_ns[0] = 0
+        r1 = Rate(freq=5, per_ns=NANO)
+        r2 = Rate(freq=100, per_ns=NANO)
+        seq = [(0, r1, 3), (NANO // 2, r2, 10), (NANO, r1, 1), (3 * NANO, r2, 50)]
+        for now, rate, n in seq:
+            assert h.take_one(0, now, rate, n) == oracle.take(now, rate, n)
+
+
+class TestCoalescedTakes:
+    """nreq-coalescing must equal the reference's sequential takes at the
+    same timestamp."""
+
+    @given(
+        freq=st.integers(1, 50),
+        n=st.integers(1, 5),
+        nreq=st.integers(1, 20),
+        prefill_ms=st.integers(0, 3000),
+    )
+    @settings(max_examples=50, deadline=None)
+    def test_equivalence(self, freq, n, nreq, prefill_ms):
+        rate = Rate(freq=freq, per_ns=NANO)
+        now = prefill_ms * 1_000_000
+
+        oracle = Bucket(name="b", created_ns=0)
+        oracle_results = [oracle.take(now, rate, n) for _ in range(nreq)]
+
+        h = DeviceHarness()
+        h.created_ns[0] = 0
+        res = h.take(0, now, rate, n, nreq=nreq)
+        got = [
+            remaining_for_request(int(res.have_nt[0]), int(res.admitted[0]), n * NANO, i)
+            for i in range(nreq)
+        ]
+        assert got == oracle_results
+
+
+class TestMergeKernels:
+    def _rand_batch(self, rng, K, B, N):
+        return MergeBatch(
+            rows=jnp.array([rng.randrange(B) for _ in range(K)], dtype=jnp.int32),
+            slots=jnp.array([rng.randrange(N) for _ in range(K)], dtype=jnp.int32),
+            added_nt=jnp.array([rng.randrange(10**12) for _ in range(K)], jnp.int64),
+            taken_nt=jnp.array([rng.randrange(10**12) for _ in range(K)], jnp.int64),
+            elapsed_ns=jnp.array([rng.randrange(10**12) for _ in range(K)], jnp.int64),
+        )
+
+    def test_merge_permutation_and_redelivery_invariance(self):
+        """CRDT laws over the batched kernel (≙ bucket_test.go:68-114):
+        any permutation, any batching, any duplication ⇒ identical state."""
+        rng = random.Random(7)
+        cfg = LimiterConfig(buckets=16, nodes=4)
+        deltas = self._rand_batch(rng, 64, cfg.buckets, cfg.nodes)
+
+        ref = merge_batch(init_state(cfg), deltas)
+
+        idx = list(range(64))
+        for _ in range(20):
+            rng.shuffle(idx)
+            state = init_state(cfg)
+            # Apply in shuffled order, split into ragged sub-batches, each
+            # delivered twice (duplication = UDP re-delivery).
+            pos = 0
+            while pos < len(idx):
+                size = rng.randrange(1, 16)
+                part = idx[pos : pos + size]
+                pos += size
+                sub = MergeBatch(*[jnp.asarray(a)[np.array(part)] for a in deltas])
+                state = merge_batch(state, sub)
+                state = merge_batch(state, sub)
+            assert (np.asarray(state.pn) == np.asarray(ref.pn)).all()
+            assert (np.asarray(state.elapsed) == np.asarray(ref.elapsed)).all()
+
+    def test_duplicate_rows_in_one_batch(self):
+        cfg = LimiterConfig(buckets=4, nodes=2)
+        state = init_state(cfg)
+        batch = MergeBatch(
+            rows=jnp.array([1, 1, 1], dtype=jnp.int32),
+            slots=jnp.array([0, 0, 0], dtype=jnp.int32),
+            added_nt=jnp.array([5, 9, 3], dtype=jnp.int64),
+            taken_nt=jnp.array([2, 1, 8], dtype=jnp.int64),
+            elapsed_ns=jnp.array([7, 7, 7], dtype=jnp.int64),
+        )
+        state = merge_batch(state, batch)
+        assert int(state.pn[1, 0, ADDED]) == 9
+        assert int(state.pn[1, 0, TAKEN]) == 8
+        assert int(state.elapsed[1]) == 7
+
+    def test_merge_dense_equals_scatter(self):
+        rng = random.Random(3)
+        cfg = LimiterConfig(buckets=8, nodes=4)
+        a = init_state(cfg)
+        deltas = self._rand_batch(rng, 32, cfg.buckets, cfg.nodes)
+        b = merge_batch(init_state(cfg), deltas)
+        joined = merge_dense(a, b)
+        assert (np.asarray(joined.pn) == np.asarray(b.pn)).all()
+        # Join with itself is idempotent.
+        again = merge_dense(joined, b)
+        assert (np.asarray(again.pn) == np.asarray(joined.pn)).all()
+
+    def test_merge_then_take_sees_remote_takes(self):
+        """Cross-node visibility: node 1's replicated takes reduce what node 0
+        can take (the PN sum, not the reference's lossy max)."""
+        h = DeviceHarness(nodes=4, node_slot=0)
+        rate = Rate(freq=10, per_ns=NANO)
+        # Remote node 1 reports 6 tokens taken.
+        batch = MergeBatch(
+            rows=jnp.array([0], dtype=jnp.int32),
+            slots=jnp.array([1], dtype=jnp.int32),
+            added_nt=jnp.array([0], dtype=jnp.int64),
+            taken_nt=jnp.array([6 * NANO], dtype=jnp.int64),
+            elapsed_ns=jnp.array([0], dtype=jnp.int64),
+        )
+        h.state = merge_batch(h.state, batch)
+        remaining, ok = h.take_one(0, 0, rate, 5)
+        assert not ok
+        assert remaining == 4  # 10 - 6
+        remaining, ok = h.take_one(0, 0, rate, 4)
+        assert ok and remaining == 0
+
+    def test_concurrent_takes_not_lost(self):
+        """The reference's known merge bug (SURVEY §2): two nodes each take 4
+        of 10 concurrently; scalar max-merge would drop one. PN lanes keep
+        both: merged balance is 10-8=2."""
+        cfg = LimiterConfig(buckets=4, nodes=4)
+        state = init_state(cfg)
+        batch = MergeBatch(
+            rows=jnp.array([0, 0], dtype=jnp.int32),
+            slots=jnp.array([1, 2], dtype=jnp.int32),
+            added_nt=jnp.array([0, 0], dtype=jnp.int64),
+            taken_nt=jnp.array([4 * NANO, 4 * NANO], dtype=jnp.int64),
+            elapsed_ns=jnp.array([0, 0], dtype=jnp.int64),
+        )
+        state = merge_batch(state, batch)
+        total_taken = int(state.pn[0, :, TAKEN].sum())
+        assert total_taken == 8 * NANO
+
+    def test_read_rows(self):
+        cfg = LimiterConfig(buckets=8, nodes=2)
+        state = init_state(cfg)
+        batch = MergeBatch(
+            rows=jnp.array([3], dtype=jnp.int32),
+            slots=jnp.array([1], dtype=jnp.int32),
+            added_nt=jnp.array([11], dtype=jnp.int64),
+            taken_nt=jnp.array([5], dtype=jnp.int64),
+            elapsed_ns=jnp.array([2], dtype=jnp.int64),
+        )
+        state = merge_batch(state, batch)
+        rs = read_rows(state, jnp.array([3, 0], dtype=jnp.int32))
+        assert int(rs.pn[0, 1, ADDED]) == 11
+        assert int(rs.elapsed[0]) == 2
+        assert int(rs.pn[1].sum()) == 0
+
+
+class TestPaddingInvariant:
+    def test_padding_rows_are_noops(self):
+        """A padded take batch (nreq=0 pointing at a live row) must not
+        disturb that row."""
+        h = DeviceHarness()
+        rate = Rate(freq=5, per_ns=NANO)
+        h.take_one(0, 0, rate, 2)
+        before = np.asarray(h.state.pn).copy()
+
+        req = TakeRequest(
+            rows=jnp.zeros(8, dtype=jnp.int32),
+            now_ns=jnp.full(8, 10 * NANO, dtype=jnp.int64),
+            freq=jnp.full(8, 5, dtype=jnp.int64),
+            per_ns=jnp.full(8, NANO, dtype=jnp.int64),
+            count_nt=jnp.zeros(8, dtype=jnp.int64),
+            nreq=jnp.zeros(8, dtype=jnp.int64),
+            cap_base_nt=jnp.full(8, 5 * NANO, dtype=jnp.int64),
+            created_ns=jnp.zeros(8, dtype=jnp.int64),
+        )
+        h.state, res = take_batch(h.state, req, 0)
+        assert (np.asarray(h.state.pn) == before).all()
+        assert int(res.admitted.sum()) == 0

@@ -130,6 +130,40 @@ class TestLimits:
         assert (name, added, taken, elapsed) == ("bkt", 3.0, 1.0, 9)
 
 
+class TestHostilePackets:
+    @pytest.mark.parametrize(
+        "added,want_nt",
+        [
+            (float("nan"), 0),
+            (float("inf"), 2**63 - 1),
+            (float("-inf"), 0),
+            (-1.5, 0),
+            (1e300, 2**63 - 1),
+            (1.0, wire.NANO),
+        ],
+    )
+    def test_nonfinite_and_huge_values_sanitized(self, added, want_nt):
+        """Attacker-controlled float64s must clamp, not crash, at the
+        int64 conversion boundary."""
+        data = struct.pack(">ddQB", added, added, 0, 1) + b"k"
+        st = decode(data)
+        assert st.added_nt == want_nt
+        assert st.taken_nt == want_nt
+
+    def test_raw_byte_names_roundtrip(self):
+        """Reference names are raw bytes (bucket.go:64-88); non-UTF8 bytes
+        must round-trip exactly (surrogateescape), or distinct buckets
+        would collapse and fork CRDT state."""
+        raw = bytes([0xFF, 0x2A])
+        data = struct.pack(">ddQB", 1.0, 0.0, 0, len(raw)) + raw
+        st = decode(data)
+        out = encode(st)
+        assert out[25 : 25 + len(raw)] == raw
+        # And a *different* raw name stays different.
+        data2 = struct.pack(">ddQB", 1.0, 0.0, 0, 2) + bytes([0xFE, 0x2A])
+        assert decode(data2).name != st.name
+
+
 class TestNanotokenBoundary:
     def test_from_nanotokens(self):
         s = from_nanotokens("k", 5 * wire.NANO, wire.NANO // 2, 3, origin_slot=1)
