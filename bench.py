@@ -1,0 +1,121 @@
+"""Benchmark: CvRDT merge + take throughput on the current JAX device.
+
+North-star metric (BASELINE.json): bucket-merges/sec at 1M buckets × 256
+node lanes; target ≥ 50M/s on v5e-4 (this harness runs on ONE chip).
+The reference publishes no numbers (BASELINE.md): the Go design's merge
+ingest is a single-threaded one-packet-per-iteration loop (repo.go:54-92);
+the TPU design replaces it with dense/batched joins.
+
+Three measurements:
+  * dense anti-entropy sweep   — merge_dense over the full state
+    (partition-heal / BASELINE config #5 class), counted as one bucket-merge
+    per bucket row per sweep;
+  * scatter microbatch merge   — merge_batch of K random deltas (the UDP
+    ingest path, BASELINE config #3 class), counted per delta;
+  * fused take step            — the HTTP hot path's device portion.
+
+Prints ONE JSON line: the headline is dense bucket-merges/sec;
+vs_baseline is the ratio against the 50M/s v5e-4 target.
+"""
+
+import json
+import os
+import time
+
+
+def _bench(fn, state, *args, iters=10, warmup=3):
+    import jax
+
+    for _ in range(warmup):
+        state = fn(state, *args)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = fn(state, *args)
+    jax.block_until_ready(state)
+    return (time.perf_counter() - t0) / iters, state
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import patrol_tpu  # noqa: F401  (x64)
+    from patrol_tpu.models.limiter import LimiterConfig, LimiterState, NANO, init_state
+    from patrol_tpu.ops.merge import MergeBatch, merge_batch, merge_dense
+    from patrol_tpu.ops.take import TakeRequest, take_batch
+
+    platform = jax.default_backend()
+    on_accel = platform not in ("cpu",)
+    B = int(os.environ.get("PATROL_BENCH_BUCKETS", 1_000_000 if on_accel else 65_536))
+    N = int(os.environ.get("PATROL_BENCH_NODES", 256 if on_accel else 32))
+    cfg = LimiterConfig(buckets=B, nodes=N)
+
+    key = jax.random.PRNGKey(0)
+
+    def mk_state(k):
+        pn = jax.random.randint(k, (B, N, 2), 0, 10 * NANO, dtype=jnp.int64)
+        elapsed = jax.random.randint(k, (B,), 0, 100 * NANO, dtype=jnp.int64)
+        return LimiterState(pn=pn, elapsed=elapsed)
+
+    k1, k2, k3 = jax.random.split(key, 3)
+
+    # -- dense anti-entropy sweep ------------------------------------------
+    dense = jax.jit(merge_dense, donate_argnums=0)
+    state = mk_state(k1)
+    other = mk_state(k2)
+    dt_dense, state = _bench(dense, state, other, iters=10)
+    dense_merges_per_s = B / dt_dense
+
+    # -- scatter microbatch merge ------------------------------------------
+    K = 131_072
+    deltas = MergeBatch(
+        rows=jax.random.randint(k3, (K,), 0, B, dtype=jnp.int32),
+        slots=jax.random.randint(k3, (K,), 0, N, dtype=jnp.int32),
+        added_nt=jax.random.randint(k3, (K,), 0, 10 * NANO, dtype=jnp.int64),
+        taken_nt=jax.random.randint(k3, (K,), 0, 10 * NANO, dtype=jnp.int64),
+        elapsed_ns=jax.random.randint(k3, (K,), 0, 100 * NANO, dtype=jnp.int64),
+    )
+    scatter = jax.jit(merge_batch, donate_argnums=0)
+    dt_scatter, state = _bench(scatter, state, deltas, iters=10)
+    scatter_merges_per_s = K / dt_scatter
+
+    # -- fused take step ----------------------------------------------------
+    KT = 4096
+    reqs = TakeRequest(
+        rows=(jnp.arange(KT, dtype=jnp.int32) * 2654435761 % B).astype(jnp.int32),
+        now_ns=jnp.full((KT,), 1000 * NANO, jnp.int64),
+        freq=jnp.full((KT,), 100, jnp.int64),
+        per_ns=jnp.full((KT,), NANO, jnp.int64),
+        count_nt=jnp.full((KT,), NANO, jnp.int64),
+        nreq=jnp.full((KT,), 4, jnp.int64),
+        cap_base_nt=jnp.full((KT,), 100 * NANO, jnp.int64),
+        created_ns=jnp.zeros((KT,), jnp.int64),
+    )
+
+    take = jax.jit(
+        lambda s, r: take_batch(s, r, 0)[0], donate_argnums=0
+    )
+    dt_take, state = _bench(take, state, reqs, iters=10)
+    takes_per_s = KT * 4 / dt_take  # nreq=4 coalesced requests per row
+
+    target = 50e6  # BASELINE.json: ≥50M bucket-merges/sec on v5e-4
+    out = {
+        "metric": "bucket-merges/sec (dense CvRDT sweep, 1 chip)",
+        "value": round(dense_merges_per_s),
+        "unit": "merges/s",
+        "vs_baseline": round(dense_merges_per_s / target, 3),
+        "platform": platform,
+        "buckets": B,
+        "node_lanes": N,
+        "dense_sweep_ms": round(dt_dense * 1e3, 3),
+        "scatter_merges_per_s": round(scatter_merges_per_s),
+        "scatter_batch": K,
+        "take_requests_per_s": round(takes_per_s),
+        "take_step_us": round(dt_take * 1e6, 1),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

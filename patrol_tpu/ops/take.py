@@ -118,8 +118,16 @@ def take_batch(
     k = jnp.where(req.count_nt > 0, k, 0)
     success = k >= 1
 
-    d_added = jnp.where(success, grant_nt, i64(0))
-    d_taken = jnp.where(success, k * req.count_nt, i64(0))
+    # Over-capacity forfeit, monotone form: the reference commits a NEGATIVE
+    # grant when merges pushed tokens above capacity (bucket.go:211-213),
+    # which would make the added-lane non-monotone — and any max-based join
+    # (UDP merge or pmax convergence) would resurrect the forfeited tokens
+    # (the reference's own protocol has exactly that quirk). Booking the
+    # forfeit as extra TAKEN keeps both lanes monotone G-counters with the
+    # same observable balance: a − t is unchanged.
+    forfeit = jnp.maximum(-grant_nt, i64(0))
+    d_added = jnp.where(success, jnp.maximum(grant_nt, i64(0)), i64(0))
+    d_taken = jnp.where(success, k * req.count_nt + forfeit, i64(0))
     d_elapsed = jnp.where(success, delta, i64(0))
 
     # Padding rows (nreq == 0) contribute zero deltas, so duplicate indices

@@ -1,0 +1,1 @@
+"""Multi-device scale-out: mesh topologies, sharded state, ICI convergence."""

@@ -1,0 +1,227 @@
+"""Mesh scale-out: the TPU-native replacement for scaling by adding UDP
+peers (SURVEY §2 "Parallelism & distribution strategies").
+
+The reference has exactly two scaling axes (SURVEY §5): bucket cardinality
+and node count. They map onto a 2-D ``jax.sharding.Mesh``:
+
+* axis ``"b"`` — **bucket sharding**: the bucket dimension of
+  ``pn[B, N, 2]`` / ``elapsed[B]`` is partitioned across devices; takes and
+  merges for a bucket run only on the shard that owns its rows (host
+  routing, no cross-device traffic on the hot path).
+* axis ``"r"`` — **replication**: full state replicas that each ingest a
+  partition of the incoming take/merge stream and converge with one
+  ``lax.pmax`` per step. This is Patrol's UDP broadcast re-expressed as an
+  ICI collective — the 256-byte-datagram protocol (repo.go:123-158) becomes
+  an elementwise int64 max across the mesh, five orders of magnitude more
+  bandwidth.
+
+Correctness of pmax-convergence relies on two invariants:
+
+1. All CRDT planes are monotone (PN lanes and the elapsed G-counter only
+   grow), so elementwise max is a join and convergence is exact.
+2. Each bucket row has one *home replica* (``row % R``) that applies its
+   takes; other replicas receive the result via pmax. Two replicas
+   incrementing the same lane concurrently would race exactly like the
+   reference's lossy scalar merge (SURVEY §2, known bug) — home routing
+   makes the write single-writer per lane while reads/merges stay
+   everywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from patrol_tpu.models.limiter import LimiterConfig, LimiterState, init_state
+from patrol_tpu.ops.merge import MergeBatch, merge_batch
+from patrol_tpu.ops.take import TakeRequest, TakeResult, take_batch
+
+REPLICA_AXIS = "r"
+BUCKET_AXIS = "b"
+
+
+def make_mesh(replicas: int = 1, devices=None) -> Mesh:
+    """A (replicas × shards) mesh over the available devices. ``replicas``
+    must divide the device count; the remainder becomes the bucket axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % replicas:
+        raise ValueError(f"{replicas} replicas do not divide {n} devices")
+    grid = np.array(devices).reshape(replicas, n // replicas)
+    return Mesh(grid, (REPLICA_AXIS, BUCKET_AXIS))
+
+
+# State: bucket axis sharded over "b", replicated over "r".
+STATE_SPEC = LimiterState(pn=P(BUCKET_AXIS, None, None), elapsed=P(BUCKET_AXIS))
+# Request/delta batches: leading dim laid out as (replica-major, shard-minor)
+# blocks, partitioned over both axes.
+BATCH_SPEC = P((REPLICA_AXIS, BUCKET_AXIS))
+
+
+def state_sharding(mesh: Mesh) -> LimiterState:
+    return LimiterState(
+        pn=NamedSharding(mesh, STATE_SPEC.pn),
+        elapsed=NamedSharding(mesh, STATE_SPEC.elapsed),
+    )
+
+
+def place_state(state: LimiterState, mesh: Mesh) -> LimiterState:
+    """Shard an existing state onto the mesh (bucket rows split across
+    ``"b"``, replicated across ``"r"``)."""
+    sh = state_sharding(mesh)
+    return LimiterState(
+        pn=jax.device_put(state.pn, sh.pn),
+        elapsed=jax.device_put(state.elapsed, sh.elapsed),
+    )
+
+
+def init_sharded_state(config: LimiterConfig, mesh: Mesh) -> LimiterState:
+    sh = state_sharding(mesh)
+    return LimiterState(
+        pn=jnp.zeros((config.buckets, config.nodes, 2), jnp.int64, device=sh.pn),
+        elapsed=jnp.zeros((config.buckets,), jnp.int64, device=sh.elapsed),
+    )
+
+
+def converge(state: LimiterState) -> LimiterState:
+    """Cross-replica CvRDT join over ICI — the collective that replaces the
+    reference's per-take UDP fan-out (repo.go:129-158)."""
+    return LimiterState(
+        pn=jax.lax.pmax(state.pn, REPLICA_AXIS),
+        elapsed=jax.lax.pmax(state.elapsed, REPLICA_AXIS),
+    )
+
+
+def cluster_step(
+    state: LimiterState,
+    deltas: MergeBatch,
+    reqs: TakeRequest,
+    node_slot: int,
+) -> Tuple[LimiterState, TakeResult]:
+    """One SPMD update step, per (replica, shard) block: merge this block's
+    replication deltas, apply this block's takes, converge replicas.
+
+    Rows in ``reqs``/``deltas`` are SHARD-LOCAL indices; the host router
+    (:func:`route_requests`) guarantees each take sits in its home
+    (replica, shard) block and every other block carries padding."""
+    state = merge_batch(state, deltas)
+    state, res = take_batch(state, reqs, node_slot)
+    state = converge(state)
+    return state, res
+
+
+def build_cluster_step(mesh: Mesh, node_slot: int):
+    """jit(shard_map(cluster_step)) over the mesh, with donated state."""
+    fn = jax.shard_map(
+        partial(cluster_step, node_slot=node_slot),
+        mesh=mesh,
+        in_specs=(
+            STATE_SPEC,
+            MergeBatch(*(BATCH_SPEC,) * 5),
+            TakeRequest(*(BATCH_SPEC,) * 8),
+        ),
+        out_specs=(STATE_SPEC, TakeResult(*(BATCH_SPEC,) * 5)),
+    )
+    return jax.jit(fn, donate_argnums=0)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Host-side routing geometry for a mesh deployment."""
+
+    replicas: int
+    shards: int
+    rows_per_shard: int
+
+    @property
+    def blocks(self) -> int:
+        return self.replicas * self.shards
+
+    def locate(self, global_row: int) -> Tuple[int, int, int]:
+        """→ (home_replica, shard, local_row) for a bucket row."""
+        shard, local_row = divmod(global_row, self.rows_per_shard)
+        return global_row % self.replicas, shard, local_row
+
+    def block_index(self, replica: int, shard: int) -> int:
+        return replica * self.shards + shard
+
+
+def plan_for(mesh: Mesh, config: LimiterConfig) -> MeshPlan:
+    shards = mesh.shape[BUCKET_AXIS]
+    if config.buckets % shards:
+        raise ValueError(f"{shards} shards do not divide {config.buckets} buckets")
+    return MeshPlan(
+        replicas=mesh.shape[REPLICA_AXIS],
+        shards=shards,
+        rows_per_shard=config.buckets // shards,
+    )
+
+
+def route_requests(
+    plan: MeshPlan,
+    takes,  # sequence of (global_row, now_ns, freq, per_ns, count_nt, nreq, cap_base_nt, created_ns)
+    deltas,  # sequence of (global_row, slot, added_nt, taken_nt, elapsed_ns)
+    k_take: int,
+    k_merge: int,
+    deltas_to_home: bool = False,
+) -> Tuple[TakeRequest, MergeBatch]:
+    """Pack host requests into the (replica-major, shard-minor) block layout
+    consumed by :func:`build_cluster_step`. Each take lands in its home
+    block; deltas spread round-robin over replicas (merges are idempotent,
+    any replica may ingest them) unless ``deltas_to_home`` — then a delta
+    lands on its row's home replica, making it visible to same-step takes
+    (useful for deterministic tests and lowest staleness). Overflowing a
+    block raises — the caller batches accordingly."""
+    B = plan.blocks
+    t = {name: np.zeros((B * k_take,), dtype=np.int64) for name in TakeRequest._fields}
+    t["rows"] = np.zeros((B * k_take,), dtype=np.int32)
+    d = {name: np.zeros((B * k_merge,), dtype=np.int64) for name in MergeBatch._fields}
+    d["rows"] = np.zeros((B * k_merge,), dtype=np.int32)
+    d["slots"] = np.zeros((B * k_merge,), dtype=np.int32)
+
+    fill_t = [0] * B
+    for row, now_ns, freq, per_ns, count_nt, nreq, cap_base_nt, created_ns in takes:
+        replica, shard, local = plan.locate(row)
+        blk = plan.block_index(replica, shard)
+        i = fill_t[blk]
+        if i >= k_take:
+            raise ValueError(f"take block {blk} overflow (k_take={k_take})")
+        at = blk * k_take + i
+        t["rows"][at] = local
+        t["now_ns"][at] = now_ns
+        t["freq"][at] = freq
+        t["per_ns"][at] = per_ns
+        t["count_nt"][at] = count_nt
+        t["nreq"][at] = nreq
+        t["cap_base_nt"][at] = cap_base_nt
+        t["created_ns"][at] = created_ns
+        fill_t[blk] += 1
+
+    fill_d = [0] * B
+    rr = 0
+    for row, slot, added_nt, taken_nt, elapsed_ns in deltas:
+        shard, local = divmod(row, plan.rows_per_shard)
+        replica = row % plan.replicas if deltas_to_home else rr % plan.replicas
+        rr += 1
+        blk = plan.block_index(replica, shard)
+        i = fill_d[blk]
+        if i >= k_merge:
+            raise ValueError(f"merge block {blk} overflow (k_merge={k_merge})")
+        at = blk * k_merge + i
+        d["rows"][at] = local
+        d["slots"][at] = slot
+        d["added_nt"][at] = max(added_nt, 0)
+        d["taken_nt"][at] = max(taken_nt, 0)
+        d["elapsed_ns"][at] = max(elapsed_ns, 0)
+        fill_d[blk] += 1
+
+    return (
+        TakeRequest(**{k: jnp.asarray(v) for k, v in t.items()}),
+        MergeBatch(**{k: jnp.asarray(v) for k, v in d.items()}),
+    )

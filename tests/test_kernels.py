@@ -284,6 +284,33 @@ class TestMergeKernels:
         assert int(rs.pn[1].sum()) == 0
 
 
+class TestMonotoneForfeit:
+    def test_lanes_stay_monotone_under_forfeit(self):
+        """Over-capacity forfeit must not decrease any lane: a max-join
+        (UDP merge or pmax) would otherwise resurrect forfeited tokens.
+        The observable balance still matches the reference: cap after the
+        take, minus what was taken."""
+        h = DeviceHarness()
+        rate = Rate(freq=5, per_ns=NANO)
+        # Merge in 50 added tokens from a remote node: way over capacity 5.
+        batch = MergeBatch(
+            rows=jnp.array([0], dtype=jnp.int32),
+            slots=jnp.array([1], dtype=jnp.int32),
+            added_nt=jnp.array([50 * NANO], dtype=jnp.int64),
+            taken_nt=jnp.array([0], dtype=jnp.int64),
+            elapsed_ns=jnp.array([0], dtype=jnp.int64),
+        )
+        h.state = merge_batch(h.state, batch)
+        before = np.asarray(h.state.pn).copy()
+        remaining, ok = h.take_one(0, 0, rate, 1)
+        assert ok and remaining == 4  # excess forfeited, like the reference
+        after = np.asarray(h.state.pn)
+        assert (after >= before).all(), "a lane decreased: join would resurrect it"
+        # Re-merging the same remote state (UDP re-delivery) changes nothing.
+        h.state = merge_batch(h.state, batch)
+        assert (np.asarray(h.state.pn) == after).all()
+
+
 class TestPaddingInvariant:
     def test_padding_rows_are_noops(self):
         """A padded take batch (nreq=0 pointing at a live row) must not
