@@ -55,6 +55,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--node-lanes", type=int, default=64, help="PN lanes (max cluster size)")
     p.add_argument("--platform", default=None, help="JAX platform override (tpu|cpu)")
     p.add_argument(
+        "--udp-backend",
+        choices=["auto", "native", "asyncio"],
+        default="auto",
+        help="replication transport: C++ sendmmsg/recvmmsg or asyncio",
+    )
+    p.add_argument(
         "--shutdown-timeout",
         default="30s",
         help="graceful shutdown timeout, Go duration syntax",
@@ -94,6 +100,7 @@ def main(argv=None) -> int:
         shutdown_timeout_s=shutdown_ns / 1e9,
         config=LimiterConfig(buckets=args.buckets, nodes=args.node_lanes),
         log=log,
+        udp_backend=args.udp_backend,
     )
     try:
         asyncio.run(cmd.run())

@@ -37,6 +37,9 @@ class Command:
     config: LimiterConfig = SMALL
     log: Optional[logging.Logger] = None
     handle_signals: bool = True
+    # "native" = C++ recvmmsg/sendmmsg path, "asyncio" = pure python,
+    # "auto" = native when the toolchain built it, else asyncio.
+    udp_backend: str = "auto"
 
     # Populated by run() for tests/introspection.
     engine: Optional[DeviceEngine] = None
@@ -56,9 +59,20 @@ class Command:
             self.node_addr, self.peer_addrs, max_slots=self.config.nodes
         )
         engine = DeviceEngine(self.config, node_slot=slots.self_slot, clock=self.clock)
-        replicator = await Replicator.create(
-            self.node_addr, self.peer_addrs, slots, log=log
+
+        from patrol_tpu.net import native_replication
+
+        use_native = self.udp_backend == "native" or (
+            self.udp_backend == "auto" and native_replication.available()
         )
+        if use_native:
+            replicator = native_replication.NativeReplicator(
+                self.node_addr, self.peer_addrs, slots, log_=log
+            )
+        else:
+            replicator = await Replicator.create(
+                self.node_addr, self.peer_addrs, slots, log=log
+            )
         repo = TPURepo(engine, send_incast=replicator.send_incast_request)
         replicator.repo = repo
         engine.on_broadcast = replicator.broadcast_states

@@ -1,0 +1,203 @@
+"""ctypes bindings for the native host network path (patrol_host.cpp).
+
+Builds ``libpatrolhost.so`` with g++ on first use (cached beside the
+source; no pybind11 in this environment — plain C ABI + ctypes + numpy).
+Falls back gracefully: :func:`load` returns None when no compiler is
+available, and callers use the pure-Python asyncio path instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+log = logging.getLogger("patrol.native")
+
+PACKET = 256
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "patrol_host.cpp")
+_LIB = os.path.join(_HERE, "libpatrolhost.so")
+
+_mu = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+_u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return True
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError) as exc:
+        log.warning("native build failed, using pure-python path: %s", exc)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build-if-needed and load the native library; None on failure."""
+    global _lib, _load_failed
+    with _mu:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        if not _build():
+            _load_failed = True
+            return None
+        lib = ctypes.CDLL(_LIB)
+        lib.pt_udp_open.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+        lib.pt_udp_open.restype = ctypes.c_int
+        lib.pt_udp_port.argtypes = [ctypes.c_int]
+        lib.pt_udp_port.restype = ctypes.c_int
+        lib.pt_udp_close.argtypes = [ctypes.c_int]
+        lib.pt_recv_batch.argtypes = [
+            ctypes.c_int, _u8p, ctypes.c_int, _i32p, _u32p, _u16p, ctypes.c_int,
+        ]
+        lib.pt_recv_batch.restype = ctypes.c_int
+        lib.pt_send_fanout.argtypes = [
+            ctypes.c_int, _u8p, _i32p, ctypes.c_int, _u32p, _u16p, ctypes.c_int,
+        ]
+        lib.pt_send_fanout.restype = ctypes.c_int
+        lib.pt_decode_batch.argtypes = [
+            _u8p, _i32p, ctypes.c_int, _f64p, _f64p, _u64p, _u8p, _i32p, _i32p,
+        ]
+        lib.pt_decode_batch.restype = ctypes.c_int
+        lib.pt_encode_batch.argtypes = [
+            _f64p, _f64p, _u64p, _u8p, _i32p, _i32p, ctypes.c_int, _u8p, _i32p,
+        ]
+        lib.pt_encode_batch.restype = ctypes.c_int
+        _lib = lib
+        return lib
+
+
+class NativeSocket:
+    """One UDP socket, native recv/send batch ops, numpy in/out."""
+
+    def __init__(self, ip: str, port: int, max_batch: int = 512):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self.lib = lib
+        self.fd = lib.pt_udp_open(ip.encode(), port)
+        if self.fd < 0:
+            raise OSError(-self.fd, os.strerror(-self.fd))
+        self.max_batch = max_batch
+        self._rx_buf = np.zeros((max_batch, PACKET), np.uint8)
+        self._rx_sizes = np.zeros(max_batch, np.int32)
+        self._rx_ips = np.zeros(max_batch, np.uint32)
+        self._rx_ports = np.zeros(max_batch, np.uint16)
+
+    @property
+    def port(self) -> int:
+        return self.lib.pt_udp_port(self.fd)
+
+    def recv_batch(self, timeout_ms: int = 100):
+        """→ (packets[n,256] uint8 view, sizes[n], src_ips[n], src_ports[n])."""
+        n = self.lib.pt_recv_batch(
+            self.fd, self._rx_buf, self.max_batch, self._rx_sizes,
+            self._rx_ips, self._rx_ports, timeout_ms,
+        )
+        if n < 0:
+            raise OSError(-n, os.strerror(-n))
+        return (
+            self._rx_buf[:n],
+            self._rx_sizes[:n],
+            self._rx_ips[:n],
+            self._rx_ports[:n],
+        )
+
+    def send_fanout(self, payloads: np.ndarray, sizes: np.ndarray,
+                    peer_ips: np.ndarray, peer_ports: np.ndarray) -> int:
+        if len(payloads) == 0 or len(peer_ips) == 0:
+            return 0
+        n = self.lib.pt_send_fanout(
+            self.fd,
+            np.ascontiguousarray(payloads, np.uint8),
+            np.ascontiguousarray(sizes, np.int32),
+            len(payloads),
+            np.ascontiguousarray(peer_ips, np.uint32),
+            np.ascontiguousarray(peer_ports, np.uint16),
+            len(peer_ips),
+        )
+        if n < 0:
+            raise OSError(-n, os.strerror(-n))
+        return n
+
+    def close(self) -> None:
+        self.lib.pt_udp_close(self.fd)
+
+
+def decode_batch(packets: np.ndarray, sizes: np.ndarray):
+    """Vectorized wire decode → (added[f64], taken[f64], elapsed[i64],
+    names[list[str]], origin_slots[i32], valid[bool])."""
+    lib = load()
+    n = len(packets)
+    added = np.zeros(n, np.float64)
+    taken = np.zeros(n, np.float64)
+    elapsed = np.zeros(n, np.uint64)
+    names = np.zeros((n, PACKET), np.uint8)
+    name_lens = np.zeros(n, np.int32)
+    slots = np.zeros(n, np.int32)
+    lib.pt_decode_batch(
+        np.ascontiguousarray(packets, np.uint8),
+        np.ascontiguousarray(sizes, np.int32),
+        n, added, taken, elapsed, names, name_lens, slots,
+    )
+    valid = name_lens >= 0
+    out_names: List[str] = [
+        bytes(names[i, : name_lens[i]]).decode("utf-8", "surrogateescape")
+        if valid[i]
+        else ""
+        for i in range(n)
+    ]
+    return added, taken, elapsed.astype(np.int64), out_names, slots, valid
+
+
+def encode_batch(
+    added: Sequence[float],
+    taken: Sequence[float],
+    elapsed_ns: Sequence[int],
+    names: Sequence[str],
+    origin_slots: Sequence[int],
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized wire encode → (packets[n,256], sizes[n]); size -1 marks a
+    state whose name was too large (caller decides; see replication)."""
+    lib = load()
+    n = len(names)
+    name_buf = np.zeros((n, PACKET), np.uint8)
+    name_lens = np.zeros(n, np.int32)
+    for i, name in enumerate(names):
+        raw = name.encode("utf-8", "surrogateescape")
+        name_lens[i] = len(raw)
+        if len(raw) <= PACKET:
+            name_buf[i, : len(raw)] = np.frombuffer(raw, np.uint8)
+    out = np.zeros((n, PACKET), np.uint8)
+    out_sizes = np.zeros(n, np.int32)
+    lib.pt_encode_batch(
+        np.ascontiguousarray(np.asarray(added, np.float64)),
+        np.ascontiguousarray(np.asarray(taken, np.float64)),
+        np.ascontiguousarray(np.asarray(elapsed_ns, np.int64).view(np.uint64)),
+        name_buf, name_lens,
+        np.ascontiguousarray(np.asarray(origin_slots, np.int32)),
+        n, out, out_sizes,
+    )
+    return out, out_sizes

@@ -1,0 +1,277 @@
+// patrol_host: native host network path for patrol_tpu.
+//
+// The reference's replication plane is compiled Go: goroutine-per-peer UDP
+// fan-out (repo.go:129-158) and a single-packet-per-syscall receive loop
+// (repo.go:108-120). This library is the C++ equivalent, shaped for the
+// microbatching TPU runtime instead of goroutines:
+//
+//   * pt_recv_batch  — recvmmsg(): up to N datagrams per syscall, with a
+//                      poll() timeout so the loop stays cancellable (the
+//                      3s read-deadline idea of repo.go:109).
+//   * pt_send_fanout — sendmmsg(): one syscall flushes a whole broadcast
+//                      matrix (payloads × peers).
+//   * pt_decode_batch / pt_encode_batch — the 25-byte-header wire codec
+//                      (bucket.go:34-91) + the v2 origin-slot trailer,
+//                      vectorized over packet batches into flat arrays that
+//                      map 1:1 onto numpy buffers.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this environment).
+// Build: g++ -O2 -shared -fPIC -o libpatrolhost.so patrol_host.cpp
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr int kPacketSize = 256;
+constexpr int kFixedSize = 25;
+constexpr int kTrailerSize = 6;
+constexpr int kMaxBatch = 1024;
+
+inline uint64_t load_be64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+#if __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
+  v = __builtin_bswap64(v);
+#endif
+  return v;
+}
+
+inline void store_be64(uint8_t* p, uint64_t v) {
+#if __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
+  v = __builtin_bswap64(v);
+#endif
+  std::memcpy(p, &v, 8);
+}
+
+inline double bits_to_double(uint64_t b) {
+  double d;
+  std::memcpy(&d, &b, 8);
+  return d;
+}
+
+inline uint64_t double_to_bits(double d) {
+  uint64_t b;
+  std::memcpy(&b, &d, 8);
+  return b;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- sockets
+
+// Open a nonblocking UDP socket bound to ip:port. Returns fd or -errno.
+int pt_udp_open(const char* ip, uint16_t port) {
+  int fd = socket(AF_INET, SOCK_DGRAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  int buf = 4 << 20;  // fat socket buffers: bursty broadcast matrices
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) {
+    close(fd);
+    return -EINVAL;
+  }
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  return fd;
+}
+
+// Local bound port (for port-0 binds in tests).
+int pt_udp_port(int fd) {
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) < 0) return -errno;
+  return ntohs(addr.sin_port);
+}
+
+void pt_udp_close(int fd) { close(fd); }
+
+// Receive up to max_packets datagrams (≤256B each) in one recvmmsg sweep.
+// buf: max_packets*256 bytes; sizes/src_ips/src_ports: per-packet outputs.
+// Waits up to timeout_ms for the first datagram. Returns n ≥ 0 or -errno.
+int pt_recv_batch(int fd, uint8_t* buf, int max_packets, int* sizes,
+                  uint32_t* src_ips, uint16_t* src_ports, int timeout_ms) {
+  if (max_packets > kMaxBatch) max_packets = kMaxBatch;
+  pollfd pfd{fd, POLLIN, 0};
+  int pr = poll(&pfd, 1, timeout_ms);
+  if (pr < 0) return -errno;
+  if (pr == 0) return 0;
+
+  mmsghdr msgs[kMaxBatch];
+  iovec iovs[kMaxBatch];
+  sockaddr_in addrs[kMaxBatch];
+  std::memset(msgs, 0, sizeof(mmsghdr) * max_packets);
+  for (int i = 0; i < max_packets; i++) {
+    iovs[i] = {buf + i * kPacketSize, kPacketSize};
+    msgs[i].msg_hdr.msg_iov = &iovs[i];
+    msgs[i].msg_hdr.msg_iovlen = 1;
+    msgs[i].msg_hdr.msg_name = &addrs[i];
+    msgs[i].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+  }
+  int n = recvmmsg(fd, msgs, max_packets, MSG_DONTWAIT, nullptr);
+  if (n < 0) return (errno == EAGAIN || errno == EWOULDBLOCK) ? 0 : -errno;
+  for (int i = 0; i < n; i++) {
+    sizes[i] = static_cast<int>(msgs[i].msg_len);
+    src_ips[i] = ntohl(addrs[i].sin_addr.s_addr);
+    src_ports[i] = ntohs(addrs[i].sin_port);
+  }
+  return n;
+}
+
+// Send every payload to every peer: n_payloads × n_peers datagrams, flushed
+// through sendmmsg in chunks. payloads: n_payloads*256B (sizes per payload).
+// Returns datagrams handed to the kernel, or -errno on hard failure.
+int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes,
+                   int n_payloads, const uint32_t* peer_ips,
+                   const uint16_t* peer_ports, int n_peers) {
+  mmsghdr msgs[kMaxBatch];
+  iovec iovs[kMaxBatch];
+  sockaddr_in addrs[kMaxBatch];
+  int queued = 0, sent_total = 0;
+
+  auto flush = [&]() -> int {
+    int off = 0;
+    while (off < queued) {
+      int n = sendmmsg(fd, msgs + off, queued - off, 0);
+      if (n < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          pollfd pfd{fd, POLLOUT, 0};
+          if (poll(&pfd, 1, 50) <= 0) break;  // give up after 50ms stall
+          continue;
+        }
+        return -errno;
+      }
+      off += n;
+      sent_total += n;
+    }
+    queued = 0;
+    return 0;
+  };
+
+  for (int p = 0; p < n_payloads; p++) {
+    for (int j = 0; j < n_peers; j++) {
+      if (queued == kMaxBatch) {
+        int rc = flush();
+        if (rc < 0) return rc;
+      }
+      int i = queued++;
+      std::memset(&msgs[i], 0, sizeof(mmsghdr));
+      iovs[i] = {const_cast<uint8_t*>(payloads) + p * kPacketSize,
+                 static_cast<size_t>(sizes[p])};
+      addrs[i] = sockaddr_in{};
+      addrs[i].sin_family = AF_INET;
+      addrs[i].sin_port = htons(peer_ports[j]);
+      addrs[i].sin_addr.s_addr = htonl(peer_ips[j]);
+      msgs[i].msg_hdr.msg_iov = &iovs[i];
+      msgs[i].msg_hdr.msg_iovlen = 1;
+      msgs[i].msg_hdr.msg_name = &addrs[i];
+      msgs[i].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+    }
+  }
+  int rc = flush();
+  if (rc < 0) return rc;
+  return sent_total;
+}
+
+// ------------------------------------------------------------------ codec
+
+// Decode n packets (each ≤256B at 256B stride). Outputs per packet:
+//   added/taken (float64 tokens), elapsed (uint64 ns, two's complement),
+//   name bytes copied into names at 256B stride with name_lens set,
+//   origin_slots (-1 when no valid v2 trailer). Malformed packets get
+//   name_lens[i] = -1. Returns count of valid packets.
+int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
+                    double* added, double* taken, uint64_t* elapsed,
+                    uint8_t* names, int* name_lens, int* origin_slots) {
+  int ok = 0;
+  for (int i = 0; i < n; i++) {
+    const uint8_t* p = packets + i * kPacketSize;
+    int sz = sizes[i];
+    origin_slots[i] = -1;
+    if (sz < kFixedSize) {
+      name_lens[i] = -1;
+      continue;
+    }
+    int nlen = p[24];
+    if (sz - kFixedSize < nlen) {
+      name_lens[i] = -1;
+      continue;
+    }
+    added[i] = bits_to_double(load_be64(p));
+    taken[i] = bits_to_double(load_be64(p + 8));
+    elapsed[i] = load_be64(p + 16);
+    std::memcpy(names + i * kPacketSize, p + kFixedSize, nlen);
+    name_lens[i] = nlen;
+    const uint8_t* tail = p + kFixedSize + nlen;
+    int tail_len = sz - kFixedSize - nlen;
+    if (tail_len >= kTrailerSize && tail[0] == 'P' && tail[1] == '2') {
+      uint8_t sum = 0;
+      for (int t = 0; t < kTrailerSize - 1; t++) sum += tail[t];
+      if (sum == tail[kTrailerSize - 1]) {
+        origin_slots[i] = (tail[3] << 8) | tail[4];
+      }
+    }
+    ok++;
+  }
+  return ok;
+}
+
+// Encode n states into packets at 256B stride. names at 256B stride with
+// name_lens; origin_slots ≥ 0 appends the v2 trailer (callers must keep
+// names ≤ 225 bytes then; ≤ 231 otherwise — oversize gets out_sizes[i] = -1).
+// Returns count encoded.
+int pt_encode_batch(const double* added, const double* taken,
+                    const uint64_t* elapsed, const uint8_t* names,
+                    const int* name_lens, const int* origin_slots, int n,
+                    uint8_t* out, int* out_sizes) {
+  int ok = 0;
+  for (int i = 0; i < n; i++) {
+    uint8_t* p = out + i * kPacketSize;
+    int nlen = name_lens[i];
+    bool with_trailer = origin_slots[i] >= 0;
+    int limit = kPacketSize - kFixedSize - (with_trailer ? kTrailerSize : 0);
+    if (nlen < 0 || nlen > limit) {
+      out_sizes[i] = -1;
+      continue;
+    }
+    store_be64(p, double_to_bits(added[i]));
+    store_be64(p + 8, double_to_bits(taken[i]));
+    store_be64(p + 16, elapsed[i]);
+    p[24] = static_cast<uint8_t>(nlen);
+    std::memcpy(p + kFixedSize, names + i * kPacketSize, nlen);
+    int sz = kFixedSize + nlen;
+    if (with_trailer) {
+      uint8_t* t = p + sz;
+      t[0] = 'P';
+      t[1] = '2';
+      t[2] = 0;  // flags
+      t[3] = static_cast<uint8_t>((origin_slots[i] >> 8) & 0xFF);
+      t[4] = static_cast<uint8_t>(origin_slots[i] & 0xFF);
+      t[5] = static_cast<uint8_t>(t[0] + t[1] + t[2] + t[3] + t[4]);
+      sz += kTrailerSize;
+    }
+    out_sizes[i] = sz;
+    ok++;
+  }
+  return ok;
+}
+
+}  // extern "C"

@@ -205,6 +205,32 @@ class DeviceEngine:
             self._cond.notify()
         return created
 
+    def ingest_deltas_batch(
+        self,
+        names: Sequence[str],
+        slots: Sequence[int],
+        added_nt: Sequence[int],
+        taken_nt: Sequence[int],
+        elapsed_ns: Sequence[int],
+    ) -> int:
+        """Bulk ingest from the native receive path: one directory pass, one
+        queue append, one wake-up. Returns deltas accepted."""
+        now = self.clock()
+        out = []
+        for i, name in enumerate(names):
+            slot = int(slots[i])
+            if not 0 <= slot < self.config.nodes:
+                continue
+            row, _ = self.directory.assign(name, now)
+            out.append(
+                _Delta(row, slot, int(added_nt[i]), int(taken_nt[i]), int(elapsed_ns[i]))
+            )
+        if out:
+            with self._cond:
+                self._deltas.extend(out)
+                self._cond.notify()
+        return len(out)
+
     def read_rows(self, rows) -> tuple:
         """Donation-safe gather of per-bucket state: returns (pn[K,N,2],
         elapsed[K]) as host numpy arrays."""

@@ -27,7 +27,7 @@ def free_port() -> int:
 class Cluster:
     """N full Command stacks sharing one background event loop."""
 
-    def __init__(self, n: int = 3):
+    def __init__(self, n: int = 3, udp_backend: str = "asyncio"):
         self.n = n
         self.api_ports = [free_port() for _ in range(n)]
         node_ports = [free_port() for _ in range(n)]
@@ -44,6 +44,7 @@ class Cluster:
                 shutdown_timeout_s=5.0,
                 config=LimiterConfig(buckets=128, nodes=4),
                 handle_signals=False,
+                udp_backend=udp_backend,
             )
             self.commands.append(cmd)
 
@@ -108,9 +109,20 @@ class KeepAliveClient:
         self.sock.close()
 
 
-@pytest.fixture(scope="module")
-def cluster():
-    c = Cluster(3)
+def _native_available() -> bool:
+    from patrol_tpu import native
+
+    return native.load() is not None
+
+
+@pytest.fixture(
+    scope="module",
+    params=["asyncio", pytest.param("native", marks=pytest.mark.skipif(
+        not _native_available(), reason="native toolchain unavailable"
+    ))],
+)
+def cluster(request):
+    c = Cluster(3, udp_backend=request.param)
     yield c
     c.close()
 
