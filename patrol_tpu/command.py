@@ -40,6 +40,11 @@ class Command:
     # "native" = C++ recvmmsg/sendmmsg path, "asyncio" = pure python,
     # "auto" = native when the toolchain built it, else asyncio.
     udp_backend: str = "auto"
+    # Checkpoint/resume (the reference has none, SURVEY §5): restore at
+    # boot when the directory holds a snapshot; save every interval (0 ⇒
+    # only at shutdown) and at graceful shutdown.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_interval_s: float = 0.0
 
     # Populated by run() for tests/introspection.
     engine: Optional[DeviceEngine] = None
@@ -76,6 +81,12 @@ class Command:
         repo = TPURepo(engine, send_incast=replicator.send_incast_request)
         replicator.repo = repo
         engine.on_broadcast = replicator.broadcast_states
+
+        from patrol_tpu.runtime import checkpoint as ckpt
+
+        if self.checkpoint_dir and ckpt.exists(self.checkpoint_dir):
+            n = ckpt.restore(self.checkpoint_dir, engine)
+            log.info("checkpoint restored", extra={"buckets": n, "dir": self.checkpoint_dir})
         log.debug(
             "peers",
             extra={
@@ -106,9 +117,32 @@ class Command:
                     loop.add_signal_handler(sig, stop.set)
 
         log.info("API serving", extra={"addr": self.api_addr})
+
+        ckpt_task = None
+        if self.checkpoint_dir and self.checkpoint_interval_s > 0:
+            loop = asyncio.get_running_loop()
+
+            async def _periodic_checkpoint():
+                while True:
+                    await asyncio.sleep(self.checkpoint_interval_s)
+                    try:
+                        await loop.run_in_executor(None, ckpt.save, self.checkpoint_dir, engine)
+                    except Exception:  # pragma: no cover
+                        log.exception("periodic checkpoint failed")
+
+            ckpt_task = asyncio.ensure_future(_periodic_checkpoint())
+
         try:
             await stop.wait()
         finally:
+            if ckpt_task is not None:
+                ckpt_task.cancel()
+            if self.checkpoint_dir:
+                try:
+                    ckpt.save(self.checkpoint_dir, engine)
+                    log.info("checkpoint saved", extra={"dir": self.checkpoint_dir})
+                except Exception:  # pragma: no cover
+                    log.exception("final checkpoint failed")
             log.info("shutting down")
             server.close()
             with contextlib.suppress(asyncio.TimeoutError):

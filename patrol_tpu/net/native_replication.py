@@ -66,6 +66,9 @@ class NativeReplicator:
         self.rx_packets = 0
         self.rx_errors = 0
         self.tx_packets = 0
+        # Fault injection: predicate (host, port)→bool; True drops traffic
+        # to/from that peer (partition simulation). Settable at runtime.
+        self.drop_addr = None
         self._stopped = threading.Event()
         self._rx_thread = threading.Thread(
             target=self._rx_loop, name="patrol-native-rx", daemon=True
@@ -94,6 +97,10 @@ class NativeReplicator:
             for i in range(n):
                 if not valid[i]:
                     self.rx_errors += 1
+                    continue
+                if self.drop_addr is not None and self.drop_addr(
+                    (_u32_to_ip(int(ips[i])), int(ports[i]))
+                ):
                     continue
                 if added[i] == 0 and taken[i] == 0 and elapsed[i] == 0:
                     # Incast request (repo.go:86-90).
@@ -134,6 +141,16 @@ class NativeReplicator:
 
     # -- send path ----------------------------------------------------------
 
+    def _live_peers(self):
+        if self.drop_addr is None:
+            return self._peer_ips, self._peer_ports
+        keep = [
+            i
+            for i, (h, p) in enumerate(self.peers)
+            if not self.drop_addr((h, p))
+        ]
+        return self._peer_ips[keep], self._peer_ports[keep]
+
     def broadcast_states(self, states: Sequence[wire.WireState]) -> None:
         """Full-state broadcast to every peer (repo.go:123-158); one
         sendmmsg per ≤1024-datagram chunk. Runs on the caller's thread."""
@@ -161,18 +178,17 @@ class NativeReplicator:
             )
             pkts = np.concatenate([pkts[~bad], r_pkts[r_sizes >= 0]])
             sizes = np.concatenate([sizes[~bad], r_sizes[r_sizes >= 0]])
-        self.tx_packets += self.sock.send_fanout(
-            pkts, sizes, self._peer_ips, self._peer_ports
-        )
+        ips, ports = self._live_peers()
+        if len(ips):
+            self.tx_packets += self.sock.send_fanout(pkts, sizes, ips, ports)
 
     def send_incast_request(self, name: str) -> None:
         if not len(self._peer_ips):
             return
         pkts, sizes = native.encode_batch([0.0], [0.0], [0], [name], [-1])
-        if sizes[0] >= 0:
-            self.tx_packets += self.sock.send_fanout(
-                pkts, sizes, self._peer_ips, self._peer_ports
-            )
+        ips, ports = self._live_peers()
+        if sizes[0] >= 0 and len(ips):
+            self.tx_packets += self.sock.send_fanout(pkts, sizes, ips, ports)
 
     def close(self) -> None:
         self._stopped.set()

@@ -101,6 +101,10 @@ class Replicator(asyncio.DatagramProtocol):
         self.rx_packets = 0
         self.rx_errors = 0
         self.tx_packets = 0
+        # Fault injection (the network-layer sibling of -clock-offset,
+        # main.go:30): a predicate addr→bool; True drops traffic to/from
+        # that address, simulating a partition. Settable at runtime.
+        self.drop_addr: Optional[callable] = None
 
     @classmethod
     async def create(
@@ -119,6 +123,8 @@ class Replicator(asyncio.DatagramProtocol):
     # -- receive path (repo.go:54-92) ---------------------------------------
 
     def datagram_received(self, data: bytes, addr: Addr) -> None:
+        if self.drop_addr is not None and self.drop_addr(addr):
+            return
         self.rx_packets += 1
         try:
             state = wire.decode(data)
@@ -163,6 +169,8 @@ class Replicator(asyncio.DatagramProtocol):
     # -- send path (repo.go:123-169) ----------------------------------------
 
     def _send(self, data: bytes, addr: Addr) -> None:
+        if self.drop_addr is not None and self.drop_addr(addr):
+            return
         if self.transport is not None and not self.transport.is_closing():
             self.transport.sendto(data, addr)
             self.tx_packets += 1
