@@ -122,11 +122,15 @@ class TestPartitionHeal:
             )
             assert a_ok == 6
             # Side B (nodes 1,2) also admits its full burst — split brain.
+            # Within the side, UDP propagation between nodes 1 and 2 is
+            # eventually consistent, so a lagged replica can admit a bit
+            # beyond capacity: ≥6 proves the partitioned side enforces
+            # independently; ≤8 just bounds it by the requests sent.
             b_ok = sum(
                 clients[1 + (i % 2)].take("split", "6:1h")[0] == 200
                 for i in range(8)
             )
-            assert b_ok == 6
+            assert 6 <= b_ok <= 8
 
             _heal(cluster)
             # Heal path: node 0's next take broadcast reaches side B (and
