@@ -25,6 +25,7 @@ to preserve the unique-rows kernel invariant (sequential semantics).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -323,6 +324,23 @@ class DeviceEngine:
         return out
 
     def _apply_merges(self, deltas: Sequence[_Delta]) -> None:
+        # Merge-kernel selection: "scatter" (XLA, default) or "pallas"
+        # (block-sparse TPU kernel, ops/pallas_merge.py).
+        if os.environ.get("PATROL_MERGE_KERNEL") == "pallas":
+            from patrol_tpu.ops import pallas_merge
+
+            if pallas_merge.available():
+                rows = np.array([d.row for d in deltas], np.int64)
+                slots = np.array([d.slot for d in deltas], np.int64)
+                added = np.array([d.added_nt for d in deltas], np.int64)
+                taken = np.array([d.taken_nt for d in deltas], np.int64)
+                elapsed = np.array([d.elapsed_ns for d in deltas], np.int64)
+                with self._state_mu:
+                    self.state = pallas_merge.merge_batch_pallas(
+                        self.state, rows, slots, added, taken, elapsed
+                    )
+                self._ticks += 1
+                return
         k = _pad_size(len(deltas))
         rows = np.zeros(k, dtype=np.int32)
         slots = np.zeros(k, dtype=np.int32)
