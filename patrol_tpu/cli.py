@@ -65,6 +65,17 @@ def build_parser() -> argparse.ArgumentParser:
         default="30s",
         help="graceful shutdown timeout, Go duration syntax",
     )
+    p.add_argument("--checkpoint-dir", default=None, help="snapshot/restore directory")
+    p.add_argument(
+        "--checkpoint-interval",
+        default="0",
+        help="periodic snapshot interval, Go duration syntax (0 = shutdown only)",
+    )
+    p.add_argument(
+        "--no-warmup",
+        action="store_true",
+        help="skip kernel pre-compilation at boot (faster start, JIT spikes later)",
+    )
     return p
 
 
@@ -101,6 +112,9 @@ def main(argv=None) -> int:
         config=LimiterConfig(buckets=args.buckets, nodes=args.node_lanes),
         log=log,
         udp_backend=args.udp_backend,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_interval_s=parse_duration(args.checkpoint_interval) / 1e9,
+        warmup=not args.no_warmup,
     )
     try:
         asyncio.run(cmd.run())

@@ -45,6 +45,9 @@ class Command:
     # only at shutdown) and at graceful shutdown.
     checkpoint_dir: Optional[str] = None
     checkpoint_interval_s: float = 0.0
+    # Pre-compile all kernel batch variants at boot (kills JIT p99 spikes;
+    # adds seconds to startup — off for tests, on for production/bench).
+    warmup: bool = False
 
     # Populated by run() for tests/introspection.
     engine: Optional[DeviceEngine] = None
@@ -87,6 +90,14 @@ class Command:
         if self.checkpoint_dir and ckpt.exists(self.checkpoint_dir):
             n = ckpt.restore(self.checkpoint_dir, engine)
             log.info("checkpoint restored", extra={"buckets": n, "dir": self.checkpoint_dir})
+
+        if self.warmup:
+            t0 = asyncio.get_running_loop().time()
+            await asyncio.get_running_loop().run_in_executor(None, engine.warmup)
+            log.info(
+                "kernels warmed",
+                extra={"seconds": round(asyncio.get_running_loop().time() - t0, 2)},
+            )
         log.debug(
             "peers",
             extra={

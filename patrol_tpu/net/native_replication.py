@@ -94,6 +94,7 @@ class NativeReplicator:
                 packets, sizes
             )
             b_names, b_slots, b_added, b_taken, b_elapsed = [], [], [], [], []
+            incasts: list = []
             for i in range(n):
                 if not valid[i]:
                     self.rx_errors += 1
@@ -103,8 +104,8 @@ class NativeReplicator:
                 ):
                     continue
                 if added[i] == 0 and taken[i] == 0 and elapsed[i] == 0:
-                    # Incast request (repo.go:86-90).
-                    self._reply_incast(names[i], int(ips[i]), int(ports[i]))
+                    # Incast request (repo.go:86-90) — answered in batch below.
+                    incasts.append((names[i], int(ips[i]), int(ports[i])))
                     continue
                 slot = int(slots[i])
                 if not 0 <= slot < self.slots.max_slots:
@@ -122,22 +123,27 @@ class NativeReplicator:
                 self.repo.engine.ingest_deltas_batch(
                     b_names, b_slots, b_added, b_taken, b_elapsed
                 )
+            if incasts:
+                self._reply_incasts(incasts)
 
-    def _reply_incast(self, name: str, ip: int, port: int) -> None:
-        states = self.repo.snapshot(name)
-        if not states:
-            return
-        pkts, sizes = native.encode_batch(
-            [s.added for s in states],
-            [s.taken for s in states],
-            [s.elapsed_ns for s in states],
-            [s.name for s in states],
-            [s.origin_slot if s.origin_slot is not None else -1 for s in states],
-        )
-        ok = sizes >= 0
-        self.tx_packets += self.sock.send_fanout(
-            pkts[ok], sizes[ok], np.array([ip], np.uint32), np.array([port], np.uint16)
-        )
+    def _reply_incasts(self, requests) -> None:
+        """Serve a batch of incast requests with ONE device gather."""
+        by_name = self.repo.engine.snapshot_many([name for name, _, _ in requests])
+        for name, ip, port in requests:
+            states = by_name.get(name)
+            if not states:
+                continue
+            pkts, sizes = native.encode_batch(
+                [s.added for s in states],
+                [s.taken for s in states],
+                [s.elapsed_ns for s in states],
+                [s.name for s in states],
+                [s.origin_slot if s.origin_slot is not None else -1 for s in states],
+            )
+            ok = sizes >= 0
+            self.tx_packets += self.sock.send_fanout(
+                pkts[ok], sizes[ok], np.array([ip], np.uint32), np.array([port], np.uint16)
+            )
 
     # -- send path ----------------------------------------------------------
 
