@@ -76,6 +76,13 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="skip kernel pre-compilation at boot (faster start, JIT spikes later)",
     )
+    p.add_argument(
+        "--mesh-replicas",
+        type=int,
+        default=0,
+        help="run over all local devices: N full replicas × remaining "
+        "devices as bucket shards (0 = single device)",
+    )
     return p
 
 
@@ -115,6 +122,7 @@ def main(argv=None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_interval_s=parse_duration(args.checkpoint_interval) / 1e9,
         warmup=not args.no_warmup,
+        mesh_replicas=args.mesh_replicas,
     )
     try:
         asyncio.run(cmd.run())

@@ -48,6 +48,10 @@ class Command:
     # Pre-compile all kernel batch variants at boot (kills JIT p99 spikes;
     # adds seconds to startup — off for tests, on for production/bench).
     warmup: bool = False
+    # Multi-device: >0 runs the MeshEngine over all local devices with this
+    # many full replicas (the rest of the devices become bucket shards);
+    # 0 = single-device engine.
+    mesh_replicas: int = 0
 
     # Populated by run() for tests/introspection.
     engine: Optional[DeviceEngine] = None
@@ -66,7 +70,17 @@ class Command:
         slots = SlotTable(
             self.node_addr, self.peer_addrs, max_slots=self.config.nodes
         )
-        engine = DeviceEngine(self.config, node_slot=slots.self_slot, clock=self.clock)
+        if self.mesh_replicas > 0:
+            from patrol_tpu.runtime.mesh_engine import MeshEngine
+
+            engine = MeshEngine(
+                self.config,
+                replicas=self.mesh_replicas,
+                node_slot=slots.self_slot,
+                clock=self.clock,
+            )
+        else:
+            engine = DeviceEngine(self.config, node_slot=slots.self_slot, clock=self.clock)
 
         from patrol_tpu.net import native_replication
 

@@ -400,10 +400,7 @@ class DeviceEngine:
                 tickets = self._drain(self._takes, MAX_TAKE_ROWS)
                 self._busy = True
             try:
-                if deltas:
-                    self._apply_merges(deltas)
-                if tickets:
-                    self._apply_takes(tickets)
+                self._apply(deltas, tickets)
             except Exception:  # pragma: no cover - engine must never die
                 log.exception("engine tick failed")
                 for t in tickets:
@@ -418,6 +415,68 @@ class DeviceEngine:
         while q and len(out) < limit:
             out.append(q.popleft())
         return out
+
+    def _apply(self, deltas: Sequence[_Delta], tickets: Sequence[TakeTicket]) -> None:
+        """One tick's work. Subclasses may fuse both phases into a single
+        device call (MeshEngine)."""
+        if deltas:
+            self._apply_merges(deltas)
+        if tickets:
+            self._apply_takes(tickets)
+
+    def _group_tickets(self, tickets: Sequence[TakeTicket]):
+        """Coalesce by (row, rate, count) preserving arrival order; defer
+        rows seen with a second key to the next tick (kernel invariant:
+        unique rows per batch). → (keys, groups)."""
+        groups: Dict[tuple, List[TakeTicket]] = {}
+        row_key: Dict[int, tuple] = {}
+        deferred: List[TakeTicket] = []
+        for t in tickets:
+            key = (t.row, t.rate.freq, t.rate.per_ns, t.count)
+            held = row_key.get(t.row)
+            if held is None:
+                row_key[t.row] = key
+                groups[key] = [t]
+            elif held == key:
+                groups[key].append(t)
+            else:
+                deferred.append(t)
+        if deferred:
+            with self._cond:
+                self._takes.extendleft(reversed(deferred))
+                self._cond.notify()
+        return list(groups.keys()), groups
+
+    def _complete_groups(self, keys, groups, have, admitted, own_a, own_t, elapsed) -> None:
+        """Fan per-group kernel results out to tickets + broadcast hook."""
+        broadcasts: List[wire.WireState] = []
+        for i, key in enumerate(keys):
+            ts = groups[key]
+            c_nt = ts[0].count * NANO
+            for idx, t in enumerate(ts):
+                remaining, ok = remaining_for_request(
+                    int(have[i]), int(admitted[i]), c_nt, idx
+                )
+                t.complete(remaining, ok)
+            # Replicate this node's lane. The reference broadcasts full state
+            # on every take, success or not (api.go:74, README.md:41-43); we
+            # skip only when our lane is still all-zero — a zero state on the
+            # wire is the incast *request* marker (repo.go:78-90).
+            if own_a[i] or own_t[i] or elapsed[i]:
+                broadcasts.append(
+                    wire.from_nanotokens(
+                        ts[0].name,
+                        int(own_a[i]),
+                        int(own_t[i]),
+                        int(elapsed[i]),
+                        origin_slot=self.node_slot,
+                    )
+                )
+        if broadcasts and self.on_broadcast is not None:
+            try:
+                self.on_broadcast(broadcasts)
+            except Exception:  # pragma: no cover
+                log.exception("broadcast hook failed")
 
     def _apply_merges(self, deltas: Sequence[_Delta]) -> None:
         # Merge-kernel selection: "scatter" (XLA, default) or "pallas"
@@ -450,27 +509,7 @@ class DeviceEngine:
         self._ticks += 1
 
     def _apply_takes(self, tickets: Sequence[TakeTicket]) -> None:
-        # Group by (row, rate, count), preserving arrival order. A row seen
-        # again with a different key is deferred to the next tick.
-        groups: Dict[tuple, List[TakeTicket]] = {}
-        row_key: Dict[int, tuple] = {}
-        deferred: List[TakeTicket] = []
-        for t in tickets:
-            key = (t.row, t.rate.freq, t.rate.per_ns, t.count)
-            held = row_key.get(t.row)
-            if held is None:
-                row_key[t.row] = key
-                groups[key] = [t]
-            elif held == key:
-                groups[key].append(t)
-            else:
-                deferred.append(t)
-        if deferred:
-            with self._cond:
-                self._takes.extendleft(reversed(deferred))
-                self._cond.notify()
-
-        keys = list(groups.keys())
+        keys, groups = self._group_tickets(tickets)
         k = _pad_size(len(keys), hi=MAX_TAKE_ROWS)
         packed = np.zeros((8, k), dtype=np.int64)
         for i, key in enumerate(keys):
@@ -486,7 +525,6 @@ class DeviceEngine:
             packed[5, i] = len(ts)
             packed[6, i] = self.directory.cap_base_nt[first.row]
             packed[7, i] = self.directory.created_ns[first.row]
-        count_nt = packed[4]
 
         with self._state_mu:
             self.state, out = _jit_take_packed(self.node_slot)(
@@ -496,32 +534,4 @@ class DeviceEngine:
 
         out = np.asarray(out)  # one D2H transfer; blocks until device done
         have, admitted, own_a, own_t, elapsed = out
-
-        broadcasts: List[wire.WireState] = []
-        for i, key in enumerate(keys):
-            ts = groups[key]
-            c_nt = int(count_nt[i])
-            for idx, t in enumerate(ts):
-                remaining, ok = remaining_for_request(
-                    int(have[i]), int(admitted[i]), c_nt, idx
-                )
-                t.complete(remaining, ok)
-            # Replicate this node's lane. The reference broadcasts full state
-            # on every take, success or not (api.go:74, README.md:41-43); we
-            # skip only when our lane is still all-zero — a zero state on the
-            # wire is the incast *request* marker (repo.go:78-90).
-            if own_a[i] or own_t[i] or elapsed[i]:
-                broadcasts.append(
-                    wire.from_nanotokens(
-                        ts[0].name,
-                        int(own_a[i]),
-                        int(own_t[i]),
-                        int(elapsed[i]),
-                        origin_slot=self.node_slot,
-                    )
-                )
-        if broadcasts and self.on_broadcast is not None:
-            try:
-                self.on_broadcast(broadcasts)
-            except Exception:  # pragma: no cover
-                log.exception("broadcast hook failed")
+        self._complete_groups(keys, groups, have, admitted, own_a, own_t, elapsed)
