@@ -6,13 +6,19 @@ The reference publishes no numbers (BASELINE.md): the Go design's merge
 ingest is a single-threaded one-packet-per-iteration loop (repo.go:54-92);
 the TPU design replaces it with dense/batched joins.
 
-Three measurements:
-  * dense anti-entropy sweep   — merge_dense over the full state
-    (partition-heal / BASELINE config #5 class), counted as one bucket-merge
-    per bucket row per sweep;
-  * scatter microbatch merge   — merge_batch of K random deltas (the UDP
-    ingest path, BASELINE config #3 class), counted per delta;
-  * fused take step            — the HTTP hot path's device portion.
+Measurements, mapped to the BASELINE.json configs (configs #1-2 are
+end-to-end HTTP paths, measured separately by benchmarks/http_bench.py):
+
+  * dense anti-entropy sweep     — merge_dense over the full state: the
+    partition-heal replay class (config #5: millions of stale deltas
+    applied in one call), counted as one bucket-merge per row per sweep;
+  * scatter microbatch merge     — merge_batch of K uniform random deltas:
+    the UDP replication-stream ingest class (config #3);
+  * hot-key contention merge     — all K deltas target ONE bucket across
+    256 node lanes (config #4: the reference serializes this on one mutex,
+    bucket.go:240-263; here it is a single scatter-max);
+  * fused take step              — the HTTP hot path's device portion,
+    with 4-way hot-bucket coalescing.
 
 Prints ONE JSON line: the headline is dense bucket-merges/sec;
 vs_baseline is the ratio against the 50M/s v5e-4 target.
@@ -80,6 +86,18 @@ def main() -> None:
     dt_scatter, state = _bench(scatter, state, deltas, iters=10)
     scatter_merges_per_s = K / dt_scatter
 
+    # -- hot-key contention: one bucket, all node lanes (config #4) --------
+    KH = 131_072
+    hot = MergeBatch(
+        rows=jnp.zeros((KH,), jnp.int32),
+        slots=jax.random.randint(k2, (KH,), 0, N, dtype=jnp.int32),
+        added_nt=jax.random.randint(k2, (KH,), 0, 10 * NANO, dtype=jnp.int64),
+        taken_nt=jax.random.randint(k2, (KH,), 0, 10 * NANO, dtype=jnp.int64),
+        elapsed_ns=jax.random.randint(k2, (KH,), 0, 100 * NANO, dtype=jnp.int64),
+    )
+    dt_hot, state = _bench(scatter, state, hot, iters=10)
+    hot_merges_per_s = KH / dt_hot
+
     # -- fused take step ----------------------------------------------------
     KT = 4096
     reqs = TakeRequest(
@@ -111,6 +129,7 @@ def main() -> None:
         "dense_sweep_ms": round(dt_dense * 1e3, 3),
         "scatter_merges_per_s": round(scatter_merges_per_s),
         "scatter_batch": K,
+        "hotkey_merges_per_s": round(hot_merges_per_s),
         "take_requests_per_s": round(takes_per_s),
         "take_step_us": round(dt_take * 1e6, 1),
     }

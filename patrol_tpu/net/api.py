@@ -168,6 +168,7 @@ class _HTTPProtocol(asyncio.Protocol):
         self.buf = b""
         self.transport: Optional[asyncio.Transport] = None
         self._body_to_skip = 0
+        self._h2 = None  # set when the h2c preface is sniffed
         # FIFO lock: pipelined requests are handled concurrently but their
         # responses are written in request order.
         self._write_order = asyncio.Lock()
@@ -184,7 +185,20 @@ class _HTTPProtocol(asyncio.Protocol):
             pass
 
     def data_received(self, data: bytes) -> None:
+        if self._h2 is not None:
+            self._feed_h2(data)
+            return
         self.buf += data
+        # h2c prior-knowledge sniff (≙ h2c.NewHandler, command.go:41-44):
+        # "PRI " is not a valid HTTP/1.1 method, so 4 bytes disambiguate.
+        if self._body_to_skip == 0 and self.buf[:4] == b"PRI ":
+            from patrol_tpu.net import h2 as h2mod
+
+            if h2mod.available():
+                self._h2 = h2mod.H2Connection(self._on_h2_request)
+                pending, self.buf = self.buf, b""
+                self._feed_h2(pending)
+                return
         while True:
             if self._body_to_skip:
                 skip = min(self._body_to_skip, len(self.buf))
@@ -218,6 +232,33 @@ class _HTTPProtocol(asyncio.Protocol):
             self._body_to_skip = clen
             path, _, query = target.partition("?")
             asyncio.ensure_future(self._respond(method, path, query, keep_alive))
+
+    def _feed_h2(self, data: bytes) -> None:
+        try:
+            out = self._h2.receive(data)
+        except Exception as exc:
+            if self.api.log is not None:
+                self.api.log.error("h2 error", extra={"error": repr(exc)})
+            self.transport.close()
+            return
+        if out:
+            self.transport.write(out)
+        if self._h2.closed:
+            self.transport.close()
+
+    def _on_h2_request(self, stream_id: int, method: str, path: str, query: str) -> None:
+        asyncio.ensure_future(self._respond_h2(stream_id, method, path, query))
+
+    async def _respond_h2(self, stream_id: int, method: str, path: str, query: str) -> None:
+        try:
+            status, body, ctype = await self.api.handle(method, path, query)
+        except Exception as exc:  # pragma: no cover
+            if self.api.log is not None:
+                self.api.log.error("api error", extra={"error": repr(exc)})
+            status, body, ctype = 500, b"internal error\n", "text/plain"
+        if self.transport is None or self.transport.is_closing() or self._h2 is None:
+            return
+        self.transport.write(self._h2.send_response(stream_id, status, body, ctype))
 
     async def _respond(self, method: str, path: str, query: str, keep_alive: bool) -> None:
         async with self._write_order:

@@ -72,6 +72,19 @@ def merge_dense(state: LimiterState, other: LimiterState) -> LimiterState:
 merge_dense_jit = partial(jax.jit, donate_argnums=0)(merge_dense)
 
 
+def zero_rows(state: LimiterState, rows: jax.Array) -> LimiterState:
+    """Clear bucket rows (slot recycling / eviction). Semantically this is
+    a node restart for those buckets: state is soft and re-hydrates from
+    peers via incast (repo.go:96-106). Duplicate indices are fine."""
+    n = state.pn.shape[1]
+    pn = state.pn.at[rows].set(jnp.zeros((rows.shape[0], n, 2), state.pn.dtype))
+    elapsed = state.elapsed.at[rows].set(0)
+    return LimiterState(pn=pn, elapsed=elapsed)
+
+
+zero_rows_jit = partial(jax.jit, donate_argnums=0)(zero_rows)
+
+
 class RowState(NamedTuple):
     pn: jax.Array  # int64[K, N, 2]
     elapsed: jax.Array  # int64[K]

@@ -307,6 +307,22 @@ class DeviceEngine:
             out.append(wire.from_nanotokens(name, 0, 0, elapsed, origin_slot=self.node_slot))
         return out
 
+    def release_bucket(self, name: str) -> bool:
+        """Evict a bucket: zero its device row and recycle the slot. The
+        bucket's state survives on peers and re-hydrates via incast on next
+        use — the same soft-state story as a node restart (SURVEY §5)."""
+        self.flush()
+        row = self.directory.release(name)
+        if row is None:
+            return False
+        from patrol_tpu.ops.merge import zero_rows
+
+        with self._state_mu:
+            self.state = jax.jit(zero_rows, donate_argnums=0)(
+                self.state, jnp.array([row], jnp.int32)
+            )
+        return True
+
     def snapshot_many(self, names: Sequence[str]) -> Dict[str, List[wire.WireState]]:
         """Batched :meth:`snapshot`: one device gather for many buckets
         (the incast-reply fan-in under cold-key storms)."""

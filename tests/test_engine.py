@@ -150,6 +150,22 @@ class TestEngine:
             eng.stop()
 
 
+class TestEviction:
+    def test_release_zeroes_and_recycles(self, engine):
+        engine.take("old", RATE, 7)
+        row = engine.directory.lookup("old")
+        assert engine.release_bucket("old")
+        assert engine.directory.lookup("old") is None
+        # The recycled row must come back clean for a new bucket.
+        row2, created = engine.directory.assign("new", 0)
+        assert created and row2 == row
+        remaining, ok, _ = engine.take("new", RATE, 1)
+        assert ok and remaining == 9  # fresh capacity, no leak from "old"
+
+    def test_release_unknown(self, engine):
+        assert not engine.release_bucket("nope")
+
+
 class TestTPURepo:
     def test_incast_on_miss_once(self, engine):
         asked = []
