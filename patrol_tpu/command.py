@@ -57,6 +57,10 @@ class Command:
     engine: Optional[DeviceEngine] = None
     repo: Optional[TPURepo] = None
     replicator: Optional[Replicator] = None
+    # Set by run() once every socket is bound and the API is accepting —
+    # the deterministic "serving" signal for supervisors and tests
+    # (awaitable immediately after construction; cleared when run() begins).
+    started: asyncio.Event = dataclasses.field(default_factory=asyncio.Event)
 
     async def run(self, stop: Optional[asyncio.Event] = None) -> None:
         """Run until ``stop`` is set or SIGINT/SIGTERM arrives; then shut
@@ -66,6 +70,7 @@ class Command:
             raise ValueError("shutdown_timeout_s must be set")
         log = self.log or logging.getLogger("patrol")
         stop = stop or asyncio.Event()
+        self.started.clear()
 
         slots = SlotTable(
             self.node_addr, self.peer_addrs, max_slots=self.config.nodes
@@ -142,6 +147,7 @@ class Command:
                     loop.add_signal_handler(sig, stop.set)
 
         log.info("API serving", extra={"addr": self.api_addr})
+        self.started.set()
 
         ckpt_task = None
         if self.checkpoint_dir and self.checkpoint_interval_s > 0:
@@ -179,3 +185,4 @@ class Command:
             for handler in (self.log.handlers if self.log else []):
                 with contextlib.suppress(Exception):
                     handler.flush()  # ≙ Log.Sync() (command.go:38)
+            self.started.clear()  # no stale "serving" signal after shutdown

@@ -7,13 +7,23 @@ imports jax.
 """
 
 import os
+import re
 
 # Hard override: the deployment environment pins JAX_PLATFORMS to the real
 # TPU tunnel, where every test-sized compile costs ~20s. Unit/integration
 # tests always run on the virtual CPU mesh; only bench.py uses the chip.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+_m = re.search(r"--xla_force_host_platform_device_count=(\d+)", _flags)
+if _m is None or int(_m.group(1)) < 8:
+    _flags = re.sub(r"--xla_force_host_platform_device_count=\d+\s*", "", _flags)
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# A TPU plugin loaded from sitecustomize (before this file runs) may have
+# already forced jax_platforms to the hardware backend; the env var alone
+# can't win that race, so re-pin the config before backends initialize.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
