@@ -20,16 +20,34 @@ end-to-end HTTP paths, measured separately by benchmarks/http_bench.py):
   * fused take step              — the HTTP hot path's device portion,
     with 4-way hot-bucket coalescing.
 
+Robustness: every stage is optional under a wall-clock budget
+(PATROL_BENCH_BUDGET_S, default 1500 s) — first compiles on the real TPU
+go through a remote-compile tunnel and can take minutes each, so the
+harness logs progress to stderr and ALWAYS prints its one JSON line with
+whatever stages completed before the budget ran out.
+
 Prints ONE JSON line: the headline is dense bucket-merges/sec;
 vs_baseline is the ratio against the 50M/s v5e-4 target.
 """
 
 import json
 import os
+import sys
 import time
 
+START = time.time()
+BUDGET_S = float(os.environ.get("PATROL_BENCH_BUDGET_S", "1500"))
 
-def _bench(fn, state, *args, iters=10, warmup=3):
+
+def _log(msg: str) -> None:
+    print(f"[bench +{time.time() - START:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def _left() -> float:
+    return BUDGET_S - (time.time() - START)
+
+
+def _bench(fn, state, *args, iters=10, warmup=2):
     import jax
 
     for _ in range(warmup):
@@ -43,65 +61,146 @@ def _bench(fn, state, *args, iters=10, warmup=3):
 
 
 def main() -> None:
+    # A persistent compilation cache makes re-runs (and the driver's final
+    # run after this script has been exercised once) skip the slow remote
+    # first-compiles. Harmless where unsupported.
+    cache_dir = os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/patrol-jax-cache"
+    )
+
     import jax
+
+    # The deployment sitecustomize's TPU plugin register() forces
+    # jax_platforms to the hardware backend, overriding the env var; re-pin
+    # from the env so `JAX_PLATFORMS=cpu python bench.py` really runs on CPU.
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        jax.config.update("jax_platforms", env_platforms)
+
     import jax.numpy as jnp
 
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
     import patrol_tpu  # noqa: F401  (x64)
-    from patrol_tpu.models.limiter import LimiterConfig, LimiterState, NANO, init_state
+    from patrol_tpu.models.limiter import LimiterConfig, LimiterState, NANO
     from patrol_tpu.ops.merge import MergeBatch, merge_batch, merge_dense
     from patrol_tpu.ops.take import TakeRequest, take_batch
 
+    global START
     platform = jax.default_backend()
+    _log(f"platform={platform} devices={jax.devices()}")
+    # The budget clock starts once the device is actually acquired: on the
+    # shared-TPU tunnel the initial claim can itself wait out a prior
+    # holder's lease, which shouldn't eat the measurement budget.
+    START = time.time()
     on_accel = platform not in ("cpu",)
     B = int(os.environ.get("PATROL_BENCH_BUCKETS", 1_000_000 if on_accel else 65_536))
     N = int(os.environ.get("PATROL_BENCH_NODES", 256 if on_accel else 32))
-    cfg = LimiterConfig(buckets=B, nodes=N)
 
-    key = jax.random.PRNGKey(0)
+    out = {
+        "metric": "bucket-merges/sec (dense CvRDT sweep, 1 chip)",
+        "value": 0,
+        "unit": "merges/s",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "buckets": B,
+        "node_lanes": N,
+    }
 
-    def mk_state(k):
-        pn = jax.random.randint(k, (B, N, 2), 0, 10 * NANO, dtype=jnp.int64)
-        elapsed = jax.random.randint(k, (B,), 0, 100 * NANO, dtype=jnp.int64)
-        return LimiterState(pn=pn, elapsed=elapsed)
+    try:
+        _run_stages(out, jax, jnp, B, N)
+    except Exception as e:  # always emit the JSON line
+        _log(f"aborted: {type(e).__name__}: {e}")
+        out["error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(out))
 
-    k1, k2, k3 = jax.random.split(key, 3)
 
-    # -- dense anti-entropy sweep ------------------------------------------
+def _run_stages(out, jax, jnp, B, N) -> None:
+    from patrol_tpu.models.limiter import LimiterConfig, LimiterState, NANO
+    from patrol_tpu.ops.merge import MergeBatch, merge_batch, merge_dense
+    from patrol_tpu.ops.take import TakeRequest, take_batch
+
+    target = 50e6  # BASELINE.json: ≥50M bucket-merges/sec on v5e-4
+
+    # Deterministic non-trivial state, built from cheap iota patterns (one
+    # tiny compile) instead of int64 PRNG kernels: on the TPU tunnel every
+    # distinct program is a slow remote compile, and PRNG adds several.
+    @jax.jit
+    def mk_states():
+        row = jnp.arange(B, dtype=jnp.int64)[:, None, None]
+        lane = jnp.arange(N, dtype=jnp.int64)[None, :, None]
+        side = jnp.arange(2, dtype=jnp.int64)[None, None, :]
+        pn_a = (row * 7 + lane * 13 + side * 3) % (10 * NANO)
+        pn_b = (row * 11 + lane * 5 + side * 17) % (10 * NANO)
+        el_a = (jnp.arange(B, dtype=jnp.int64) * 29) % (100 * NANO)
+        el_b = (jnp.arange(B, dtype=jnp.int64) * 31) % (100 * NANO)
+        return (
+            LimiterState(pn=pn_a, elapsed=el_a),
+            LimiterState(pn=pn_b, elapsed=el_b),
+        )
+
+    _log(f"building {B}x{N}x2 int64 state (compile #1)…")
+    state, other = mk_states()
+    jax.block_until_ready(state.pn)
+    _log("state ready")
+
+    # -- dense anti-entropy sweep (config #5) -------------------------------
+    if _left() < 30:
+        _log("budget exhausted before dense sweep")
+        return
     dense = jax.jit(merge_dense, donate_argnums=0)
-    state = mk_state(k1)
-    other = mk_state(k2)
+    _log("dense sweep (compile #2)…")
     dt_dense, state = _bench(dense, state, other, iters=10)
-    dense_merges_per_s = B / dt_dense
+    out["value"] = round(B / dt_dense)
+    out["vs_baseline"] = round(B / dt_dense / target, 3)
+    out["dense_sweep_ms"] = round(dt_dense * 1e3, 3)
+    _log(f"dense: {out['value']:.3g} merges/s ({out['dense_sweep_ms']} ms/sweep)")
 
-    # -- scatter microbatch merge ------------------------------------------
+    # -- scatter microbatch merge (config #3) -------------------------------
+    if _left() < 30:
+        return
     K = 131_072
+    idx = jnp.arange(K, dtype=jnp.int64)
     deltas = MergeBatch(
-        rows=jax.random.randint(k3, (K,), 0, B, dtype=jnp.int32),
-        slots=jax.random.randint(k3, (K,), 0, N, dtype=jnp.int32),
-        added_nt=jax.random.randint(k3, (K,), 0, 10 * NANO, dtype=jnp.int64),
-        taken_nt=jax.random.randint(k3, (K,), 0, 10 * NANO, dtype=jnp.int64),
-        elapsed_ns=jax.random.randint(k3, (K,), 0, 100 * NANO, dtype=jnp.int64),
+        rows=((idx * 2654435761) % B).astype(jnp.int32),
+        slots=((idx * 40503) % N).astype(jnp.int32),
+        added_nt=(idx * 7919) % (10 * NANO),
+        taken_nt=(idx * 104729) % (10 * NANO),
+        elapsed_ns=(idx * 1299709) % (100 * NANO),
     )
     scatter = jax.jit(merge_batch, donate_argnums=0)
+    _log("scatter merge (compile #3)…")
     dt_scatter, state = _bench(scatter, state, deltas, iters=10)
-    scatter_merges_per_s = K / dt_scatter
+    out["scatter_merges_per_s"] = round(K / dt_scatter)
+    out["scatter_batch"] = K
+    _log(f"scatter: {out['scatter_merges_per_s']:.3g} merges/s")
 
-    # -- hot-key contention: one bucket, all node lanes (config #4) --------
-    KH = 131_072
+    # -- hot-key contention: one bucket, all node lanes (config #4) ---------
+    if _left() < 30:
+        return
     hot = MergeBatch(
-        rows=jnp.zeros((KH,), jnp.int32),
-        slots=jax.random.randint(k2, (KH,), 0, N, dtype=jnp.int32),
-        added_nt=jax.random.randint(k2, (KH,), 0, 10 * NANO, dtype=jnp.int64),
-        taken_nt=jax.random.randint(k2, (KH,), 0, 10 * NANO, dtype=jnp.int64),
-        elapsed_ns=jax.random.randint(k2, (KH,), 0, 100 * NANO, dtype=jnp.int64),
+        rows=jnp.zeros((K,), jnp.int32),
+        slots=((idx * 48271) % N).astype(jnp.int32),
+        added_nt=(idx * 6151) % (10 * NANO),
+        taken_nt=(idx * 3571) % (10 * NANO),
+        elapsed_ns=(idx * 9973) % (100 * NANO),
     )
+    _log("hot-key merge (cached compile)…")
     dt_hot, state = _bench(scatter, state, hot, iters=10)
-    hot_merges_per_s = KH / dt_hot
+    out["hotkey_merges_per_s"] = round(K / dt_hot)
+    _log(f"hotkey: {out['hotkey_merges_per_s']:.3g} merges/s")
 
-    # -- fused take step ----------------------------------------------------
+    # -- fused take step (device half of configs #1-2) ----------------------
+    if _left() < 30:
+        return
     KT = 4096
+    it = jnp.arange(KT, dtype=jnp.int64)
     reqs = TakeRequest(
-        rows=(jnp.arange(KT, dtype=jnp.int32) * 2654435761 % B).astype(jnp.int32),
+        rows=((it * 2654435761) % B).astype(jnp.int32),
         now_ns=jnp.full((KT,), 1000 * NANO, jnp.int64),
         freq=jnp.full((KT,), 100, jnp.int64),
         per_ns=jnp.full((KT,), NANO, jnp.int64),
@@ -110,30 +209,12 @@ def main() -> None:
         cap_base_nt=jnp.full((KT,), 100 * NANO, jnp.int64),
         created_ns=jnp.zeros((KT,), jnp.int64),
     )
-
-    take = jax.jit(
-        lambda s, r: take_batch(s, r, 0)[0], donate_argnums=0
-    )
+    take = jax.jit(lambda s, r: take_batch(s, r, 0)[0], donate_argnums=0)
+    _log("fused take (compile #4)…")
     dt_take, state = _bench(take, state, reqs, iters=10)
-    takes_per_s = KT * 4 / dt_take  # nreq=4 coalesced requests per row
-
-    target = 50e6  # BASELINE.json: ≥50M bucket-merges/sec on v5e-4
-    out = {
-        "metric": "bucket-merges/sec (dense CvRDT sweep, 1 chip)",
-        "value": round(dense_merges_per_s),
-        "unit": "merges/s",
-        "vs_baseline": round(dense_merges_per_s / target, 3),
-        "platform": platform,
-        "buckets": B,
-        "node_lanes": N,
-        "dense_sweep_ms": round(dt_dense * 1e3, 3),
-        "scatter_merges_per_s": round(scatter_merges_per_s),
-        "scatter_batch": K,
-        "hotkey_merges_per_s": round(hot_merges_per_s),
-        "take_requests_per_s": round(takes_per_s),
-        "take_step_us": round(dt_take * 1e6, 1),
-    }
-    print(json.dumps(out))
+    out["take_requests_per_s"] = round(KT * 4 / dt_take)  # nreq=4 per row
+    out["take_step_us"] = round(dt_take * 1e6, 1)
+    _log(f"take: {out['take_requests_per_s']:.3g} req/s ({out['take_step_us']} µs/step)")
 
 
 if __name__ == "__main__":

@@ -20,7 +20,14 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # Hard override: the HTTP path is host-bound; default to CPU regardless of
 # the environment's platform pin (set PATROL_HTTP_BENCH_PLATFORM to change).
+# The env var alone is not enough: a TPU plugin registered from
+# sitecustomize forces jax_platforms before this module runs, so re-pin the
+# config after importing jax (same dance as tests/conftest.py).
 os.environ["JAX_PLATFORMS"] = os.environ.get("PATROL_HTTP_BENCH_PLATFORM", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 import asyncio
 import socket

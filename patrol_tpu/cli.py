@@ -90,6 +90,12 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
+        # A TPU plugin registered from sitecustomize may already have forced
+        # jax_platforms before main() runs; the env var alone loses that
+        # race, so re-pin the config explicitly.
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     # Heavy imports after platform selection.
     from patrol_tpu.command import Command
