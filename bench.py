@@ -14,29 +14,61 @@ end-to-end HTTP paths, measured separately by benchmarks/http_bench.py):
     applied in one call), counted as one bucket-merge per row per sweep;
   * scatter microbatch merge     — merge_batch of K uniform random deltas:
     the UDP replication-stream ingest class (config #3);
+  * pallas-vs-XLA scatter        — the block-sparse Pallas merge kernel
+    against the XLA scatter at K∈{8k, 131k}; the winner becomes the
+    engine's auto-mode default (ops/pallas_merge.py);
   * hot-key contention merge     — all K deltas target ONE bucket across
     256 node lanes (config #4: the reference serializes this on one mutex,
     bucket.go:240-263; here it is a single scatter-max);
   * fused take step              — the HTTP hot path's device portion,
-    with 4-way hot-bucket coalescing.
+    with 4-way hot-bucket coalescing;
+  * ingest replay                — configs #3/#5 end-to-end HOST path:
+    pre-encoded wire packets → batch decode → directory → device merge,
+    measuring the feeder (engine.py), not just the kernel.
 
-Robustness: every stage is optional under a wall-clock budget
-(PATROL_BENCH_BUDGET_S, default 1500 s) — first compiles on the real TPU
-go through a remote-compile tunnel and can take minutes each, so the
-harness logs progress to stderr and ALWAYS prints its one JSON line with
-whatever stages completed before the budget ran out.
-
-Prints ONE JSON line: the headline is dense bucket-merges/sec;
-vs_baseline is the ratio against the 50M/s v5e-4 target.
+Robustness contract: this process prints EXACTLY ONE JSON line on stdout,
+no matter what — TPU backend init failure (falls back to CPU, recorded in
+the "error" field), budget exhaustion mid-run ("truncated": true), SIGINT/
+SIGTERM from a driver timeout (handler flushes the line), or any exception.
+The backend is probed in a short-lived subprocess first so a wedged TPU
+tunnel cannot take this process down with it (round-1 failure mode:
+BENCH_r01.json rc=1, parsed=null).
 """
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
 START = time.time()
 BUDGET_S = float(os.environ.get("PATROL_BENCH_BUDGET_S", "1500"))
+PROBE_TIMEOUT_S = float(os.environ.get("PATROL_BENCH_PROBE_TIMEOUT_S", "420"))
+
+OUT = {
+    "metric": "bucket-merges/sec (dense CvRDT sweep, 1 chip)",
+    "value": 0,
+    "unit": "merges/s",
+    "vs_baseline": 0.0,
+    "platform": "unknown",
+    "stages_completed": 0,
+}
+_EMITTED = False
+
+
+def _emit() -> None:
+    global _EMITTED
+    if not _EMITTED:
+        _EMITTED = True
+        print(json.dumps(OUT), flush=True)
+
+
+def _on_signal(signum, frame):  # driver timeout → still emit the line
+    OUT.setdefault("error", f"terminated by signal {signum}")
+    OUT["truncated"] = True
+    _emit()
+    os._exit(128 + signum)
 
 
 def _log(msg: str) -> None:
@@ -60,70 +92,120 @@ def _bench(fn, state, *args, iters=10, warmup=2):
     return (time.perf_counter() - t0) / iters, state
 
 
+def _probe_backend() -> str:
+    """Decide the platform WITHOUT importing jax in this process: a child
+    process tries the default (TPU) backend under a timeout; on failure it
+    is retried once, then we pin JAX_PLATFORMS=cpu. This is what keeps a
+    wedged TPU tunnel from killing the harness (VERDICT r1 item 1)."""
+    if os.environ.get("JAX_PLATFORMS"):
+        return os.environ["JAX_PLATFORMS"].split(",")[0]
+    probe = (
+        "import jax; d = jax.devices(); "
+        "print(jax.default_backend(), flush=True)"
+    )
+    for attempt in (1, 2):
+        _log(f"probing default backend (attempt {attempt}, ≤{PROBE_TIMEOUT_S:.0f}s)…")
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            OUT["error"] = f"backend probe timed out after {PROBE_TIMEOUT_S:.0f}s"
+            continue
+        if r.returncode == 0 and r.stdout.strip():
+            platform = r.stdout.strip().splitlines()[-1]
+            _log(f"probe ok: {platform}")
+            OUT.pop("error", None)
+            return platform
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        OUT["error"] = "tpu unavailable: " + (tail[-1] if tail else f"rc={r.returncode}")
+        _log(f"probe failed (rc={r.returncode}): {OUT['error']}")
+    _log("falling back to CPU")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return "cpu"
+
+
 def main() -> None:
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
     # A persistent compilation cache makes re-runs (and the driver's final
     # run after this script has been exercised once) skip the slow remote
     # first-compiles. Harmless where unsupported.
     cache_dir = os.environ.setdefault(
         "JAX_COMPILATION_CACHE_DIR", "/tmp/patrol-jax-cache"
     )
+    try:
+        platform = _probe_backend()
+        OUT["platform"] = platform
 
+        import jax
+
+        # The deployment sitecustomize's TPU plugin register() forces
+        # jax_platforms to the hardware backend, overriding the env var;
+        # re-pin from the env so the CPU fallback (and explicit
+        # JAX_PLATFORMS=cpu runs) really land on CPU.
+        env_platforms = os.environ.get("JAX_PLATFORMS")
+        if env_platforms:
+            jax.config.update("jax_platforms", env_platforms)
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        except Exception:
+            pass
+
+        OUT["platform"] = jax.default_backend()
+        _log(f"platform={OUT['platform']} devices={jax.devices()}")
+        _run_stages(OUT)
+    except BaseException as e:  # the one JSON line survives everything
+        _log(f"aborted: {type(e).__name__}: {e}")
+        OUT["error"] = f"{type(e).__name__}: {e}"
+        OUT["truncated"] = True
+        _emit()
+        if not isinstance(e, Exception):
+            raise  # re-raise KeyboardInterrupt/SystemExit after flushing
+        return
+    _emit()
+
+
+def _stage_done(name: str) -> None:
+    OUT["stages_completed"] = int(OUT["stages_completed"]) + 1
+    OUT.setdefault("stages", []).append(name)
+
+
+def _budget_out(stage: str) -> bool:
+    if _left() < 30:
+        _log(f"budget exhausted before {stage}")
+        OUT["truncated"] = True
+        OUT["truncated_before"] = stage
+        return True
+    return False
+
+
+def _run_stages(out) -> None:
+    global START
     import jax
-
-    # The deployment sitecustomize's TPU plugin register() forces
-    # jax_platforms to the hardware backend, overriding the env var; re-pin
-    # from the env so `JAX_PLATFORMS=cpu python bench.py` really runs on CPU.
-    env_platforms = os.environ.get("JAX_PLATFORMS")
-    if env_platforms:
-        jax.config.update("jax_platforms", env_platforms)
-
     import jax.numpy as jnp
 
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:
-        pass
-
-    import patrol_tpu  # noqa: F401  (x64)
-    from patrol_tpu.models.limiter import LimiterConfig, LimiterState, NANO
+    import patrol_tpu  # noqa: F401  (enables x64)
+    from patrol_tpu.models.limiter import LimiterState, NANO
     from patrol_tpu.ops.merge import MergeBatch, merge_batch, merge_dense
     from patrol_tpu.ops.take import TakeRequest, take_batch
 
-    global START
-    platform = jax.default_backend()
-    _log(f"platform={platform} devices={jax.devices()}")
     # The budget clock starts once the device is actually acquired: on the
     # shared-TPU tunnel the initial claim can itself wait out a prior
     # holder's lease, which shouldn't eat the measurement budget.
+    jnp.zeros((), jnp.int32).block_until_ready()
     START = time.time()
+
+    platform = out["platform"]
     on_accel = platform not in ("cpu",)
     B = int(os.environ.get("PATROL_BENCH_BUCKETS", 1_000_000 if on_accel else 65_536))
     N = int(os.environ.get("PATROL_BENCH_NODES", 256 if on_accel else 32))
-
-    out = {
-        "metric": "bucket-merges/sec (dense CvRDT sweep, 1 chip)",
-        "value": 0,
-        "unit": "merges/s",
-        "vs_baseline": 0.0,
-        "platform": platform,
-        "buckets": B,
-        "node_lanes": N,
-    }
-
-    try:
-        _run_stages(out, jax, jnp, B, N)
-    except Exception as e:  # always emit the JSON line
-        _log(f"aborted: {type(e).__name__}: {e}")
-        out["error"] = f"{type(e).__name__}: {e}"
-    print(json.dumps(out))
-
-
-def _run_stages(out, jax, jnp, B, N) -> None:
-    from patrol_tpu.models.limiter import LimiterConfig, LimiterState, NANO
-    from patrol_tpu.ops.merge import MergeBatch, merge_batch, merge_dense
-    from patrol_tpu.ops.take import TakeRequest, take_batch
-
+    out["buckets"] = B
+    out["node_lanes"] = N
     target = 50e6  # BASELINE.json: ≥50M bucket-merges/sec on v5e-4
 
     # Deterministic non-trivial state, built from cheap iota patterns (one
@@ -148,9 +230,8 @@ def _run_stages(out, jax, jnp, B, N) -> None:
     jax.block_until_ready(state.pn)
     _log("state ready")
 
-    # -- dense anti-entropy sweep (config #5) -------------------------------
-    if _left() < 30:
-        _log("budget exhausted before dense sweep")
+    # -- dense anti-entropy sweep (config #5, kernel half) ------------------
+    if _budget_out("dense sweep"):
         return
     dense = jax.jit(merge_dense, donate_argnums=0)
     _log("dense sweep (compile #2)…")
@@ -158,30 +239,31 @@ def _run_stages(out, jax, jnp, B, N) -> None:
     out["value"] = round(B / dt_dense)
     out["vs_baseline"] = round(B / dt_dense / target, 3)
     out["dense_sweep_ms"] = round(dt_dense * 1e3, 3)
+    _stage_done("dense")
     _log(f"dense: {out['value']:.3g} merges/s ({out['dense_sweep_ms']} ms/sweep)")
 
-    # -- scatter microbatch merge (config #3) -------------------------------
-    if _left() < 30:
+    # -- scatter microbatch merge (config #3, kernel half) ------------------
+    if _budget_out("scatter merge"):
         return
     K = 131_072
-    idx = jnp.arange(K, dtype=jnp.int64)
-    deltas = MergeBatch(
-        rows=((idx * 2654435761) % B).astype(jnp.int32),
-        slots=((idx * 40503) % N).astype(jnp.int32),
-        added_nt=(idx * 7919) % (10 * NANO),
-        taken_nt=(idx * 104729) % (10 * NANO),
-        elapsed_ns=(idx * 1299709) % (100 * NANO),
-    )
+    deltas = _mk_merge_batch(K, B, N)
     scatter = jax.jit(merge_batch, donate_argnums=0)
     _log("scatter merge (compile #3)…")
     dt_scatter, state = _bench(scatter, state, deltas, iters=10)
     out["scatter_merges_per_s"] = round(K / dt_scatter)
     out["scatter_batch"] = K
+    _stage_done("scatter")
     _log(f"scatter: {out['scatter_merges_per_s']:.3g} merges/s")
 
-    # -- hot-key contention: one bucket, all node lanes (config #4) ---------
-    if _left() < 30:
+    # -- pallas-vs-XLA scatter (VERDICT r1 item 5; TPU only) ----------------
+    if _budget_out("pallas compare"):
         return
+    state = _stage_pallas_compare(out, state, scatter, B, N)
+
+    # -- hot-key contention: one bucket, all node lanes (config #4) ---------
+    if _budget_out("hot-key merge"):
+        return
+    idx = jnp.arange(K, dtype=jnp.int64)
     hot = MergeBatch(
         rows=jnp.zeros((K,), jnp.int32),
         slots=((idx * 48271) % N).astype(jnp.int32),
@@ -192,10 +274,11 @@ def _run_stages(out, jax, jnp, B, N) -> None:
     _log("hot-key merge (cached compile)…")
     dt_hot, state = _bench(scatter, state, hot, iters=10)
     out["hotkey_merges_per_s"] = round(K / dt_hot)
+    _stage_done("hotkey")
     _log(f"hotkey: {out['hotkey_merges_per_s']:.3g} merges/s")
 
     # -- fused take step (device half of configs #1-2) ----------------------
-    if _left() < 30:
+    if _budget_out("fused take"):
         return
     KT = 4096
     it = jnp.arange(KT, dtype=jnp.int64)
@@ -214,7 +297,168 @@ def _run_stages(out, jax, jnp, B, N) -> None:
     dt_take, state = _bench(take, state, reqs, iters=10)
     out["take_requests_per_s"] = round(KT * 4 / dt_take)  # nreq=4 per row
     out["take_step_us"] = round(dt_take * 1e6, 1)
+    _stage_done("take")
     _log(f"take: {out['take_requests_per_s']:.3g} req/s ({out['take_step_us']} µs/step)")
+
+    del state, other, deltas, hot, reqs  # free HBM before the engine stages
+
+    # -- ingest replay: configs #3/#5 through the HOST path -----------------
+    if _budget_out("ingest replay"):
+        return
+    _stage_ingest_replay(out, B, N, on_accel)
+
+
+def _mk_merge_batch(K: int, B: int, N: int, as_numpy: bool = False):
+    """The shared deterministic delta pattern for the scatter and pallas
+    stages (same multipliers ⇒ their numbers stay comparable)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from patrol_tpu.models.limiter import NANO
+    from patrol_tpu.ops.merge import MergeBatch
+
+    idx = np.arange(K, dtype=np.int64)
+    rows = (idx * 2654435761) % B
+    slots = (idx * 40503) % N
+    added = (idx * 7919) % (10 * NANO)
+    taken = (idx * 104729) % (10 * NANO)
+    elapsed = (idx * 1299709) % (100 * NANO)
+    if as_numpy:
+        return rows, slots, added, taken, elapsed
+    return MergeBatch(
+        rows=jnp.asarray(rows, jnp.int32),
+        slots=jnp.asarray(slots, jnp.int32),
+        added_nt=jnp.asarray(added),
+        taken_nt=jnp.asarray(taken),
+        elapsed_ns=jnp.asarray(elapsed),
+    )
+
+
+def _stage_pallas_compare(out, state, scatter, B, N):
+    """Pallas block-sparse scatter-merge vs XLA scatter at two batch sizes,
+    both through their deployment paths (donated buffers, engine-style).
+    Records per-K timings plus which kernel auto mode would pick; returns
+    the threaded state (both sides donate). No-op off-TPU."""
+    from patrol_tpu.ops import pallas_merge
+
+    if not pallas_merge.native_available():
+        out["pallas"] = "unavailable on " + str(out.get("platform"))
+        return state
+    result = {}
+    for K in (8_192, 131_072):
+        if _left() < 60:
+            out["truncated"] = True
+            break
+        rows, slots, added, taken, elapsed = _mk_merge_batch(K, B, N, as_numpy=True)
+        batch = _mk_merge_batch(K, B, N)
+        _log(f"pallas-vs-xla @K={K} (compiles)…")
+        dt_xla, state = _bench(scatter, state, batch, iters=10)
+
+        def pal(s, *_ignored):
+            return pallas_merge.merge_batch_pallas(s, rows, slots, added, taken, elapsed)
+
+        try:
+            dt_pal, state = _bench(pal, state, iters=10)
+        except Exception as e:
+            result[f"k{K}"] = {"xla_us": round(dt_xla * 1e6, 1), "pallas_error": str(e)[:200]}
+            continue
+        result[f"k{K}"] = {
+            "xla_us": round(dt_xla * 1e6, 1),
+            "pallas_us": round(dt_pal * 1e6, 1),
+            "winner": "pallas" if dt_pal < dt_xla else "xla",
+            "auto_picks_pallas": pallas_merge.auto_pick(rows, B),
+        }
+        _log(f"  K={K}: xla {dt_xla*1e6:.0f}µs vs pallas {dt_pal*1e6:.0f}µs")
+    out["pallas"] = result
+    _stage_done("pallas-compare")
+    return state
+
+
+def _stage_ingest_replay(out, B, N, on_accel) -> None:
+    """Configs #3 and #5 end-to-end through the host feeder: pre-encoded
+    256B wire packets → batch decode (C++ when available) → directory
+    assign → device scatter-merge. This measures the ingest pipeline the
+    Go reference caps at one packet per loop iteration (repo.go:54-92)."""
+    import numpy as np
+
+    from patrol_tpu import native
+    from patrol_tpu.models.limiter import LimiterConfig
+    from patrol_tpu.runtime.engine import DeviceEngine
+
+    n_deltas = int(
+        os.environ.get("PATROL_BENCH_INGEST_DELTAS", 10_000_000 if on_accel else 500_000)
+    )
+    directory_keys = min(B, 1_000_000 if on_accel else 65_536)
+    use_native = native.load() is not None
+    _log(
+        f"ingest replay: {n_deltas} deltas over {directory_keys} keys, "
+        f"codec={'c++' if use_native else 'py'}"
+    )
+
+    cfg = LimiterConfig(buckets=B, nodes=N)
+    engine = DeviceEngine(cfg, node_slot=0)
+    try:
+        chunk = 8_192
+        # Pre-encode ONE chunk of packets (names cycle through the keyspace
+        # per-chunk offset so the directory still sees every key).
+        names = [f"bench-bucket-{i}" for i in range(chunk)]
+        t_decode = t_dir = 0.0
+        done = 0
+        t0 = time.perf_counter()
+        key_off = 0
+        if use_native:
+            pkts, sizes = native.encode_batch(
+                [1.5 + (i % 97) * 0.25 for i in range(chunk)],
+                [0.5 + (i % 89) * 0.125 for i in range(chunk)],
+                [10_000_000 + i for i in range(chunk)],
+                names,
+                [int(i % N) for i in range(chunk)],
+            )
+        while done < n_deltas and _left() > 45:
+            if use_native:
+                td = time.perf_counter()
+                added, taken, elapsed, dnames, slots, valid = native.decode_batch(
+                    pkts, sizes
+                )
+                t_decode += time.perf_counter() - td
+            else:
+                dnames = names
+                slots = np.arange(chunk) % N
+                added = np.full(chunk, 1.5)
+                taken = np.full(chunk, 0.5)
+                elapsed = np.full(chunk, 10_000_000, np.uint64)
+            # rotate the key window so directory_keys distinct names appear
+            base = key_off % max(directory_keys - chunk, 1)
+            key_off += chunk
+            renamed = [f"k{base + i}" for i in range(len(dnames))]
+            tdir = time.perf_counter()
+            engine.ingest_deltas_batch(
+                renamed,
+                [int(s) for s in slots],
+                [int(a * 1e9) for a in added],
+                [int(t * 1e9) for t in taken],
+                [int(e) for e in elapsed],
+            )
+            t_dir += time.perf_counter() - tdir
+            done += chunk
+            while engine.backlog() > 65_536 and _left() > 45:  # backpressure
+                time.sleep(0.001)
+        if not engine.flush(timeout=60):
+            out["truncated"] = True
+            out["ingest_flush_timeout"] = True
+        dt = time.perf_counter() - t0
+        out["ingest_deltas_per_s"] = round(done / dt)
+        out["ingest_deltas"] = done
+        out["ingest_decode_ms"] = round(t_decode * 1e3, 1)
+        out["ingest_feed_ms"] = round(t_dir * 1e3, 1)
+        out["ingest_directory_keys"] = directory_keys
+        if done < n_deltas:
+            out["truncated"] = True
+            out["ingest_truncated_at"] = done
+        _stage_done("ingest-replay")
+        _log(f"ingest: {out['ingest_deltas_per_s']:.3g} deltas/s ({done} total)")
+    finally:
+        engine.stop()
 
 
 if __name__ == "__main__":

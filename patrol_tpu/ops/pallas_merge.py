@@ -256,4 +256,40 @@ def merge_batch_pallas(
 
 
 def available() -> bool:
+    """Pallas importable (interpret-mode capable on CPU — tests use this)."""
     return _PALLAS_OK
+
+
+def native_available() -> bool:
+    """Pallas compiled path usable on the current backend. Interpret mode
+    exists on CPU but is orders of magnitude slower than the XLA scatter,
+    so only an accelerator backend counts."""
+    if not _PALLAS_OK:
+        return False
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+# auto-mode knobs (PATROL_MERGE_KERNEL=auto): pallas wins when the batch is
+# block-sparse — it streams only touched 512-row tiles where the XLA scatter
+# serializes per delta. Tiny batches lose to kernel-launch overhead; near-
+# dense batches should take the vectorized dense path instead. Thresholds
+# are overridable so bench.py's measured crossover can be pinned via env.
+import os as _os
+
+AUTO_MIN_BATCH = int(_os.environ.get("PATROL_PALLAS_MIN_BATCH", "1024"))
+AUTO_BLOCK_FRAC = float(_os.environ.get("PATROL_PALLAS_BLOCK_FRAC", "0.25"))
+
+
+def auto_pick(rows: np.ndarray, num_buckets: int) -> bool:
+    """The PATROL_MERGE_KERNEL=auto heuristic (docstring contract): use the
+    pallas block-sparse kernel iff it can run natively, the batch is big
+    enough to amortize launch, and it touches a small fraction of the
+    state's 512-row blocks."""
+    if len(rows) < AUTO_MIN_BATCH or not native_available():
+        return False
+    touched = len(np.unique(np.asarray(rows) // ROWS_PER_BLOCK))
+    total = max(1, (num_buckets + ROWS_PER_BLOCK - 1) // ROWS_PER_BLOCK)
+    return touched <= total * AUTO_BLOCK_FRAC

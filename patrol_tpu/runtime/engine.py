@@ -403,6 +403,12 @@ class DeviceEngine:
     def ticks(self) -> int:
         return self._ticks
 
+    def backlog(self) -> int:
+        """Queued-but-unapplied work items (takes + deltas): the public
+        backpressure signal for bulk feeders (bench replay, heal ingest)."""
+        with self._cond:
+            return len(self._takes) + len(self._deltas)
+
     # -- engine loop --------------------------------------------------------
 
     def _run(self) -> None:
@@ -495,13 +501,21 @@ class DeviceEngine:
                 log.exception("broadcast hook failed")
 
     def _apply_merges(self, deltas: Sequence[_Delta]) -> None:
-        # Merge-kernel selection: "scatter" (XLA, default) or "pallas"
-        # (block-sparse TPU kernel, ops/pallas_merge.py).
-        if os.environ.get("PATROL_MERGE_KERNEL") == "pallas":
+        # Merge-kernel selection: "scatter" (XLA, default), "pallas" (the
+        # block-sparse TPU kernel whenever it can run natively), or "auto"
+        # (per-batch heuristic: pallas iff the batch is block-sparse,
+        # ops/pallas_merge.py auto_pick).
+        mode = os.environ.get("PATROL_MERGE_KERNEL", "scatter")
+        if mode in ("pallas", "auto"):
             from patrol_tpu.ops import pallas_merge
 
-            if pallas_merge.available():
-                rows = np.array([d.row for d in deltas], np.int64)
+            rows = np.array([d.row for d in deltas], np.int64)
+            use_pallas = (
+                pallas_merge.native_available()
+                if mode == "pallas"
+                else pallas_merge.auto_pick(rows, self.config.buckets)
+            )
+            if use_pallas:
                 slots = np.array([d.slot for d in deltas], np.int64)
                 added = np.array([d.added_nt for d in deltas], np.int64)
                 taken = np.array([d.taken_nt for d in deltas], np.int64)
