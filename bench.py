@@ -388,7 +388,7 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
     n_deltas = int(
         os.environ.get("PATROL_BENCH_INGEST_DELTAS", 10_000_000 if on_accel else 500_000)
     )
-    directory_keys = min(B, 1_000_000 if on_accel else 65_536)
+    directory_keys = max(8_192, min(B, 1_000_000 if on_accel else 65_536))
     use_native = native.load() is not None
     _log(
         f"ingest replay: {n_deltas} deltas over {directory_keys} keys, "
@@ -399,9 +399,10 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
     engine = DeviceEngine(cfg, node_slot=0)
     try:
         chunk = 8_192
-        # Pre-encode ONE chunk of packets (names cycle through the keyspace
-        # per-chunk offset so the directory still sees every key).
+        # Pre-encode ONE chunk of packets; a sliding window over a
+        # pre-built name pool makes the directory see every key.
         names = [f"bench-bucket-{i}" for i in range(chunk)]
+        name_pool = [f"k{j}" for j in range(directory_keys)]
         t_decode = t_dir = 0.0
         done = 0
         t0 = time.perf_counter()
@@ -422,7 +423,6 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
                 )
                 t_decode += time.perf_counter() - td
             else:
-                dnames = names
                 slots = np.arange(chunk) % N
                 added = np.full(chunk, 1.5)
                 taken = np.full(chunk, 0.5)
@@ -430,14 +430,14 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
             # rotate the key window so directory_keys distinct names appear
             base = key_off % max(directory_keys - chunk, 1)
             key_off += chunk
-            renamed = [f"k{base + i}" for i in range(len(dnames))]
+            renamed = name_pool[base : base + chunk]
             tdir = time.perf_counter()
             engine.ingest_deltas_batch(
                 renamed,
-                [int(s) for s in slots],
-                [int(a * 1e9) for a in added],
-                [int(t * 1e9) for t in taken],
-                [int(e) for e in elapsed],
+                np.asarray(slots, np.int64),
+                (np.asarray(added) * 1e9).astype(np.int64),
+                (np.asarray(taken) * 1e9).astype(np.int64),
+                np.asarray(elapsed).astype(np.int64),
             )
             t_dir += time.perf_counter() - tdir
             done += chunk

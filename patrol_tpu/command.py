@@ -129,6 +129,7 @@ class Command:
         def stats() -> dict:
             return {
                 "engine_ticks": engine.ticks,
+                "engine_evictions": engine.evictions,
                 "buckets": len(engine.directory),
                 "node_slot": slots.self_slot,
                 **replicator.stats(),

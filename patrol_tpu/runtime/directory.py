@@ -11,16 +11,23 @@ reference keeps inside ``Bucket``:
 * ``cap_base_nt`` — the lazily-initialized capacity base, the host-side
   mirror of ``if added == 0 { added = capacity }`` (bucket.go:194-196).
 
-Rows are recycled through an LRU-ish second-chance policy only when the pool
-is exhausted *and* the row is idle (no queued work) — eviction of a bucket
-is semantically safe in this protocol: state is soft (re-hydrated from peers
-via incast on next use, repo.go:96-106), exactly like a node restart.
+Row recycling (the dynamic-keyspace story the reference sidesteps by
+growing its map unboundedly, repo.go:200-207): when the pool is spent, the
+engine evicts the least-recently-used *unpinned* rows. Eviction is
+semantically safe in this protocol — bucket state is soft and re-hydrates
+from peers via incast on next use (repo.go:96-106), exactly like a node
+restart. Pins are the correctness mechanism: every queued work item
+(take ticket, replication delta) pins its row so in-flight work can never
+land on a row that was recycled under it. Eviction is three-phase —
+``pick_victims`` unbinds names and returns rows in limbo (unreachable:
+not looked up, not allocatable), the engine zeroes the device rows, then
+``recycle`` returns them to the free list.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -42,6 +49,10 @@ class BucketDirectory:
         self.created_ns = np.zeros(capacity, dtype=np.int64)
         self.cap_base_nt = np.zeros(capacity, dtype=np.int64)
         self.last_used_ns = np.zeros(capacity, dtype=np.int64)
+        # In-flight reference counts: a pinned row is never an eviction
+        # victim. Guarded by _mu (numpy += is not atomic).
+        self.pins = np.zeros(capacity, dtype=np.int32)
+        self._bound = np.zeros(capacity, dtype=bool)
 
     def __len__(self) -> int:
         return len(self._rows)
@@ -51,24 +62,68 @@ class BucketDirectory:
         # path, repo.go:192-198).
         return self._rows.get(name)
 
-    def assign(self, name: str, now_ns: int) -> Tuple[int, bool]:
+    def free_rows(self) -> int:
+        """Rows allocatable without eviction (approximate outside _mu)."""
+        return len(self._free) + (self.capacity - self._next_fresh)
+
+    def assign(self, name: str, now_ns: int, pin: bool = False) -> Tuple[int, bool]:
         """Get-or-create: returns (row, created). Stamps ``created_ns`` from
-        the caller's clock on creation (repo.go:205)."""
-        row = self._rows.get(name)
-        if row is not None:
-            self.last_used_ns[row] = now_ns
-            return row, False
+        the caller's clock on creation (repo.go:205). ``pin=True`` takes an
+        in-flight reference the caller must release via :meth:`unpin_rows`."""
         with self._mu:
             row = self._rows.get(name)
-            if row is not None:
-                return row, False
-            row = self._alloc_locked()
-            self._rows[name] = row
-            self._names[row] = name
-            self.created_ns[row] = now_ns
-            self.cap_base_nt[row] = 0
+            created = False
+            if row is None:
+                row = self._alloc_locked()
+                self._rows[name] = row
+                self._names[row] = name
+                self._bound[row] = True
+                self.created_ns[row] = now_ns
+                self.cap_base_nt[row] = 0
+                created = True
             self.last_used_ns[row] = now_ns
-            return row, True
+            if pin:
+                self.pins[row] += 1
+            return row, created
+
+    def assign_many(
+        self, names: Sequence[str], now_ns: int, pin: bool = False
+    ) -> np.ndarray:
+        """Vectorized get-or-create for a delta chunk: one lock acquisition,
+        C-speed dict lookups. Atomic against eviction: if the pool cannot
+        absorb every missing name, raises DirectoryFullError having
+        assigned/pinned NOTHING (so the engine can evict and retry the whole
+        chunk without leaking pins)."""
+        get = self._rows.get
+        with self._mu:
+            rows = list(map(get, names))
+            missing = [i for i, r in enumerate(rows) if r is None]
+            if missing:
+                # Count distinct new names before touching anything, so a
+                # full pool raises with zero rows assigned or pinned.
+                fresh: Dict[str, int] = {names[i]: -1 for i in missing}
+                need = len(fresh)
+                if need > len(self._free) + (self.capacity - self._next_fresh):
+                    raise DirectoryFullError(
+                        f"bucket directory needs {need} rows, pool spent"
+                    )
+                for i in missing:
+                    nm = names[i]
+                    r = fresh[nm]
+                    if r < 0:
+                        r = self._alloc_locked()
+                        fresh[nm] = r
+                        self._rows[nm] = r
+                        self._names[r] = nm
+                        self._bound[r] = True
+                        self.created_ns[r] = now_ns
+                        self.cap_base_nt[r] = 0
+                    rows[i] = r
+            arr = np.asarray(rows, dtype=np.int64)
+            self.last_used_ns[arr] = now_ns
+            if pin:
+                np.add.at(self.pins, arr, 1)
+            return arr
 
     def _alloc_locked(self) -> int:
         if self._free:
@@ -82,14 +137,76 @@ class BucketDirectory:
             "evict or grow the pool"
         )
 
-    def release(self, name: str) -> Optional[int]:
-        """Drop a name→row binding and recycle the row. The caller must zero
-        the device row before reuse (the engine does this lazily)."""
+    def unpin_rows(self, rows) -> None:
+        """Release in-flight references taken by ``assign(..., pin=True)``."""
+        with self._mu:
+            np.subtract.at(self.pins, np.asarray(rows, dtype=np.int64), 1)
+
+    def pick_victims(self, k: int) -> np.ndarray:
+        """Phase 1 of eviction: unbind up to ``k`` least-recently-used
+        unpinned rows and return them in limbo — unreachable via lookup and
+        not yet allocatable. The caller must zero the device rows, then
+        :meth:`recycle`. Returns an empty array when everything is pinned."""
+        with self._mu:
+            eligible = self._bound & (self.pins == 0)
+            idx = np.flatnonzero(eligible)
+            if idx.size == 0:
+                return np.empty(0, dtype=np.int64)
+            k = min(k, idx.size)
+            if k < idx.size:
+                part = np.argpartition(self.last_used_ns[idx], k - 1)[:k]
+                victims = idx[part]
+            else:
+                victims = idx
+            for r in victims:
+                r = int(r)
+                name = self._names[r]
+                if name is not None:
+                    del self._rows[name]
+                    self._names[r] = None
+                self._bound[r] = False
+            return victims.astype(np.int64)
+
+    def recycle(self, rows) -> None:
+        """Phase 3 of eviction: return zeroed limbo rows to the free list."""
+        with self._mu:
+            self._free.extend(int(r) for r in rows)
+
+    def unbind(self, name: str) -> Optional[int]:
+        """Drop a name→row binding, leaving the row in limbo (not free, not
+        reachable). The caller zeroes the device row, then :meth:`recycle`s."""
         with self._mu:
             row = self._rows.pop(name, None)
             if row is None:
                 return None
             self._names[row] = None
+            self._bound[row] = False
+            return row
+
+    def unbind_if_unpinned(self, name: str) -> Tuple[Optional[int], bool]:
+        """Like :meth:`unbind`, but refuses while in-flight work pins the
+        row. → (row-or-None, bound): ``(None, True)`` means "exists but
+        pinned, try again"."""
+        with self._mu:
+            row = self._rows.get(name)
+            if row is None:
+                return None, False
+            if self.pins[row] > 0:
+                return None, True
+            del self._rows[name]
+            self._names[row] = None
+            self._bound[row] = False
+            return row, True
+
+    def release(self, name: str) -> Optional[int]:
+        """Drop a name→row binding and recycle the row. The caller must zero
+        the device row before reuse (the engine does this eagerly)."""
+        with self._mu:
+            row = self._rows.pop(name, None)
+            if row is None:
+                return None
+            self._names[row] = None
+            self._bound[row] = False
             self._free.append(row)
             return row
 

@@ -30,7 +30,7 @@ import threading
 import time
 from collections import deque
 from functools import lru_cache
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +42,7 @@ from patrol_tpu.ops.merge import MergeBatch, merge_batch, read_rows
 from patrol_tpu.ops.rate import Rate
 from patrol_tpu.ops.take import TakeRequest, take_batch, remaining_for_request
 from patrol_tpu.runtime.bucket import ClockFn, system_clock
-from patrol_tpu.runtime.directory import BucketDirectory
+from patrol_tpu.runtime.directory import BucketDirectory, DirectoryFullError
 
 log = logging.getLogger("patrol.engine")
 
@@ -70,6 +70,7 @@ class TakeTicket:
         "_callbacks",
         "remaining",
         "ok",
+        "deferred",
     )
 
     def __init__(self, name: str, row: int, rate: Rate, count: int, now_ns: int):
@@ -83,15 +84,25 @@ class TakeTicket:
         self._callbacks: List[Callable[[], None]] = []
         self.remaining: int = 0
         self.ok: bool = False
+        # True while re-queued by _group_tickets (rate-key conflict): such a
+        # ticket is still live in the queue — failure paths must not
+        # complete/unpin it (engine thread only; no lock needed).
+        self.deferred = False
 
-    def complete(self, remaining: int, ok: bool) -> None:
+    def complete(self, remaining: int, ok: bool) -> bool:
+        """Returns True on the first completion (False if already done) —
+        the engine unpins the ticket's directory row exactly on that
+        transition."""
         with self._mu:
+            if self._event.is_set():
+                return False
             self.remaining = remaining
             self.ok = ok
             self._event.set()
             callbacks, self._callbacks = self._callbacks, []
         for cb in callbacks:
             cb()
+        return True
 
     def add_done_callback(self, cb: Callable[[], None]) -> None:
         """Invoke ``cb`` once completed (immediately if already done).
@@ -119,6 +130,35 @@ class _Delta:
         self.elapsed_ns = max(elapsed_ns, 0)
 
 
+class _DeltaChunk:
+    """A pre-vectorized batch of deltas (bulk ingest path): five parallel
+    int64 numpy arrays, already clamped non-negative and slot-validated."""
+
+    __slots__ = ("rows", "slots", "added_nt", "taken_nt", "elapsed_ns", "n")
+
+    def __init__(self, rows, slots, added_nt, taken_nt, elapsed_ns):
+        self.rows = rows
+        self.slots = slots
+        self.added_nt = added_nt
+        self.taken_nt = taken_nt
+        self.elapsed_ns = elapsed_ns
+        self.n = len(rows)
+
+
+class DeltaArrays(NamedTuple):
+    """One tick's drained replication deltas, in arrival order, as flat
+    int64 numpy arrays — the canonical form both engines consume."""
+
+    rows: np.ndarray
+    slots: np.ndarray
+    added_nt: np.ndarray
+    taken_nt: np.ndarray
+    elapsed_ns: np.ndarray
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+
 def _pad_size(n: int, lo: int = 8, hi: int = MAX_MERGE_ROWS) -> int:
     """Next power of two ≥ n, bounded — keeps the jit-variant count ~log."""
     size = lo
@@ -127,14 +167,11 @@ def _pad_size(n: int, lo: int = 8, hi: int = MAX_MERGE_ROWS) -> int:
     return size
 
 
-@lru_cache(maxsize=64)
-def _jit_take(k: int, node_slot: int):
-    return jax.jit(take_batch, static_argnames=("node_slot",), donate_argnums=0)
+@lru_cache(maxsize=1)
+def _jit_zero_rows():
+    from patrol_tpu.ops.merge import zero_rows
 
-
-@lru_cache(maxsize=64)
-def _jit_merge(k: int):
-    return jax.jit(merge_batch, donate_argnums=0)
+    return jax.jit(zero_rows, donate_argnums=0)
 
 
 # Packed-transfer variants: host↔device latency is dominated by per-array
@@ -203,13 +240,73 @@ class DeviceEngine:
         # Kernel calls donate the state buffers (zero-copy update); this lock
         # keeps introspection readers off a donated-and-deleted array.
         self._state_mu = threading.Lock()
+        # Serializes evictions (pick victims → zero device rows → recycle);
+        # concurrent assigners that hit a spent pool queue up behind it.
+        self._evict_mu = threading.Lock()
         self._takes: deque = deque()
         self._deltas: deque = deque()
         self._stopped = False
         self._busy = False
         self._ticks = 0  # device calls issued (observability)
+        self._evictions = 0  # rows recycled under pool pressure
         self._thread = threading.Thread(target=self._run, name="patrol-engine", daemon=True)
         self._thread.start()
+
+    # -- eviction (the dynamic-keyspace story; VERDICT r1 item 3) -----------
+
+    def _evict(self, need: int) -> int:
+        """Reclaim at least ``need`` rows: unbind the LRU unpinned rows,
+        zero their device state in one batch, recycle the slots. Evicts a
+        swath per trip so pool-exhaustion doesn't thrash. Caller must hold
+        ``_evict_mu``. Returns rows reclaimed (0 ⇒ everything is pinned)."""
+        # A fraction of the pool per trip: big enough to amortize the device
+        # zeroing call, small enough that recently-used buckets survive.
+        swath = min(4096, max(1, self.config.buckets // 8))
+        victims = self.directory.pick_victims(max(need, swath))
+        if victims.size == 0:
+            return 0
+        k = _pad_size(int(victims.size), lo=8, hi=1 << 20)
+        rows = np.full(k, victims[0], np.int32)  # pad dupes: zeroing twice is fine
+        rows[: victims.size] = victims
+        with self._state_mu:
+            self.state = _jit_zero_rows()(self.state, jnp.asarray(rows))
+        self.directory.recycle(victims)
+        self._evictions += int(victims.size)
+        log.info("evicted %d idle buckets (pool pressure)", victims.size)
+        return int(victims.size)
+
+    def _assign_pinned(self, name: str, now: int) -> Tuple[int, bool]:
+        """Directory assign with second-chance eviction on a spent pool.
+        Loops because concurrent fast-path assigners may consume freed rows
+        before we re-try; each iteration that evicts makes global progress.
+        Raises DirectoryFullError only when every row is mid-flight."""
+        try:
+            return self.directory.assign(name, now, pin=True)
+        except DirectoryFullError:
+            pass
+        with self._evict_mu:
+            while True:
+                try:
+                    return self.directory.assign(name, now, pin=True)
+                except DirectoryFullError:
+                    if self._evict(1) == 0:
+                        raise
+
+    def _assign_many_pinned(self, names: Sequence[str], now: int):
+        """Batch form of :meth:`_assign_pinned`; returns rows or None when
+        the pool is spent with every row pinned (callers drop the batch —
+        replication is loss-tolerant)."""
+        try:
+            return self.directory.assign_many(names, now, pin=True)
+        except DirectoryFullError:
+            pass
+        with self._evict_mu:
+            while True:
+                try:
+                    return self.directory.assign_many(names, now, pin=True)
+                except DirectoryFullError:
+                    if self._evict(len(names)) == 0:
+                        return None
 
     # -- entry points -------------------------------------------------------
 
@@ -219,7 +316,7 @@ class DeviceEngine:
         """Queue a take; returns (ticket, created). ``created`` mirrors the
         get-or-create miss signal that triggers incast (repo.go:96-106)."""
         now = self.clock() if now_ns is None else now_ns
-        row, created = self.directory.assign(name, now)
+        row, created = self._assign_pinned(name, now)
         self.directory.init_cap_base(row, rate.freq * NANO)
         ticket = TakeTicket(name, row, rate, count, now)
         with self._cond:
@@ -236,12 +333,18 @@ class DeviceEngine:
         return ticket.remaining, ticket.ok, created
 
     def ingest_delta(self, state: wire.WireState, slot: int) -> bool:
-        """Queue one replication delta for merge; returns created flag."""
+        """Queue one replication delta for merge; returns created flag.
+        Dropped (not an error) if the pool is spent with everything pinned —
+        replication is loss-tolerant by CRDT design (README.md:41-43)."""
         now = self.clock()
-        row, created = self.directory.assign(state.name, now)
         if not 0 <= slot < self.config.nodes:
             log.warning("delta slot %d out of range, dropped", slot)
-            return created
+            return False
+        try:
+            row, created = self._assign_pinned(state.name, now)
+        except DirectoryFullError:
+            log.warning("pool spent (all pinned); delta for %r dropped", state.name)
+            return False
         delta = _Delta(row, slot, state.added_nt, state.taken_nt, state.elapsed_ns)
         with self._cond:
             self._deltas.append(delta)
@@ -256,23 +359,46 @@ class DeviceEngine:
         taken_nt: Sequence[int],
         elapsed_ns: Sequence[int],
     ) -> int:
-        """Bulk ingest from the native receive path: one directory pass, one
-        queue append, one wake-up. Returns deltas accepted."""
+        """Bulk ingest from the native receive path: one vectorized
+        directory pass, one queue append, one wake-up — the feeder loop the
+        Go reference runs one packet per iteration (repo.go:54-92).
+        Returns deltas accepted (the whole batch is dropped only when the
+        pool is spent with every row pinned)."""
         now = self.clock()
-        out = []
-        for i, name in enumerate(names):
-            slot = int(slots[i])
-            if not 0 <= slot < self.config.nodes:
+        slots_a = np.asarray(slots, dtype=np.int64)
+        keep = (slots_a >= 0) & (slots_a < self.config.nodes)
+        if not keep.all():
+            idx = np.flatnonzero(keep)
+            names = [names[i] for i in idx]
+            slots_a = slots_a[idx]
+            added_nt = np.asarray(added_nt, dtype=np.int64)[idx]
+            taken_nt = np.asarray(taken_nt, dtype=np.int64)[idx]
+            elapsed_ns = np.asarray(elapsed_ns, dtype=np.int64)[idx]
+        if not len(names):
+            return 0
+        accepted = 0
+        # Split oversize batches so one chunk never exceeds a tick's budget.
+        for lo in range(0, len(names), MAX_MERGE_ROWS):
+            hi = lo + MAX_MERGE_ROWS
+            chunk_names = names[lo:hi]
+            rows = self._assign_many_pinned(chunk_names, now)
+            if rows is None:
+                log.warning(
+                    "pool spent (all pinned); %d deltas dropped", len(chunk_names)
+                )
                 continue
-            row, _ = self.directory.assign(name, now)
-            out.append(
-                _Delta(row, slot, int(added_nt[i]), int(taken_nt[i]), int(elapsed_ns[i]))
+            chunk = _DeltaChunk(
+                rows,
+                slots_a[lo:hi],
+                np.maximum(np.asarray(added_nt[lo:hi], dtype=np.int64), 0),
+                np.maximum(np.asarray(taken_nt[lo:hi], dtype=np.int64), 0),
+                np.maximum(np.asarray(elapsed_ns[lo:hi], dtype=np.int64), 0),
             )
-        if out:
             with self._cond:
-                self._deltas.extend(out)
+                self._deltas.append(chunk)
                 self._cond.notify()
-        return len(out)
+            accepted += chunk.n
+        return accepted
 
     def read_rows(self, rows) -> tuple:
         """Donation-safe gather of per-bucket state: returns (pn[K,N,2],
@@ -296,6 +422,8 @@ class DeviceEngine:
         if row is None:
             return []
         pn_rows, elapsed_rows = self.read_rows([row])
+        if self.directory.lookup(name) != row:
+            return []  # evicted mid-read
         pn = pn_rows[0]  # [N, 2]
         elapsed = int(elapsed_rows[0])
         out = []
@@ -307,20 +435,29 @@ class DeviceEngine:
             out.append(wire.from_nanotokens(name, 0, 0, elapsed, origin_slot=self.node_slot))
         return out
 
-    def release_bucket(self, name: str) -> bool:
-        """Evict a bucket: zero its device row and recycle the slot. The
-        bucket's state survives on peers and re-hydrates via incast on next
-        use — the same soft-state story as a node restart (SURVEY §5)."""
-        self.flush()
-        row = self.directory.release(name)
-        if row is None:
-            return False
-        from patrol_tpu.ops.merge import zero_rows
-
-        with self._state_mu:
-            self.state = jax.jit(zero_rows, donate_argnums=0)(
-                self.state, jnp.array([row], jnp.int32)
-            )
+    def release_bucket(self, name: str, timeout: float = 5.0) -> bool:
+        """Evict one bucket by name: unbind, zero its device row, recycle.
+        The bucket's state survives on peers and re-hydrates via incast on
+        next use — the same soft-state story as a node restart (SURVEY §5).
+        Unbind-before-zero ordering (the eviction protocol's limbo phase)
+        keeps a concurrently re-created bucket from seeing stale state, and
+        a pinned row (in-flight take/delta) is waited out, never yanked."""
+        deadline = time.monotonic() + timeout
+        with self._evict_mu:
+            while True:
+                row, bound = self.directory.unbind_if_unpinned(name)
+                if row is not None:
+                    break
+                if not bound:
+                    return False
+                self.flush(timeout=max(0.0, deadline - time.monotonic()))
+                if time.monotonic() >= deadline:
+                    return False
+            with self._state_mu:
+                self.state = _jit_zero_rows()(
+                    self.state, jnp.array([row], jnp.int32)
+                )
+            self.directory.recycle([row])
         return True
 
     def snapshot_many(self, names: Sequence[str]) -> Dict[str, List[wire.WireState]]:
@@ -332,7 +469,9 @@ class DeviceEngine:
             return {}
         pn_rows, elapsed_rows = self.read_rows([r for _, r in known])
         out: Dict[str, List[wire.WireState]] = {}
-        for i, (name, _row) in enumerate(known):
+        for i, (name, row) in enumerate(known):
+            if self.directory.lookup(name) != row:
+                continue  # evicted mid-read: don't leak another bucket's state
             pn = pn_rows[i]
             elapsed = int(elapsed_rows[i])
             states = [
@@ -403,11 +542,18 @@ class DeviceEngine:
     def ticks(self) -> int:
         return self._ticks
 
+    @property
+    def evictions(self) -> int:
+        return self._evictions
+
     def backlog(self) -> int:
-        """Queued-but-unapplied work items (takes + deltas): the public
-        backpressure signal for bulk feeders (bench replay, heal ingest)."""
+        """Queued-but-unapplied work rows (takes + deltas, counting each
+        delta inside a bulk chunk): the public backpressure signal for bulk
+        feeders (bench replay, heal ingest)."""
         with self._cond:
-            return len(self._takes) + len(self._deltas)
+            return len(self._takes) + sum(
+                d.n if isinstance(d, _DeltaChunk) else 1 for d in self._deltas
+            )
 
     # -- engine loop --------------------------------------------------------
 
@@ -418,16 +564,19 @@ class DeviceEngine:
                     self._cond.wait()
                 if self._stopped and not (self._takes or self._deltas):
                     return
-                deltas = self._drain(self._deltas, MAX_MERGE_ROWS)
+                deltas = self._drain_deltas(MAX_MERGE_ROWS)
                 tickets = self._drain(self._takes, MAX_TAKE_ROWS)
                 self._busy = True
             try:
                 self._apply(deltas, tickets)
             except Exception:  # pragma: no cover - engine must never die
                 log.exception("engine tick failed")
-                for t in tickets:
-                    t.complete(0, False)
+                self._fail_tickets(tickets)
             finally:
+                if deltas is not None:
+                    # Deltas are done (applied or lost with the tick): their
+                    # in-flight row pins release here, success or not.
+                    self.directory.unpin_rows(deltas.rows)
                 with self._cond:
                     self._busy = False
 
@@ -438,10 +587,56 @@ class DeviceEngine:
             out.append(q.popleft())
         return out
 
-    def _apply(self, deltas: Sequence[_Delta], tickets: Sequence[TakeTicket]) -> None:
+    def _drain_deltas(self, limit: int) -> Optional[DeltaArrays]:
+        """Pop queued deltas (singles and pre-vectorized chunks) up to a row
+        budget, concatenated into flat arrays in arrival order. Called under
+        ``_cond``. A chunk is never split; one oversized-first chunk may
+        exceed the budget alone."""
+        q = self._deltas
+        items: list = []
+        total = 0
+        while q:
+            n = q[0].n if isinstance(q[0], _DeltaChunk) else 1
+            if total and total + n > limit:
+                break
+            items.append(q.popleft())
+            total += n
+        if not items:
+            return None
+        rows = np.empty(total, np.int64)
+        slots = np.empty(total, np.int64)
+        added = np.empty(total, np.int64)
+        taken = np.empty(total, np.int64)
+        elapsed = np.empty(total, np.int64)
+        at = 0
+        for it in items:
+            if isinstance(it, _DeltaChunk):
+                rows[at : at + it.n] = it.rows
+                slots[at : at + it.n] = it.slots
+                added[at : at + it.n] = it.added_nt
+                taken[at : at + it.n] = it.taken_nt
+                elapsed[at : at + it.n] = it.elapsed_ns
+                at += it.n
+            else:
+                rows[at] = it.row
+                slots[at] = it.slot
+                added[at] = it.added_nt
+                taken[at] = it.taken_nt
+                elapsed[at] = it.elapsed_ns
+                at += 1
+        return DeltaArrays(rows, slots, added, taken, elapsed)
+
+    def _fail_tickets(self, tickets: Sequence[TakeTicket]) -> None:
+        unpin = [
+            t.row for t in tickets if not t.deferred and t.complete(0, False)
+        ]
+        if unpin:
+            self.directory.unpin_rows(unpin)
+
+    def _apply(self, deltas: Optional[DeltaArrays], tickets: Sequence[TakeTicket]) -> None:
         """One tick's work. Subclasses may fuse both phases into a single
         device call (MeshEngine)."""
-        if deltas:
+        if deltas is not None:
             self._apply_merges(deltas)
         if tickets:
             self._apply_takes(tickets)
@@ -454,6 +649,7 @@ class DeviceEngine:
         row_key: Dict[int, tuple] = {}
         deferred: List[TakeTicket] = []
         for t in tickets:
+            t.deferred = False  # drained from the queue this tick
             key = (t.row, t.rate.freq, t.rate.per_ns, t.count)
             held = row_key.get(t.row)
             if held is None:
@@ -464,14 +660,18 @@ class DeviceEngine:
             else:
                 deferred.append(t)
         if deferred:
+            for t in deferred:
+                t.deferred = True
             with self._cond:
                 self._takes.extendleft(reversed(deferred))
                 self._cond.notify()
         return list(groups.keys()), groups
 
     def _complete_groups(self, keys, groups, have, admitted, own_a, own_t, elapsed) -> None:
-        """Fan per-group kernel results out to tickets + broadcast hook."""
+        """Fan per-group kernel results out to tickets + broadcast hook.
+        Completion releases each ticket's directory pin."""
         broadcasts: List[wire.WireState] = []
+        unpin: List[int] = []
         for i, key in enumerate(keys):
             ts = groups[key]
             c_nt = ts[0].count * NANO
@@ -479,7 +679,8 @@ class DeviceEngine:
                 remaining, ok = remaining_for_request(
                     int(have[i]), int(admitted[i]), c_nt, idx
                 )
-                t.complete(remaining, ok)
+                if t.complete(remaining, ok):
+                    unpin.append(t.row)
             # Replicate this node's lane. The reference broadcasts full state
             # on every take, success or not (api.go:74, README.md:41-43); we
             # skip only when our lane is still all-zero — a zero state on the
@@ -494,13 +695,15 @@ class DeviceEngine:
                         origin_slot=self.node_slot,
                     )
                 )
+        if unpin:
+            self.directory.unpin_rows(unpin)
         if broadcasts and self.on_broadcast is not None:
             try:
                 self.on_broadcast(broadcasts)
             except Exception:  # pragma: no cover
                 log.exception("broadcast hook failed")
 
-    def _apply_merges(self, deltas: Sequence[_Delta]) -> None:
+    def _apply_merges(self, deltas: DeltaArrays) -> None:
         # Merge-kernel selection: "scatter" (XLA, default), "pallas" (the
         # block-sparse TPU kernel whenever it can run natively), or "auto"
         # (per-batch heuristic: pallas iff the batch is block-sparse,
@@ -509,31 +712,31 @@ class DeviceEngine:
         if mode in ("pallas", "auto"):
             from patrol_tpu.ops import pallas_merge
 
-            rows = np.array([d.row for d in deltas], np.int64)
             use_pallas = (
                 pallas_merge.native_available()
                 if mode == "pallas"
-                else pallas_merge.auto_pick(rows, self.config.buckets)
+                else pallas_merge.auto_pick(deltas.rows, self.config.buckets)
             )
             if use_pallas:
-                slots = np.array([d.slot for d in deltas], np.int64)
-                added = np.array([d.added_nt for d in deltas], np.int64)
-                taken = np.array([d.taken_nt for d in deltas], np.int64)
-                elapsed = np.array([d.elapsed_ns for d in deltas], np.int64)
                 with self._state_mu:
                     self.state = pallas_merge.merge_batch_pallas(
-                        self.state, rows, slots, added, taken, elapsed
+                        self.state,
+                        deltas.rows,
+                        deltas.slots,
+                        deltas.added_nt,
+                        deltas.taken_nt,
+                        deltas.elapsed_ns,
                     )
                 self._ticks += 1
                 return
-        k = _pad_size(len(deltas))
+        n = len(deltas)
+        k = _pad_size(n)
         packed = np.zeros((5, k), dtype=np.int64)
-        for i, d in enumerate(deltas):
-            packed[0, i] = d.row
-            packed[1, i] = d.slot
-            packed[2, i] = d.added_nt
-            packed[3, i] = d.taken_nt
-            packed[4, i] = d.elapsed_ns
+        packed[0, :n] = deltas.rows
+        packed[1, :n] = deltas.slots
+        packed[2, :n] = deltas.added_nt
+        packed[3, :n] = deltas.taken_nt
+        packed[4, :n] = deltas.elapsed_ns
         with self._state_mu:
             self.state = _jit_merge_packed()(self.state, jnp.asarray(packed))
         self._ticks += 1

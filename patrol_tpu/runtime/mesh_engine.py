@@ -26,9 +26,9 @@ from patrol_tpu.parallel import topology as topo
 from patrol_tpu.runtime.bucket import ClockFn, system_clock
 from patrol_tpu.runtime.engine import (
     BroadcastFn,
+    DeltaArrays,
     DeviceEngine,
     TakeTicket,
-    _Delta,
     _pad_size,
 )
 
@@ -57,11 +57,14 @@ class MeshEngine(DeviceEngine):
 
     # -- tick ---------------------------------------------------------------
 
-    def _apply(self, deltas: Sequence[_Delta], tickets: Sequence[TakeTicket]) -> None:
+    def _apply(
+        self, deltas: Optional[DeltaArrays], tickets: Sequence[TakeTicket]
+    ) -> None:
         keys, groups = self._group_tickets(tickets) if tickets else ([], {})
 
         plan = self.plan
         B = plan.blocks
+        d_rows = deltas.rows.tolist() if deltas is not None else []
 
         # Per-block occupancy → padded block capacity.
         fill_t = [0] * B
@@ -76,8 +79,8 @@ class MeshEngine(DeviceEngine):
 
         fill_d = [0] * B
         d_placed: List[int] = []
-        for i, d in enumerate(deltas):
-            shard, _ = divmod(d.row, plan.rows_per_shard)
+        for i, row in enumerate(d_rows):
+            shard, _ = divmod(row, plan.rows_per_shard)
             replica = i % plan.replicas
             blk = plan.block_index(replica, shard)
             d_placed.append(blk)
@@ -100,9 +103,19 @@ class MeshEngine(DeviceEngine):
                     int(self.directory.created_ns[first.row]),
                 )
             )
-        delta_tuples = [
-            (d.row, d.slot, d.added_nt, d.taken_nt, d.elapsed_ns) for d in deltas
-        ]
+        delta_tuples = (
+            list(
+                zip(
+                    d_rows,
+                    deltas.slots.tolist(),
+                    deltas.added_nt.tolist(),
+                    deltas.taken_nt.tolist(),
+                    deltas.elapsed_ns.tolist(),
+                )
+            )
+            if deltas is not None
+            else []
+        )
 
         req, mb = topo.route_requests(plan, takes, delta_tuples, k_take, k_merge)
         with self._state_mu:

@@ -125,6 +125,17 @@ class TestTakeRoute:
         status, body = srv.request("POST", "/take/sp%20ace?rate=5:1s")
         assert (status, body) == (200, "4")
 
+    def test_keyspace_beyond_pool_never_500s(self, srv):
+        """VERDICT r1 item 3 at the HTTP layer: 4× the slot pool of distinct
+        bucket names through /take — every response is a clean 200 (LRU
+        eviction recycles slots; no DirectoryFullError 500s)."""
+        pool = srv.engine.config.buckets
+        for i in range(4 * pool):
+            srv.clock_ns += 1_000_000
+            status, body = srv.request("POST", f"/take/flood-{i}?rate=5:1s")
+            assert (status, body) == (200, "4"), f"key {i}: {status} {body}"
+        assert srv.engine.evictions > 0
+
 
 class TestDebugRoutes:
     def test_pprof_index(self, srv):

@@ -165,6 +165,89 @@ class TestEviction:
     def test_release_unknown(self, engine):
         assert not engine.release_bucket("nope")
 
+    def test_keyspace_overflow_recycles_lru(self, engine):
+        """VERDICT r1 item 3: keyspace = 4× pool through the take path with
+        zero failures — the pool self-recycles via LRU eviction instead of
+        erroring (the reference grows unboundedly, repo.go:200-207)."""
+        clock = engine.clock
+        for i in range(4 * CFG.buckets):
+            remaining, ok, _ = engine.take(f"key-{i}", RATE, 1)
+            assert ok and remaining == 9  # every key admits as a fresh bucket
+            clock.advance(1)  # distinct LRU stamps
+        assert len(engine.directory) <= CFG.buckets
+        assert engine.evictions >= 3 * CFG.buckets - CFG.buckets  # recycled a lot
+
+    def test_hot_survivor_keeps_state_across_evictions(self, engine):
+        """A recently-used bucket must survive pool churn with its limit
+        intact: LRU picks idle victims, not the hot key."""
+        clock = engine.clock
+        for _ in range(10):
+            _, ok, _ = engine.take("hot", RATE, 1)
+            assert ok
+        _, ok, _ = engine.take("hot", RATE, 1)
+        assert not ok  # drained
+        # Flood 4× the pool in cold keys, touching "hot" between batches so
+        # it is never the LRU victim.
+        for i in range(4 * CFG.buckets):
+            clock.advance(1)
+            engine.take(f"cold-{i}", RATE, 1)
+            if i % 16 == 0:
+                _, ok, _ = engine.take("hot", RATE, 1)
+                assert not ok  # still drained ⇒ state survived, no reset
+        assert engine.evictions > 0
+        _, ok, _ = engine.take("hot", RATE, 1)
+        assert not ok
+
+    def test_pinned_rows_are_never_victims(self):
+        d = BucketDirectory(4)
+        rows = {}
+        for i, name in enumerate(["a", "b", "c", "d"]):
+            rows[name], _ = d.assign(name, i, pin=(name in ("a", "b")))
+        victims = d.pick_victims(4)
+        assert sorted(int(v) for v in victims) == sorted([rows["c"], rows["d"]])
+        assert d.lookup("a") is not None and d.lookup("b") is not None
+        assert d.lookup("c") is None and d.lookup("d") is None
+        d.recycle(victims)
+        assert d.free_rows() == 2
+        # all-pinned pool: nothing evictable
+        assert d.pick_victims(4).size == 0
+
+    def test_assign_many_is_atomic_on_full(self):
+        d = BucketDirectory(4)
+        d.assign("a", 0)
+        d.assign("b", 0)
+        with pytest.raises(DirectoryFullError):
+            d.assign_many(["x", "y", "z"], 1, pin=True)
+        # nothing partially assigned or pinned
+        assert len(d) == 2 and d.pins.sum() == 0
+        rows = d.assign_many(["x", "y"], 1, pin=True)
+        assert len(d) == 4 and list(d.pins[rows]) == [1, 1]
+        d.unpin_rows(rows)
+        assert d.pins.sum() == 0
+
+    def test_assign_many_dedupes_names(self):
+        d = BucketDirectory(4)
+        rows = d.assign_many(["k", "k", "j", "k"], 5, pin=True)
+        assert rows[0] == rows[1] == rows[3] != rows[2]
+        assert len(d) == 2
+        assert d.pins[rows[0]] == 3 and d.pins[rows[2]] == 1
+
+    def test_bulk_ingest_takes_vectorized_path(self, engine):
+        """ingest_deltas_batch must land deltas identically to singles."""
+        n = engine.config.nodes
+        engine.ingest_deltas_batch(
+            ["v", "v", "w"],
+            [1, 2, 1],
+            [2 * NANO, 3 * NANO, NANO],
+            [NANO, 0, 0],
+            [5, 7, 9],
+        )
+        engine.flush()
+        by_slot = {s.origin_slot: s for s in engine.snapshot("v")}
+        assert by_slot[1].added_nt == 2 * NANO and by_slot[1].taken_nt == NANO
+        assert by_slot[2].added_nt == 3 * NANO
+        assert engine.snapshot("w")[0].added_nt == NANO
+
 
 class TestTPURepo:
     def test_incast_on_miss_once(self, engine):
