@@ -47,6 +47,9 @@ FLAG_PADDED = 0x8
 FLAG_PRIORITY = 0x20
 
 MAX_FRAME_SIZE = 16384  # we never exceed the default peer setting
+DEFAULT_WINDOW = 65535  # RFC 7540 §6.9.2 initial flow-control window
+
+SETTINGS_INITIAL_WINDOW_SIZE = 0x4
 
 
 # -- libnghttp2 HPACK inflater ----------------------------------------------
@@ -219,6 +222,17 @@ class H2Connection:
         self.closed = False
         # streams collecting header blocks across CONTINUATION frames
         self._pending: Dict[int, dict] = {}
+        # -- send-side flow control (RFC 7540 §6.9) --------------------------
+        # send_response may run on another thread than receive(), so window
+        # state and the deferred-body queue share one lock.
+        self._fc_mu = threading.Lock()
+        self._conn_window = DEFAULT_WINDOW
+        self._initial_window = DEFAULT_WINDOW
+        self._stream_windows: Dict[int, int] = {}
+        # stream_id -> remaining body bytes awaiting window (END_STREAM is
+        # implied: every response we frame ends its stream).
+        self._deferred: Dict[int, memoryview] = {}
+        self._deferred_order: List[int] = []
 
     # -- input --------------------------------------------------------------
 
@@ -257,13 +271,33 @@ class H2Connection:
         if ftype == SETTINGS:
             if flags & FLAG_ACK:
                 return b""
-            return frame(SETTINGS, FLAG_ACK, 0, b"")
+            self._apply_settings(payload)
+            return frame(SETTINGS, FLAG_ACK, 0, b"") + self._flush_deferred()
         if ftype == PING:
             if flags & FLAG_ACK:
                 return b""
             return frame(PING, FLAG_ACK, 0, payload)
-        if ftype == WINDOW_UPDATE or ftype == PRIORITY or ftype == RST_STREAM:
-            self._pending.pop(stream_id, None) if ftype == RST_STREAM else None
+        if ftype == WINDOW_UPDATE:
+            if len(payload) >= 4:
+                increment = int.from_bytes(payload[:4], "big") & 0x7FFFFFFF
+                with self._fc_mu:
+                    if stream_id == 0:
+                        self._conn_window += increment
+                    elif stream_id in self._stream_windows:
+                        # Unknown ids are finished streams (entries are
+                        # created at HEADERS, removed at END_STREAM); late
+                        # updates for them must not re-create entries or
+                        # the map would grow per-stream forever.
+                        self._stream_windows[stream_id] += increment
+            return self._flush_deferred()
+        if ftype == PRIORITY:
+            return b""
+        if ftype == RST_STREAM:
+            self._pending.pop(stream_id, None)
+            with self._fc_mu:
+                if self._deferred.pop(stream_id, None) is not None:
+                    self._deferred_order.remove(stream_id)
+                self._stream_windows.pop(stream_id, None)
             return b""
         if ftype == GOAWAY:
             self.closed = True
@@ -285,6 +319,9 @@ class H2Connection:
                 block = block[5:]
             if pad:
                 block = block[: len(block) - pad]
+            if stream_id not in self._pending:
+                with self._fc_mu:
+                    self._stream_windows.setdefault(stream_id, self._initial_window)
             st = self._pending.setdefault(
                 stream_id, {"block": b"", "end_stream": False, "headers_done": False}
             )
@@ -326,11 +363,59 @@ class H2Connection:
     ) -> bytes:
         hdrs = encode_response_headers(status, ctype, len(body))
         out = bytearray(frame(HEADERS, FLAG_END_HEADERS, stream_id, hdrs))
-        if body:
-            for off in range(0, len(body), MAX_FRAME_SIZE):
-                chunk = body[off : off + MAX_FRAME_SIZE]
-                last = off + MAX_FRAME_SIZE >= len(body)
-                out += frame(DATA, FLAG_END_STREAM if last else 0, stream_id, chunk)
-        else:
-            out += frame(DATA, FLAG_END_STREAM, stream_id, b"")
+        with self._fc_mu:
+            out += self._send_data_locked(stream_id, memoryview(body))
         return bytes(out)
+
+    def _apply_settings(self, payload: bytes) -> None:
+        for off in range(0, len(payload) - 5, 6):
+            ident = int.from_bytes(payload[off : off + 2], "big")
+            value = int.from_bytes(payload[off + 2 : off + 6], "big")
+            if ident == SETTINGS_INITIAL_WINDOW_SIZE:
+                with self._fc_mu:
+                    # §6.9.2: adjust every open stream's window by the delta
+                    # (windows may go negative; sends resume on updates).
+                    delta = value - self._initial_window
+                    self._initial_window = value
+                    for sid in self._stream_windows:
+                        self._stream_windows[sid] += delta
+
+    def _send_data_locked(self, stream_id: int, data: memoryview) -> bytes:
+        """Frame as much of ``data`` as the connection and stream windows
+        allow (zero-length END_STREAM frames are always allowed, §6.9);
+        park the remainder for :meth:`_flush_deferred`."""
+        out = bytearray()
+        if len(data) == 0:
+            out += frame(DATA, FLAG_END_STREAM, stream_id, b"")
+            self._stream_windows.pop(stream_id, None)
+            return bytes(out)
+        win = self._stream_windows.setdefault(stream_id, self._initial_window)
+        while len(data) > 0:
+            allow = min(len(data), MAX_FRAME_SIZE, self._conn_window, win)
+            if allow <= 0:
+                if stream_id not in self._deferred:
+                    self._deferred_order.append(stream_id)
+                self._deferred[stream_id] = data
+                self._stream_windows[stream_id] = win
+                return bytes(out)
+            chunk = bytes(data[:allow])
+            data = data[allow:]
+            self._conn_window -= allow
+            win -= allow
+            last = len(data) == 0
+            out += frame(DATA, FLAG_END_STREAM if last else 0, stream_id, chunk)
+        self._stream_windows.pop(stream_id, None)
+        return bytes(out)
+
+    def _flush_deferred(self) -> bytes:
+        with self._fc_mu:
+            if not self._deferred:
+                return b""
+            out = bytearray()
+            for sid in list(self._deferred_order):
+                data = self._deferred.pop(sid)
+                self._deferred_order.remove(sid)
+                out += self._send_data_locked(sid, data)
+                if self._conn_window <= 0:
+                    break
+            return bytes(out)

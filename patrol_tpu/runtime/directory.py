@@ -103,7 +103,7 @@ class BucketDirectory:
                 # full pool raises with zero rows assigned or pinned.
                 fresh: Dict[str, int] = {names[i]: -1 for i in missing}
                 need = len(fresh)
-                if need > len(self._free) + (self.capacity - self._next_fresh):
+                if need > self.free_rows():
                     raise DirectoryFullError(
                         f"bucket directory needs {need} rows, pool spent"
                     )

@@ -38,7 +38,7 @@ import numpy as np
 
 from patrol_tpu.models.limiter import NANO, LimiterConfig, LimiterState, init_state
 from patrol_tpu.ops import wire
-from patrol_tpu.ops.merge import MergeBatch, merge_batch, read_rows
+from patrol_tpu.ops.merge import MergeBatch, merge_batch, read_rows, zero_rows_jit
 from patrol_tpu.ops.rate import Rate
 from patrol_tpu.ops.take import TakeRequest, take_batch, remaining_for_request
 from patrol_tpu.runtime.bucket import ClockFn, system_clock
@@ -167,13 +167,6 @@ def _pad_size(n: int, lo: int = 8, hi: int = MAX_MERGE_ROWS) -> int:
     return size
 
 
-@lru_cache(maxsize=1)
-def _jit_zero_rows():
-    from patrol_tpu.ops.merge import zero_rows
-
-    return jax.jit(zero_rows, donate_argnums=0)
-
-
 # Packed-transfer variants: host↔device latency is dominated by per-array
 # transfer setup (~50µs each on this stack), so the engine ships ONE
 # int64[8,K] request matrix and receives ONE int64[5,K] result matrix per
@@ -269,28 +262,31 @@ class DeviceEngine:
         rows = np.full(k, victims[0], np.int32)  # pad dupes: zeroing twice is fine
         rows[: victims.size] = victims
         with self._state_mu:
-            self.state = _jit_zero_rows()(self.state, jnp.asarray(rows))
+            self.state = zero_rows_jit(self.state, jnp.asarray(rows))
         self.directory.recycle(victims)
         self._evictions += int(victims.size)
         log.info("evicted %d idle buckets (pool pressure)", victims.size)
         return int(victims.size)
 
-    def _assign_pinned(self, name: str, now: int) -> Tuple[int, bool]:
+    def assign_row(self, name: str, now: int, pin: bool = False) -> Tuple[int, bool]:
         """Directory assign with second-chance eviction on a spent pool.
         Loops because concurrent fast-path assigners may consume freed rows
         before we re-try; each iteration that evicts makes global progress.
         Raises DirectoryFullError only when every row is mid-flight."""
         try:
-            return self.directory.assign(name, now, pin=True)
+            return self.directory.assign(name, now, pin=pin)
         except DirectoryFullError:
             pass
         with self._evict_mu:
             while True:
                 try:
-                    return self.directory.assign(name, now, pin=True)
+                    return self.directory.assign(name, now, pin=pin)
                 except DirectoryFullError:
                     if self._evict(1) == 0:
                         raise
+
+    def _assign_pinned(self, name: str, now: int) -> Tuple[int, bool]:
+        return self.assign_row(name, now, pin=True)
 
     def _assign_many_pinned(self, names: Sequence[str], now: int):
         """Batch form of :meth:`_assign_pinned`; returns rows or None when
@@ -454,7 +450,7 @@ class DeviceEngine:
                 if time.monotonic() >= deadline:
                     return False
             with self._state_mu:
-                self.state = _jit_zero_rows()(
+                self.state = zero_rows_jit(
                     self.state, jnp.array([row], jnp.int32)
                 )
             self.directory.recycle([row])
@@ -566,6 +562,12 @@ class DeviceEngine:
                     return
                 deltas = self._drain_deltas(MAX_MERGE_ROWS)
                 tickets = self._drain(self._takes, MAX_TAKE_ROWS)
+                # Clear the re-queue marker at drain time, not in
+                # _group_tickets: if the tick dies before grouping runs, a
+                # stale True from a prior tick would make _fail_tickets skip
+                # the ticket and hang its caller while leaking the row pin.
+                for t in tickets:
+                    t.deferred = False
                 self._busy = True
             try:
                 self._apply(deltas, tickets)
@@ -649,7 +651,6 @@ class DeviceEngine:
         row_key: Dict[int, tuple] = {}
         deferred: List[TakeTicket] = []
         for t in tickets:
-            t.deferred = False  # drained from the queue this tick
             key = (t.row, t.rate.freq, t.rate.per_ns, t.count)
             held = row_key.get(t.row)
             if held is None:

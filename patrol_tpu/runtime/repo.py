@@ -113,7 +113,10 @@ class TPURepo:
         row = self.engine.directory.lookup(name)
         existed = row is not None
         if row is None:
-            row, _ = self.engine.directory.assign(name, self.engine.clock())
+            # assign_row (not directory.assign): evicts idle rows when the
+            # pool is spent, so keyspace > pool stays a supported state on
+            # the introspection surface too.
+            row, _ = self.engine.assign_row(name, self.engine.clock())
             self._maybe_incast(name)
         pn_rows, elapsed_rows = self.engine.read_rows([row])
         pn = pn_rows[0]

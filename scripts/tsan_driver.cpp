@@ -1,0 +1,111 @@
+// TSan exercise driver for patrol_host.cpp (the C++ host network path).
+//
+// The library is deliberately stateless (all state is per-fd kernel state
+// or caller-owned buffers), but the production process calls it from
+// multiple threads: the replication receive loop and the broadcast path
+// share one socket fd, while encode/decode run on the engine feeder
+// thread. This driver reproduces that concurrency shape — two senders,
+// two receivers, and two codec threads hammering a loopback socket pair —
+// so `-fsanitize=thread` can prove the no-shared-mutable-state claim.
+//
+// Reference concurrency bar: Go's `-race` on `go test ./...`
+// (repo.go:13-14 documents the Repo thread-safety contract).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int pt_udp_open(const char* ip, uint16_t port);
+int pt_udp_port(int fd);
+void pt_udp_close(int fd);
+int pt_recv_batch(int fd, uint8_t* buf, int max_packets, int* sizes,
+                  uint32_t* ips, uint16_t* ports, int timeout_ms);
+int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes, int n,
+                   const uint32_t* peer_ips, const uint16_t* peer_ports,
+                   int n_peers);
+int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
+                    double* added, double* taken, uint64_t* elapsed,
+                    uint8_t* names, int* name_lens, int* origin_slots);
+int pt_encode_batch(const double* added, const double* taken,
+                    const uint64_t* elapsed, const uint8_t* names,
+                    const int* name_lens, const int* origin_slots, int n,
+                    uint8_t* out, int* out_sizes);
+}
+
+static constexpr int PACKET = 256;
+static constexpr int BATCH = 64;
+static constexpr int ROUNDS = 200;
+
+int main() {
+  int tx = pt_udp_open("127.0.0.1", 0);
+  int rx = pt_udp_open("127.0.0.1", 0);
+  if (tx < 0 || rx < 0) {
+    fprintf(stderr, "socket open failed\n");
+    return 1;
+  }
+  uint32_t loop_ip = (127u << 24) | 1u;
+  uint16_t rx_port = static_cast<uint16_t>(pt_udp_port(rx));
+
+  std::atomic<long> received{0};
+  std::atomic<bool> stop{false};
+
+  auto sender = [&](int seed) {
+    double added[BATCH], taken[BATCH];
+    uint64_t elapsed[BATCH];
+    uint8_t names[BATCH * PACKET];
+    int name_lens[BATCH], slots[BATCH], sizes[BATCH];
+    uint8_t out[BATCH * PACKET];
+    for (int r = 0; r < ROUNDS && !stop.load(); ++r) {
+      for (int i = 0; i < BATCH; ++i) {
+        added[i] = seed + i + r * 0.5;
+        taken[i] = i * 0.25;
+        elapsed[i] = static_cast<uint64_t>(r) * 1000 + i;
+        int n = snprintf(reinterpret_cast<char*>(names + i * PACKET), PACKET,
+                         "bucket-%d-%d", seed, i);
+        name_lens[i] = n;
+        slots[i] = i & 0xFF;
+      }
+      pt_encode_batch(added, taken, elapsed, names, name_lens, slots, BATCH,
+                      out, sizes);
+      pt_send_fanout(tx, out, sizes, BATCH, &loop_ip, &rx_port, 1);
+    }
+  };
+
+  auto receiver = [&]() {
+    uint8_t buf[BATCH * PACKET];
+    int sizes[BATCH];
+    uint32_t ips[BATCH];
+    uint16_t ports[BATCH];
+    double added[BATCH], taken[BATCH];
+    uint64_t elapsed[BATCH];
+    uint8_t names[BATCH * PACKET];
+    int name_lens[BATCH], slots[BATCH];
+    while (!stop.load()) {
+      int n = pt_recv_batch(rx, buf, BATCH, sizes, ips, ports, 50);
+      if (n <= 0) continue;
+      pt_decode_batch(buf, sizes, n, added, taken, elapsed, names, name_lens,
+                      slots);
+      received.fetch_add(n);
+    }
+  };
+
+  std::vector<std::thread> threads;
+  threads.emplace_back(receiver);
+  threads.emplace_back(receiver);
+  threads.emplace_back(sender, 1);
+  threads.emplace_back(sender, 2);
+  for (int i = 2; i < 4; ++i) threads[i].join();
+  // drain, then stop receivers
+  std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  stop.store(true);
+  threads[0].join();
+  threads[1].join();
+  pt_udp_close(tx);
+  pt_udp_close(rx);
+  printf("tsan driver ok: %ld packets received\n", received.load());
+  return 0;
+}

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# ThreadSanitizer build + run for the C++ host network path.
+#
+# Equivalent of the reference's implicit `go test -race` contract
+# (SURVEY.md §5 "Race detection"): builds patrol_host.cpp with
+# -fsanitize=thread and runs a multi-threaded send/recv/codec driver;
+# any TSan report makes the run exit non-zero (halt_on_error=1).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=$(mktemp -d)
+trap 'rm -rf "$OUT"' EXIT
+
+g++ -std=c++17 -O1 -g -fsanitize=thread -fPIC \
+    -o "$OUT/tsan_driver" \
+    scripts/tsan_driver.cpp patrol_tpu/native/patrol_host.cpp \
+    -DPT_NO_MAIN -lpthread
+
+TSAN_OPTIONS="halt_on_error=1" "$OUT/tsan_driver"
+echo "TSan: clean"

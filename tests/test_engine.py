@@ -250,6 +250,19 @@ class TestEviction:
 
 
 class TestTPURepo:
+    def test_get_bucket_evicts_on_spent_pool(self, engine):
+        """get_bucket must ride the same eviction path as the take path:
+        a spent pool evicts an idle row instead of raising."""
+        repo = TPURepo(engine)
+        clock = engine.clock
+        for i in range(CFG.buckets):
+            engine.take(f"fill-{i}", RATE, 1)
+            clock.advance(1)
+        engine.flush()
+        b, existed = repo.get_bucket("fresh-after-full")
+        assert not existed and b.name == "fresh-after-full"
+        assert len(engine.directory) <= CFG.buckets
+
     def test_incast_on_miss_once(self, engine):
         asked = []
         repo = TPURepo(engine, send_incast=asked.append, incast_ttl_s=10.0)

@@ -144,3 +144,92 @@ class TestHpackEncoding:
         long_val = "x" * 500
         block = h2._encode_literal(b"k", long_val.encode())
         assert dec.decode(block) == [(b"k", long_val.encode())]
+
+
+def _parse_frames(raw):
+    frames = []
+    off = 0
+    while off + 9 <= len(raw):
+        ln = int.from_bytes(raw[off : off + 3], "big")
+        ftype, flags = raw[off + 3], raw[off + 4]
+        sid = int.from_bytes(raw[off + 5 : off + 9], "big") & 0x7FFFFFFF
+        frames.append((ftype, flags, sid, raw[off + 9 : off + 9 + ln]))
+        off += 9 + ln
+    return frames
+
+
+def _settings(**entries):
+    ids = {"initial_window": 0x4}
+    payload = b"".join(
+        int.to_bytes(ids[k], 2, "big") + int.to_bytes(v, 4, "big")
+        for k, v in entries.items()
+    )
+    return h2.frame(h2.SETTINGS, 0, 0, payload)
+
+
+class TestFlowControl:
+    """RFC 7540 §6.9: DATA must not exceed the peer's advertised windows."""
+
+    def _conn(self):
+        requests = []
+        c = h2.H2Connection(lambda *a: requests.append(a))
+        c.receive(h2.PREFACE)
+        return c, requests
+
+    def test_small_initial_window_defers_body(self):
+        c, _ = self._conn()
+        c.receive(_settings(initial_window=10))
+        out = c.send_response(1, 200, b"A" * 35, "text/plain")
+        data = [f for f in _parse_frames(out) if f[0] == h2.DATA]
+        assert sum(len(f[3]) for f in data) == 10
+        assert not any(f[1] & h2.FLAG_END_STREAM for f in data)
+        # stream-level WINDOW_UPDATE releases 10 more
+        out = c.receive(h2.frame(h2.WINDOW_UPDATE, 0, 1, int.to_bytes(10, 4, "big")))
+        data = [f for f in _parse_frames(out) if f[0] == h2.DATA]
+        assert sum(len(f[3]) for f in data) == 10
+        # release the rest; final frame carries END_STREAM
+        out = c.receive(h2.frame(h2.WINDOW_UPDATE, 0, 1, int.to_bytes(100, 4, "big")))
+        data = [f for f in _parse_frames(out) if f[0] == h2.DATA]
+        assert sum(len(f[3]) for f in data) == 15
+        assert data[-1][1] & h2.FLAG_END_STREAM
+
+    def test_connection_window_shared_across_streams(self):
+        c, _ = self._conn()
+        big = b"B" * h2.DEFAULT_WINDOW
+        out = c.send_response(1, 200, big, "text/plain")
+        sent = sum(len(f[3]) for f in _parse_frames(out) if f[0] == h2.DATA)
+        assert sent == h2.DEFAULT_WINDOW  # connection window exhausted
+        out = c.send_response(3, 200, b"C" * 5, "text/plain")
+        assert not [f for f in _parse_frames(out) if f[0] == h2.DATA]
+        # connection-level update flushes stream 3's parked body too
+        out = c.receive(h2.frame(h2.WINDOW_UPDATE, 0, 0, int.to_bytes(1000, 4, "big")))
+        data = [f for f in _parse_frames(out) if f[0] == h2.DATA]
+        assert {f[2] for f in data} == {3}
+        assert sum(len(f[3]) for f in data) == 5
+
+    def test_settings_delta_applies_to_open_streams(self):
+        c, _ = self._conn()
+        c.receive(_settings(initial_window=5))
+        out = c.send_response(1, 200, b"D" * 20, "text/plain")
+        assert sum(len(f[3]) for f in _parse_frames(out) if f[0] == h2.DATA) == 5
+        # raising INITIAL_WINDOW_SIZE retroactively credits stream 1 (§6.9.2)
+        out = c.receive(_settings(initial_window=50))
+        data = [f for f in _parse_frames(out) if f[0] == h2.DATA]
+        assert sum(len(f[3]) for f in data) == 15
+        assert data[-1][1] & h2.FLAG_END_STREAM
+
+    def test_rst_stream_drops_deferred(self):
+        c, _ = self._conn()
+        c.receive(_settings(initial_window=0))
+        out = c.send_response(1, 200, b"E" * 8, "text/plain")
+        assert not [f for f in _parse_frames(out) if f[0] == h2.DATA]
+        c.receive(h2.frame(h2.RST_STREAM, 0, 1, int.to_bytes(8, 4, "big")))
+        out = c.receive(h2.frame(h2.WINDOW_UPDATE, 0, 0, int.to_bytes(100, 4, "big")))
+        assert not [f for f in _parse_frames(out) if f[0] == h2.DATA]
+
+    def test_empty_body_always_allowed(self):
+        c, _ = self._conn()
+        c.receive(_settings(initial_window=0))
+        out = c.send_response(1, 204, b"", "text/plain")
+        data = [f for f in _parse_frames(out) if f[0] == h2.DATA]
+        assert len(data) == 1 and data[0][1] & h2.FLAG_END_STREAM
