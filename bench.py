@@ -418,7 +418,7 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
         while done < n_deltas and _left() > 45:
             if use_native:
                 td = time.perf_counter()
-                added, taken, elapsed, dnames, slots, valid = native.decode_batch(
+                added, taken, elapsed, dnames, slots, valid, *_rest = native.decode_batch(
                     pkts, sizes
                 )
                 t_decode += time.perf_counter() - td

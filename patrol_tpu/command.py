@@ -130,6 +130,7 @@ class Command:
             return {
                 "engine_ticks": engine.ticks,
                 "engine_evictions": engine.evictions,
+                "engine_scalar_dropped": engine.scalar_dropped,
                 "buckets": len(engine.directory),
                 "node_slot": slots.self_slot,
                 **replicator.stats(),

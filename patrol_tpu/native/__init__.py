@@ -34,6 +34,7 @@ _u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
 _u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
 _f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
 _u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 
 
 def _build() -> bool:
@@ -79,10 +80,12 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pt_send_fanout.restype = ctypes.c_int
         lib.pt_decode_batch.argtypes = [
             _u8p, _i32p, ctypes.c_int, _f64p, _f64p, _u64p, _u8p, _i32p, _i32p,
+            _i64p, _i64p, _i64p,
         ]
         lib.pt_decode_batch.restype = ctypes.c_int
         lib.pt_encode_batch.argtypes = [
-            _f64p, _f64p, _u64p, _u8p, _i32p, _i32p, ctypes.c_int, _u8p, _i32p,
+            _f64p, _f64p, _u64p, _u8p, _i32p, _i32p, _i64p, _i64p, _i64p,
+            ctypes.c_int, _u8p, _i32p,
         ]
         lib.pt_encode_batch.restype = ctypes.c_int
         _lib = lib
@@ -148,7 +151,8 @@ class NativeSocket:
 
 def decode_batch(packets: np.ndarray, sizes: np.ndarray):
     """Vectorized wire decode → (added[f64], taken[f64], elapsed[i64],
-    names[list[str]], origin_slots[i32], valid[bool])."""
+    names[list[str]], origin_slots[i32], valid[bool], caps[i64], lane_added
+    [i64], lane_taken[i64]) — caps/lane values in nanotokens, -1 = absent."""
     lib = load()
     n = len(packets)
     added = np.zeros(n, np.float64)
@@ -157,10 +161,13 @@ def decode_batch(packets: np.ndarray, sizes: np.ndarray):
     names = np.zeros((n, PACKET), np.uint8)
     name_lens = np.zeros(n, np.int32)
     slots = np.zeros(n, np.int32)
+    caps = np.zeros(n, np.int64)
+    lane_a = np.zeros(n, np.int64)
+    lane_t = np.zeros(n, np.int64)
     lib.pt_decode_batch(
         np.ascontiguousarray(packets, np.uint8),
         np.ascontiguousarray(sizes, np.int32),
-        n, added, taken, elapsed, names, name_lens, slots,
+        n, added, taken, elapsed, names, name_lens, slots, caps, lane_a, lane_t,
     )
     valid = name_lens >= 0
     out_names: List[str] = [
@@ -169,7 +176,10 @@ def decode_batch(packets: np.ndarray, sizes: np.ndarray):
         else ""
         for i in range(n)
     ]
-    return added, taken, elapsed.astype(np.int64), out_names, slots, valid
+    return (
+        added, taken, elapsed.astype(np.int64), out_names, slots, valid,
+        caps, lane_a, lane_t,
+    )
 
 
 def encode_batch(
@@ -178,9 +188,14 @@ def encode_batch(
     elapsed_ns: Sequence[int],
     names: Sequence[str],
     origin_slots: Sequence[int],
+    caps: Optional[Sequence[int]] = None,
+    lane_added: Optional[Sequence[int]] = None,
+    lane_taken: Optional[Sequence[int]] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Vectorized wire encode → (packets[n,256], sizes[n]); size -1 marks a
-    state whose name was too large (caller decides; see replication)."""
+    state whose name was too large (caller decides; see replication).
+    ``caps``/``lane_added``/``lane_taken`` are per-state nanotoken values
+    (-1 = omit from the trailer); omitted entirely ⇒ base-form trailers."""
     lib = load()
     n = len(names)
     name_buf = np.zeros((n, PACKET), np.uint8)
@@ -192,12 +207,19 @@ def encode_batch(
             name_buf[i, : len(raw)] = np.frombuffer(raw, np.uint8)
     out = np.zeros((n, PACKET), np.uint8)
     out_sizes = np.zeros(n, np.int32)
+
+    def _i64(vals):
+        if vals is None:
+            return np.full(n, -1, np.int64)
+        return np.ascontiguousarray(np.asarray(vals, np.int64))
+
     lib.pt_encode_batch(
         np.ascontiguousarray(np.asarray(added, np.float64)),
         np.ascontiguousarray(np.asarray(taken, np.float64)),
         np.ascontiguousarray(np.asarray(elapsed_ns, np.int64).view(np.uint64)),
         name_buf, name_lens,
         np.ascontiguousarray(np.asarray(origin_slots, np.int32)),
+        _i64(caps), _i64(lane_added), _i64(lane_taken),
         n, out, out_sizes,
     )
     return out, out_sizes

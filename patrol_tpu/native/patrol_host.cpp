@@ -33,7 +33,9 @@ namespace {
 
 constexpr int kPacketSize = 256;
 constexpr int kFixedSize = 25;
-constexpr int kTrailerSize = 6;
+constexpr int kTrailerSize = 6;       // base form: P2 | flags=0 | slot u16 | ck
+constexpr int kTrailerCapSize = 14;   // with-cap:  P2 | flags=1 | slot u16 | cap u64 | ck
+constexpr int kTrailerLaneSize = 30;  // lane: P2 | flags=3 | slot | cap | lane_a | lane_t | ck
 constexpr int kMaxBatch = 1024;
 
 inline uint64_t load_be64(const uint8_t* p) {
@@ -196,16 +198,22 @@ int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes,
 // Decode n packets (each ≤256B at 256B stride). Outputs per packet:
 //   added/taken (float64 tokens), elapsed (uint64 ns, two's complement),
 //   name bytes copied into names at 256B stride with name_lens set,
-//   origin_slots (-1 when no valid v2 trailer). Malformed packets get
-//   name_lens[i] = -1. Returns count of valid packets.
+//   origin_slots (-1 when no valid v2 trailer), caps (sender capacity base
+//   in int64 nanotokens; -1 when absent — v1 or base-form trailer),
+//   lane_added/lane_taken (exact own-lane PN values; -1 when absent).
+// Malformed packets get name_lens[i] = -1. Returns count of valid packets.
 int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
                     double* added, double* taken, uint64_t* elapsed,
-                    uint8_t* names, int* name_lens, int* origin_slots) {
+                    uint8_t* names, int* name_lens, int* origin_slots,
+                    int64_t* caps, int64_t* lane_added, int64_t* lane_taken) {
   int ok = 0;
   for (int i = 0; i < n; i++) {
     const uint8_t* p = packets + i * kPacketSize;
     int sz = sizes[i];
     origin_slots[i] = -1;
+    caps[i] = -1;
+    lane_added[i] = -1;
+    lane_taken[i] = -1;
     if (sz < kFixedSize) {
       name_lens[i] = -1;
       continue;
@@ -223,10 +231,31 @@ int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
     const uint8_t* tail = p + kFixedSize + nlen;
     int tail_len = sz - kFixedSize - nlen;
     if (tail_len >= kTrailerSize && tail[0] == 'P' && tail[1] == '2') {
-      uint8_t sum = 0;
-      for (int t = 0; t < kTrailerSize - 1; t++) sum += tail[t];
-      if (sum == tail[kTrailerSize - 1]) {
-        origin_slots[i] = (tail[3] << 8) | tail[4];
+      bool with_cap = (tail[2] & 0x01) != 0;
+      bool with_lane = (tail[2] & 0x02) != 0;
+      int tsz = with_lane ? kTrailerLaneSize
+                          : (with_cap ? kTrailerCapSize : kTrailerSize);
+      if (tail_len >= tsz && (!with_lane || with_cap)) {
+        uint8_t sum = 0;
+        for (int t = 0; t < tsz - 1; t++) sum += tail[t];
+        if (sum == tail[tsz - 1]) {
+          // Bit-63 values are hostile (non-negative int64 counts by
+          // contract). All-or-nothing: any invalid field discards the WHOLE
+          // trailer (packet degrades to v1 / deficit-attribution ingest) —
+          // a partially-honored lane trailer would merge the header's
+          // aggregate into one lane and permanently inflate the PN sum.
+          uint64_t cap = with_cap ? load_be64(tail + 5) : 0;
+          uint64_t la = with_lane ? load_be64(tail + 13) : 0;
+          uint64_t lt = with_lane ? load_be64(tail + 21) : 0;
+          if (cap < (1ULL << 63) && la < (1ULL << 63) && lt < (1ULL << 63)) {
+            origin_slots[i] = (tail[3] << 8) | tail[4];
+            if (with_cap) caps[i] = static_cast<int64_t>(cap);
+            if (with_lane) {
+              lane_added[i] = static_cast<int64_t>(la);
+              lane_taken[i] = static_cast<int64_t>(lt);
+            }
+          }
+        }
       }
     }
     ok++;
@@ -235,19 +264,29 @@ int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
 }
 
 // Encode n states into packets at 256B stride. names at 256B stride with
-// name_lens; origin_slots ≥ 0 appends the v2 trailer (callers must keep
-// names ≤ 225 bytes then; ≤ 231 otherwise — oversize gets out_sizes[i] = -1).
-// Returns count encoded.
+// name_lens; origin_slots ≥ 0 appends the v2 trailer — the 30-byte lane
+// form when caps[i] ≥ 0 and lane_added[i]/lane_taken[i] ≥ 0 (names ≤ 201),
+// the 14-byte with-cap form when only caps[i] ≥ 0 (names ≤ 217), the 6-byte
+// base form otherwise (names ≤ 225; ≤ 231 with no trailer — oversize gets
+// out_sizes[i] = -1). Returns count encoded.
 int pt_encode_batch(const double* added, const double* taken,
                     const uint64_t* elapsed, const uint8_t* names,
-                    const int* name_lens, const int* origin_slots, int n,
+                    const int* name_lens, const int* origin_slots,
+                    const int64_t* caps, const int64_t* lane_added,
+                    const int64_t* lane_taken, int n,
                     uint8_t* out, int* out_sizes) {
   int ok = 0;
   for (int i = 0; i < n; i++) {
     uint8_t* p = out + i * kPacketSize;
     int nlen = name_lens[i];
     bool with_trailer = origin_slots[i] >= 0;
-    int limit = kPacketSize - kFixedSize - (with_trailer ? kTrailerSize : 0);
+    bool with_cap = with_trailer && caps[i] >= 0;
+    bool with_lane = with_cap && lane_added[i] >= 0 && lane_taken[i] >= 0;
+    int tsz = with_trailer
+                  ? (with_lane ? kTrailerLaneSize
+                               : (with_cap ? kTrailerCapSize : kTrailerSize))
+                  : 0;
+    int limit = kPacketSize - kFixedSize - tsz;
     if (nlen < 0 || nlen > limit) {
       out_sizes[i] = -1;
       continue;
@@ -262,11 +301,20 @@ int pt_encode_batch(const double* added, const double* taken,
       uint8_t* t = p + sz;
       t[0] = 'P';
       t[1] = '2';
-      t[2] = 0;  // flags
+      t[2] = static_cast<uint8_t>((with_cap ? 1 : 0) | (with_lane ? 2 : 0));
       t[3] = static_cast<uint8_t>((origin_slots[i] >> 8) & 0xFF);
       t[4] = static_cast<uint8_t>(origin_slots[i] & 0xFF);
-      t[5] = static_cast<uint8_t>(t[0] + t[1] + t[2] + t[3] + t[4]);
-      sz += kTrailerSize;
+      if (with_cap) {
+        store_be64(t + 5, static_cast<uint64_t>(caps[i]));
+      }
+      if (with_lane) {
+        store_be64(t + 13, static_cast<uint64_t>(lane_added[i]));
+        store_be64(t + 21, static_cast<uint64_t>(lane_taken[i]));
+      }
+      uint8_t sum = 0;
+      for (int b = 0; b < tsz - 1; b++) sum += t[b];
+      t[tsz - 1] = sum;
+      sz += tsz;
     }
     out_sizes[i] = sz;
     ok++;

@@ -90,10 +90,13 @@ class NativeReplicator:
             if n == 0 or self.repo is None:
                 continue
             self.rx_packets += n
-            added, taken, elapsed, names, slots, valid = native.decode_batch(
-                packets, sizes
+            (
+                added, taken, elapsed, names, slots, valid, caps, lane_a, lane_t,
+            ) = native.decode_batch(packets, sizes)
+            b_names, b_slots, b_added, b_taken, b_elapsed, b_caps = (
+                [], [], [], [], [], [],
             )
-            b_names, b_slots, b_added, b_taken, b_elapsed = [], [], [], [], []
+            b_lane_a, b_lane_t, b_scalar = [], [], []
             incasts: list = []
             for i in range(n):
                 if not valid[i]:
@@ -108,6 +111,11 @@ class NativeReplicator:
                     incasts.append((names[i], int(ips[i]), int(ports[i])))
                     continue
                 slot = int(slots[i])
+                # No valid trailer ⇒ v1 (reference) peer: sender-address slot
+                # table + scalar deficit-attribution semantics. A base
+                # (cap-less) trailer is a prior-version patrol peer whose
+                # header carries raw own-lane values (lane merge).
+                no_trailer = slot < 0
                 if not 0 <= slot < self.slots.max_slots:
                     resolved = self.slots.resolve((_u32_to_ip(int(ips[i])), int(ports[i])))
                     if resolved is None:
@@ -119,9 +127,17 @@ class NativeReplicator:
                 b_added.append(wire._sanitize_nt(float(added[i])))
                 b_taken.append(wire._sanitize_nt(float(taken[i])))
                 b_elapsed.append(max(int(elapsed[i]), 0))
+                # −1 ⇒ field absent. See ingest_deltas_batch for the
+                # per-delta wire-semantics contract.
+                b_caps.append(int(caps[i]))
+                b_lane_a.append(int(lane_a[i]))
+                b_lane_t.append(int(lane_t[i]))
+                b_scalar.append(no_trailer)
             if b_names:
                 self.repo.engine.ingest_deltas_batch(
-                    b_names, b_slots, b_added, b_taken, b_elapsed
+                    b_names, b_slots, b_added, b_taken, b_elapsed,
+                    caps_nt=b_caps, lane_added_nt=b_lane_a, lane_taken_nt=b_lane_t,
+                    scalar=b_scalar,
                 )
             if incasts:
                 self._reply_incasts(incasts)
@@ -139,10 +155,13 @@ class NativeReplicator:
                 [s.elapsed_ns for s in states],
                 [s.name for s in states],
                 [s.origin_slot if s.origin_slot is not None else -1 for s in states],
+                [s.cap_nt if s.cap_nt is not None else -1 for s in states],
+                [s.lane_added_nt if s.lane_added_nt is not None else -1 for s in states],
+                [s.lane_taken_nt if s.lane_taken_nt is not None else -1 for s in states],
             )
-            ok = sizes >= 0
+            pkts, sizes = self._retry_oversize(states, pkts, sizes)
             self.tx_packets += self.sock.send_fanout(
-                pkts[ok], sizes[ok], np.array([ip], np.uint32), np.array([port], np.uint16)
+                pkts, sizes, np.array([ip], np.uint32), np.array([port], np.uint16)
             )
 
     # -- send path ----------------------------------------------------------
@@ -169,24 +188,34 @@ class NativeReplicator:
             [s.elapsed_ns for s in states],
             [s.name for s in states],
             slots,
+            [s.cap_nt if s.cap_nt is not None else -1 for s in states],
+            [s.lane_added_nt if s.lane_added_nt is not None else -1 for s in states],
+            [s.lane_taken_nt if s.lane_taken_nt is not None else -1 for s in states],
         )
-        bad = sizes < 0
-        if bad.any():
-            # Names too large with the trailer: resend those without it
-            # (receivers fall back to the sender-address slot table).
-            retry_idx = np.flatnonzero(bad)
-            r_pkts, r_sizes = native.encode_batch(
-                [states[i].added for i in retry_idx],
-                [states[i].taken for i in retry_idx],
-                [states[i].elapsed_ns for i in retry_idx],
-                [states[i].name for i in retry_idx],
-                [-1] * len(retry_idx),
-            )
-            pkts = np.concatenate([pkts[~bad], r_pkts[r_sizes >= 0]])
-            sizes = np.concatenate([sizes[~bad], r_sizes[r_sizes >= 0]])
+        pkts, sizes = self._retry_oversize(states, pkts, sizes)
         ips, ports = self._live_peers()
         if len(ips):
             self.tx_packets += self.sock.send_fanout(pkts, sizes, ips, ports)
+
+    def _retry_oversize(self, states, pkts, sizes):
+        """Re-encode trailer-oversized states (size −1) without the
+        trailer: ``added`` stays capacity-included, so receivers treating
+        these as v1 packets (sender-address slot table, scalar semantics)
+        still converge."""
+        bad = sizes < 0
+        if not bad.any():
+            return pkts, sizes
+        retry_idx = np.flatnonzero(bad)
+        r_pkts, r_sizes = native.encode_batch(
+            [states[i].added for i in retry_idx],
+            [states[i].taken for i in retry_idx],
+            [states[i].elapsed_ns for i in retry_idx],
+            [states[i].name for i in retry_idx],
+            [-1] * len(retry_idx),
+        )
+        pkts = np.concatenate([pkts[~bad], r_pkts[r_sizes >= 0]])
+        sizes = np.concatenate([sizes[~bad], r_sizes[r_sizes >= 0]])
+        return pkts, sizes
 
     def send_incast_request(self, name: str) -> None:
         if not len(self._peer_ips):

@@ -47,6 +47,25 @@ def _resolve(addr: str) -> Addr:
         return (host, port)
 
 
+def _encode_with_fallback(st: wire.WireState) -> bytes:
+    """Encode a state, dropping the v2 trailer for names in
+    (lane-limit, v1-limit]: receivers fall back to the sender-address slot
+    table and scalar (deficit-attribution) semantics, which converge
+    because the header ``added``/``taken`` stay capacity-included. Names
+    beyond the v1 limit can't exist (rejected at the API)."""
+    try:
+        return wire.encode(st)
+    except wire.NameTooLargeError:
+        return wire.encode(
+            wire.WireState(
+                name=st.name,
+                added=st.added,
+                taken=st.taken,
+                elapsed_ns=st.elapsed_ns,
+            )
+        )
+
+
 class SlotTable:
     """Deterministic node-slot assignment: rank in the sorted static member
     list (peers ∪ self), identical on every correctly-configured node.
@@ -144,7 +163,11 @@ class Replicator(asyncio.DatagramProtocol):
             if slot is None:
                 self.rx_errors += 1
                 return
-            self.repo.apply_delta(state, slot)
+            # No trailer at all ⇒ a v1 (reference) peer's scalar-max state:
+            # deficit-attribution semantics at ingest (see engine.ingest_delta).
+            # A base (cap-less) trailer is a prior-version patrol_tpu peer
+            # whose header carries raw own-lane values — plain lane merge.
+            self.repo.apply_delta(state, slot, scalar=state.origin_slot is None)
             if self.log:
                 self.log.debug(
                     "received",
@@ -159,7 +182,7 @@ class Replicator(asyncio.DatagramProtocol):
         assert self.loop is not None
         states = await self.loop.run_in_executor(None, self.repo.snapshot, name)
         for st in states:
-            self._send(wire.encode(st), addr)
+            self._send(_encode_with_fallback(st), addr)
         if states and self.log:
             self.log.debug(
                 "incast reply",
@@ -186,23 +209,7 @@ class Replicator(asyncio.DatagramProtocol):
         request goroutine, repo.go:129-158)."""
         if not self.peers:
             return
-        payloads = []
-        for st in states:
-            try:
-                payloads.append(wire.encode(st))
-            except wire.NameTooLargeError:
-                # Names in (v2-limit, v1-limit]: drop the trailer, receivers
-                # fall back to the sender-address slot table.
-                payloads.append(
-                    wire.encode(
-                        wire.WireState(
-                            name=st.name,
-                            added=st.added,
-                            taken=st.taken,
-                            elapsed_ns=st.elapsed_ns,
-                        )
-                    )
-                )
+        payloads = [_encode_with_fallback(st) for st in states]
         if self.loop is not None:
             self.loop.call_soon_threadsafe(self._broadcast_now, payloads)
 

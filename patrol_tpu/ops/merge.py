@@ -58,6 +58,50 @@ def merge_batch(state: LimiterState, batch: MergeBatch) -> LimiterState:
 merge_batch_jit = partial(jax.jit, donate_argnums=0)(merge_batch)
 
 
+def merge_scalar_batch(state: LimiterState, batch: MergeBatch) -> LimiterState:
+    """Deficit-attribution merge for deltas from *scalar-semantics* peers
+    (reference nodes, bucket.go:240-263): interop's echo-cancellation kernel.
+
+    A reference node's wire ``added``/``taken`` are scalar maxima over
+    EVERYONE's state — including grants this cluster already holds in other
+    PN lanes (our own broadcasts, max-merged into the reference node's
+    scalars and echoed back). Ingesting the raw value into the sender's lane
+    would double-count those echoes under the PN sum. Instead, attribute to
+    the sender's lane only the part of its counter NOT explained by the
+    other lanes:
+
+        attributed = max(delta − Σ_{l ≠ slot} lane_l, 0)
+        lane_slot  = max(lane_slot, attributed)
+
+    ``batch.added_nt`` must arrive capacity-subtracted (the host ingest
+    path subtracts the row's cap_base, since the reference folds its lazy
+    capacity init into ``added``). Exact for one scalar peer; for multiple
+    scalar peers it degrades toward the reference's own lossy-max behavior
+    (over-attribution only when a reference node relays grants we have not
+    yet heard first-hand — the same AP best-effort class as the reference).
+
+    Duplicate rows in one batch all read the pre-batch state: scatter-max
+    keeps the result order-free, at worst under-attributing until the next
+    full-state rebroadcast (every take rebroadcasts, README.md:41-43)."""
+    k = batch.rows.shape[0]
+    pn_rows = state.pn[batch.rows]  # [K, N, 2] gather
+    ar = jnp.arange(k)
+    lane_a = pn_rows[ar, batch.slots, ADDED]
+    lane_t = pn_rows[ar, batch.slots, TAKEN]
+    other_a = pn_rows[:, :, ADDED].sum(axis=-1) - lane_a
+    other_t = pn_rows[:, :, TAKEN].sum(axis=-1) - lane_t
+    zero = jnp.int64(0)
+    attr_a = jnp.maximum(batch.added_nt - other_a, zero)
+    attr_t = jnp.maximum(batch.taken_nt - other_t, zero)
+    pn = state.pn.at[batch.rows, batch.slots, ADDED].max(attr_a)
+    pn = pn.at[batch.rows, batch.slots, TAKEN].max(attr_t)
+    elapsed = state.elapsed.at[batch.rows].max(batch.elapsed_ns)
+    return LimiterState(pn=pn, elapsed=elapsed)
+
+
+merge_scalar_batch_jit = partial(jax.jit, donate_argnums=0)(merge_scalar_batch)
+
+
 def merge_dense(state: LimiterState, other: LimiterState) -> LimiterState:
     """Full-state join: elementwise max of both CRDT planes.
 

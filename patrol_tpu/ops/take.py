@@ -67,8 +67,10 @@ class TakeResult(NamedTuple):
     have_nt: jax.Array  # int64[K] tokens after refill, before the batch's takes
     admitted: jax.Array  # int64[K] how many of nreq were admitted
     own_added_nt: jax.Array  # int64[K] this node's PN lane after commit …
-    own_taken_nt: jax.Array  # int64[K] … for wire broadcast
+    own_taken_nt: jax.Array  # int64[K] … the exact lane values for the v2 trailer
     elapsed_ns: jax.Array  # int64[K] bucket elapsed after commit
+    sum_added_nt: jax.Array  # int64[K] Σ lanes added post-commit … the aggregate
+    sum_taken_nt: jax.Array  # int64[K] … scalars reference peers expect in the header
 
 
 def take_batch(
@@ -142,6 +144,8 @@ def take_batch(
         own_added_nt=pn_rows[:, node_slot, ADDED] + d_added,
         own_taken_nt=pn_rows[:, node_slot, TAKEN] + d_taken,
         elapsed_ns=state.elapsed[rows] + d_elapsed,
+        sum_added_nt=sum_added + d_added,
+        sum_taken_nt=sum_taken + d_taken,
     )
     return LimiterState(pn=pn, elapsed=elapsed), result
 

@@ -18,11 +18,29 @@ independent (README.md:49-62).
 
 This module adds a *backward-compatible* v2 extension: because the reference
 decoder reads exactly ``data[25:25+L]`` and ignores any trailing bytes, we
-may append a 6-byte trailer carrying the origin node slot. Reference nodes
-interoperate unchanged; patrol_tpu nodes use the slot to address the sender's
-PN-counter lane. Trailer layout: ``b"P2" | u8 flags | u16 slot | u8 checksum``
-(checksum = sum of the 5 preceding trailer bytes mod 256, a guard against a
+may append a trailer carrying patrol_tpu metadata. Reference nodes
+interoperate unchanged; patrol_tpu nodes use it to address the sender's
+PN-counter lane. Three trailer forms (``flags`` bits select):
+
+* base (6 B):     ``b"P2" | u8 flags=0 | u16 slot | u8 checksum``
+* with-cap (14B): ``b"P2" | u8 flags=1 | u16 slot | u64 cap_nt | u8 checksum``
+* lane (30 B):    ``b"P2" | u8 flags=3 | u16 slot | u64 cap_nt |``
+  ``u64 lane_added_nt | u64 lane_taken_nt | u8 checksum``
+
+(checksum = sum of the preceding trailer bytes mod 256, a guard against a
 name that happens to end in "P2").
+
+Mixed-cluster interop hinges on the **dual payload**: the float64 header
+``added``/``taken`` carry the sender's *aggregate scalar view* of the bucket
+(capacity-included, like the reference's ``bucket.added`` after lazy init,
+bucket.go:194-196) — exactly the full-state scalars a reference node
+max-merges — while the trailer carries the sender's *exact own-lane*
+PN-counter values in int64 nanotokens for patrol_tpu receivers. Without the
+aggregate header, a reference peer max-merging our lane-only ``taken``
+against its global scalar would lose takes; without the lane trailer,
+patrol_tpu peers would double-count echoed aggregates. ``cap_nt`` is the
+sender's lazily-initialized capacity base, which receivers adopt for rows
+whose capacity is still unknown.
 
 The device state is int64 nanotokens; the wire is float64 tokens — this codec
 is the conversion boundary. float64 represents integers exactly up to 2^53,
@@ -41,13 +59,19 @@ NANO = 1_000_000_000
 
 FIXED_SIZE = 25  # 8 + 8 + 8 + 1 (bucket.go:36)
 PACKET_SIZE = 256  # no-fragmentation bound (bucket.go:38-41)
-MAX_NAME_LENGTH = PACKET_SIZE - FIXED_SIZE - 6  # leave room for the v2 trailer
+MAX_NAME_LENGTH = PACKET_SIZE - FIXED_SIZE - 30  # room for the lane trailer
 MAX_NAME_LENGTH_V1 = PACKET_SIZE - FIXED_SIZE  # the reference's 231 (bucket.go:43-44)
 
 _HEADER = struct.Struct(">ddQ")
 _TRAILER = struct.Struct(">2sBHB")
+_TRAILER_CAP = struct.Struct(">2sBHQB")
+_TRAILER_LANE = struct.Struct(">2sBHQQQB")
 _TRAILER_MAGIC = b"P2"
+_FLAG_CAP = 0x01
+_FLAG_LANE = 0x02
 TRAILER_SIZE = _TRAILER.size
+TRAILER_CAP_SIZE = _TRAILER_CAP.size
+TRAILER_LANE_SIZE = _TRAILER_LANE.size
 
 
 class NameTooLargeError(ValueError):
@@ -66,10 +90,16 @@ class WireState:
     """One bucket state as it crosses the wire."""
 
     name: str
-    added: float  # tokens (float64, as on the wire)
+    added: float  # tokens (float64, as on the wire): the sender's AGGREGATE
+    # scalar view, capacity-included — what a reference node max-merges
     taken: float
     elapsed_ns: int  # signed int64 nanoseconds
     origin_slot: Optional[int] = None  # v2 trailer; None for v1 packets
+    cap_nt: Optional[int] = None  # sender's capacity base (nanotokens);
+    # None on v1 / base-trailer packets — the receiver then falls back to
+    # scalar (reference) merge semantics for this delta
+    lane_added_nt: Optional[int] = None  # exact own-lane PN values (grants-
+    lane_taken_nt: Optional[int] = None  # only, nanotokens); lane trailer
 
     def is_zero(self) -> bool:
         """The incast-request marker (bucket.go:163-170, repo.go:78-90)."""
@@ -109,6 +139,9 @@ def from_nanotokens(
     taken_nt: int,
     elapsed_ns: int,
     origin_slot: Optional[int] = None,
+    cap_nt: Optional[int] = None,
+    lane_added_nt: Optional[int] = None,
+    lane_taken_nt: Optional[int] = None,
 ) -> WireState:
     return WireState(
         name=name,
@@ -116,6 +149,9 @@ def from_nanotokens(
         taken=taken_nt / NANO,
         elapsed_ns=elapsed_ns,
         origin_slot=origin_slot,
+        cap_nt=cap_nt,
+        lane_added_nt=lane_added_nt,
+        lane_taken_nt=lane_taken_nt,
     )
 
 
@@ -126,7 +162,20 @@ def encode(state: WireState) -> bytes:
     # non-UTF8 bytes must round-trip exactly or distinct buckets would
     # collapse into one and fork CRDT state across the cluster.
     name_bytes = state.name.encode("utf-8", errors="surrogateescape")
-    limit = MAX_NAME_LENGTH if state.origin_slot is not None else MAX_NAME_LENGTH_V1
+    with_cap = state.origin_slot is not None and state.cap_nt is not None
+    with_lane = (
+        with_cap
+        and state.lane_added_nt is not None
+        and state.lane_taken_nt is not None
+    )
+    if state.origin_slot is None:
+        limit = MAX_NAME_LENGTH_V1
+    elif with_lane:
+        limit = PACKET_SIZE - FIXED_SIZE - TRAILER_LANE_SIZE
+    elif with_cap:
+        limit = PACKET_SIZE - FIXED_SIZE - TRAILER_CAP_SIZE
+    else:
+        limit = PACKET_SIZE - FIXED_SIZE - TRAILER_SIZE
     if len(name_bytes) > limit:
         raise NameTooLargeError(limit)
 
@@ -135,9 +184,24 @@ def encode(state: WireState) -> bytes:
     out.append(len(name_bytes))
     out += name_bytes
     if state.origin_slot is not None:
-        trailer = bytearray(
-            _TRAILER.pack(_TRAILER_MAGIC, 0, state.origin_slot, 0)
-        )
+        if with_lane:
+            trailer = bytearray(
+                _TRAILER_LANE.pack(
+                    _TRAILER_MAGIC, _FLAG_CAP | _FLAG_LANE, state.origin_slot,
+                    state.cap_nt & 0xFFFFFFFFFFFFFFFF,
+                    state.lane_added_nt & 0xFFFFFFFFFFFFFFFF,
+                    state.lane_taken_nt & 0xFFFFFFFFFFFFFFFF, 0,
+                )
+            )
+        elif with_cap:
+            trailer = bytearray(
+                _TRAILER_CAP.pack(
+                    _TRAILER_MAGIC, _FLAG_CAP, state.origin_slot,
+                    state.cap_nt & 0xFFFFFFFFFFFFFFFF, 0,
+                )
+            )
+        else:
+            trailer = bytearray(_TRAILER.pack(_TRAILER_MAGIC, 0, state.origin_slot, 0))
         trailer[-1] = sum(trailer[:-1]) & 0xFF
         out += trailer
     assert len(out) <= PACKET_SIZE
@@ -160,11 +224,40 @@ def decode(data: bytes) -> WireState:
     elapsed_ns = elapsed_u64 - (1 << 64) if elapsed_u64 >= 1 << 63 else elapsed_u64
 
     origin_slot: Optional[int] = None
+    cap_nt: Optional[int] = None
+    lane_added_nt: Optional[int] = None
+    lane_taken_nt: Optional[int] = None
     tail = data[FIXED_SIZE + name_len :]
     if len(tail) >= TRAILER_SIZE and tail[:2] == _TRAILER_MAGIC:
-        magic, _flags, slot, checksum = _TRAILER.unpack_from(tail)
-        if checksum == sum(tail[: TRAILER_SIZE - 1]) & 0xFF:
-            origin_slot = slot
+        flags = tail[2]
+        # Values are non-negative int64 nanotoken counts by contract; a
+        # bit-63 value is a hostile packet. Validation is all-or-nothing:
+        # a trailer with ANY invalid field is discarded whole (the packet
+        # degrades to v1 — conservative deficit-attribution ingest), never
+        # partially honored. A partially-honored lane trailer would merge
+        # the header's AGGREGATE into the sender's single lane and
+        # permanently inflate the PN sum (one crafted packet per bucket).
+        if flags & _FLAG_LANE and flags & _FLAG_CAP and len(tail) >= TRAILER_LANE_SIZE:
+            _m, _f, slot, cap_u64, la_u64, lt_u64, ck = _TRAILER_LANE.unpack_from(tail)
+            if (
+                ck == sum(tail[: TRAILER_LANE_SIZE - 1]) & 0xFF
+                and cap_u64 < 1 << 63
+                and la_u64 < 1 << 63
+                and lt_u64 < 1 << 63
+            ):
+                origin_slot = slot
+                cap_nt = cap_u64
+                lane_added_nt = la_u64
+                lane_taken_nt = lt_u64
+        elif flags & _FLAG_CAP and not flags & _FLAG_LANE and len(tail) >= TRAILER_CAP_SIZE:
+            _magic, _flags, slot, cap_u64, checksum = _TRAILER_CAP.unpack_from(tail)
+            if checksum == sum(tail[: TRAILER_CAP_SIZE - 1]) & 0xFF and cap_u64 < 1 << 63:
+                origin_slot = slot
+                cap_nt = cap_u64
+        elif not flags & (_FLAG_CAP | _FLAG_LANE):
+            _magic, _flags, slot, checksum = _TRAILER.unpack_from(tail)
+            if checksum == sum(tail[: TRAILER_SIZE - 1]) & 0xFF:
+                origin_slot = slot
 
     return WireState(
         name=name,
@@ -172,4 +265,7 @@ def decode(data: bytes) -> WireState:
         taken=taken,
         elapsed_ns=elapsed_ns,
         origin_slot=origin_slot,
+        cap_nt=cap_nt,
+        lane_added_nt=lane_added_nt,
+        lane_taken_nt=lane_taken_nt,
     )

@@ -126,7 +126,7 @@ def build_cluster_step(mesh: Mesh, node_slot: int):
             MergeBatch(*(BATCH_SPEC,) * 5),
             TakeRequest(*(BATCH_SPEC,) * 8),
         ),
-        out_specs=(STATE_SPEC, TakeResult(*(BATCH_SPEC,) * 5)),
+        out_specs=(STATE_SPEC, TakeResult(*(BATCH_SPEC,) * 7)),
     )
     return jax.jit(fn, donate_argnums=0)
 

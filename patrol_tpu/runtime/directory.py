@@ -222,3 +222,12 @@ class BucketDirectory:
             self.cap_base_nt[row] = cap_nt
             return cap_nt
         return base
+
+    def init_cap_base_many(self, rows: np.ndarray, caps_nt: np.ndarray) -> None:
+        """Vectorized :meth:`init_cap_base` for the bulk ingest path: rows
+        whose base is still 0 adopt the given (non-zero) capacity."""
+        if not len(rows):
+            return
+        with self._mu:
+            unset = self.cap_base_nt[rows] == 0
+            self.cap_base_nt[rows[unset]] = caps_nt[unset]

@@ -38,7 +38,13 @@ import numpy as np
 
 from patrol_tpu.models.limiter import NANO, LimiterConfig, LimiterState, init_state
 from patrol_tpu.ops import wire
-from patrol_tpu.ops.merge import MergeBatch, merge_batch, read_rows, zero_rows_jit
+from patrol_tpu.ops.merge import (
+    MergeBatch,
+    merge_batch,
+    merge_scalar_batch,
+    read_rows,
+    zero_rows_jit,
+)
 from patrol_tpu.ops.rate import Rate
 from patrol_tpu.ops.take import TakeRequest, take_batch, remaining_for_request
 from patrol_tpu.runtime.bucket import ClockFn, system_clock
@@ -118,9 +124,17 @@ class TakeTicket:
 
 
 class _Delta:
-    __slots__ = ("row", "slot", "added_nt", "taken_nt", "elapsed_ns")
+    __slots__ = ("row", "slot", "added_nt", "taken_nt", "elapsed_ns", "scalar")
 
-    def __init__(self, row: int, slot: int, added_nt: int, taken_nt: int, elapsed_ns: int):
+    def __init__(
+        self,
+        row: int,
+        slot: int,
+        added_nt: int,
+        taken_nt: int,
+        elapsed_ns: int,
+        scalar: bool = False,
+    ):
         self.row = row
         self.slot = slot
         # Ingest clamp: device state is non-negative by invariant; hostile or
@@ -128,32 +142,40 @@ class _Delta:
         self.added_nt = max(added_nt, 0)
         self.taken_nt = max(taken_nt, 0)
         self.elapsed_ns = max(elapsed_ns, 0)
+        # True ⇒ the delta came from a scalar-semantics (reference) peer and
+        # must go through the deficit-attribution kernel (merge_scalar_batch).
+        self.scalar = scalar
 
 
 class _DeltaChunk:
-    """A pre-vectorized batch of deltas (bulk ingest path): five parallel
-    int64 numpy arrays, already clamped non-negative and slot-validated."""
+    """A pre-vectorized batch of deltas (bulk ingest path): parallel int64
+    numpy arrays, already clamped non-negative and slot-validated, plus a
+    per-delta scalar-semantics flag."""
 
-    __slots__ = ("rows", "slots", "added_nt", "taken_nt", "elapsed_ns", "n")
+    __slots__ = ("rows", "slots", "added_nt", "taken_nt", "elapsed_ns", "scalar", "n")
 
-    def __init__(self, rows, slots, added_nt, taken_nt, elapsed_ns):
+    def __init__(self, rows, slots, added_nt, taken_nt, elapsed_ns, scalar=None):
         self.rows = rows
         self.slots = slots
         self.added_nt = added_nt
         self.taken_nt = taken_nt
         self.elapsed_ns = elapsed_ns
+        self.scalar = (
+            scalar if scalar is not None else np.zeros(len(rows), dtype=bool)
+        )
         self.n = len(rows)
 
 
 class DeltaArrays(NamedTuple):
     """One tick's drained replication deltas, in arrival order, as flat
-    int64 numpy arrays — the canonical form both engines consume."""
+    numpy arrays — the canonical form both engines consume."""
 
     rows: np.ndarray
     slots: np.ndarray
     added_nt: np.ndarray
     taken_nt: np.ndarray
     elapsed_ns: np.ndarray
+    scalar: np.ndarray  # bool[K]: deficit-attribution (reference peer) deltas
 
     def __len__(self) -> int:
         return len(self.rows)
@@ -187,7 +209,15 @@ def _jit_take_packed(node_slot: int):
         )
         state, res = take_batch(state, req, node_slot)
         out = jnp.stack(
-            [res.have_nt, res.admitted, res.own_added_nt, res.own_taken_nt, res.elapsed_ns]
+            [
+                res.have_nt,
+                res.admitted,
+                res.own_added_nt,
+                res.own_taken_nt,
+                res.elapsed_ns,
+                res.sum_added_nt,
+                res.sum_taken_nt,
+            ]
         )
         return state, out
 
@@ -205,6 +235,24 @@ def _jit_merge_packed():
             elapsed_ns=packed[4],
         )
         return merge_batch(state, batch)
+
+    return jax.jit(step, donate_argnums=0)
+
+
+@lru_cache(maxsize=8)
+def _jit_merge_scalar_packed():
+    """Deficit-attribution merge for scalar-semantics (reference-peer)
+    deltas — interop path, typically a small batch."""
+
+    def step(state, packed):
+        batch = MergeBatch(
+            rows=packed[0].astype(jnp.int32),
+            slots=packed[1].astype(jnp.int32),
+            added_nt=packed[2],
+            taken_nt=packed[3],
+            elapsed_ns=packed[4],
+        )
+        return merge_scalar_batch(state, batch)
 
     return jax.jit(step, donate_argnums=0)
 
@@ -242,6 +290,7 @@ class DeviceEngine:
         self._busy = False
         self._ticks = 0  # device calls issued (observability)
         self._evictions = 0  # rows recycled under pool pressure
+        self._scalar_dropped = 0  # v1 deltas dropped for unknown capacity
         self._thread = threading.Thread(target=self._run, name="patrol-engine", daemon=True)
         self._thread.start()
 
@@ -313,6 +362,12 @@ class DeviceEngine:
         get-or-create miss signal that triggers incast (repo.go:96-106)."""
         now = self.clock() if now_ns is None else now_ns
         row, created = self._assign_pinned(name, now)
+        # First *local* take on the row (capacity still unset) counts as a
+        # miss for incast purposes even when replication created the row
+        # first: scalar (v1-peer) deltas are dropped while the capacity is
+        # unknown, so peer state must be re-solicited now that it is.
+        if int(self.directory.cap_base_nt[row]) == 0:
+            created = True
         self.directory.init_cap_base(row, rate.freq * NANO)
         ticket = TakeTicket(name, row, rate, count, now)
         with self._cond:
@@ -328,10 +383,31 @@ class DeviceEngine:
         ticket.wait()
         return ticket.remaining, ticket.ok, created
 
-    def ingest_delta(self, state: wire.WireState, slot: int) -> bool:
+    def ingest_delta(self, state: wire.WireState, slot: int, scalar: bool = False) -> bool:
         """Queue one replication delta for merge; returns created flag.
         Dropped (not an error) if the pool is spent with everything pinned —
-        replication is loss-tolerant by CRDT design (README.md:41-43)."""
+        replication is loss-tolerant by CRDT design (README.md:41-43).
+
+        Wire semantics (the mixed-cluster interop contract; see ops/wire.py):
+
+        * lane trailer present — a patrol_tpu peer's exact PN lane values:
+          merge them directly (the float header is its aggregate view, for
+          reference peers only); adopt ``cap_nt`` as this row's cap_base
+          when still unset.
+        * ``cap_nt`` only (with-cap trailer) — the header is the sender's
+          capacity-included AGGREGATE but the exact lane is absent: subtract
+          the wire cap and route through the deficit-attribution kernel
+          (attributing the aggregate to the sender's lane directly would
+          double-count every other lane's echoed grants).
+        * ``scalar=True`` (v1 packet, no trailer) — a reference peer's
+          scalar-max aggregates: subtract OUR cap_base and route through
+          the deficit-attribution kernel. Unknowable before the first local
+          take reveals the capacity ⇒ dropped until then (the reference
+          rebroadcasts full state on every take, so nothing is lost).
+        * none of the above — the header carries raw own-lane values: a
+          base-trailer peer (grants-only lane header) or an internal
+          raw-lane join (upsert seam). Plain lane max-merge.
+        """
         now = self.clock()
         if not 0 <= slot < self.config.nodes:
             log.warning("delta slot %d out of range, dropped", slot)
@@ -341,7 +417,29 @@ class DeviceEngine:
         except DirectoryFullError:
             log.warning("pool spent (all pinned); delta for %r dropped", state.name)
             return False
-        delta = _Delta(row, slot, state.added_nt, state.taken_nt, state.elapsed_ns)
+        added_nt = state.added_nt
+        taken_nt = state.taken_nt
+        if state.cap_nt is not None:
+            if state.cap_nt > 0:
+                self.directory.init_cap_base(row, state.cap_nt)
+            if state.lane_added_nt is not None and state.lane_taken_nt is not None:
+                added_nt = state.lane_added_nt
+                taken_nt = state.lane_taken_nt
+                scalar = False
+            else:
+                added_nt = max(added_nt - state.cap_nt, 0)
+                scalar = True
+        elif scalar:
+            base = int(self.directory.cap_base_nt[row])
+            if base == 0:
+                # Capacity unknown on this row: can't separate the reference
+                # peer's lazy-init cap from its grants yet. Drop; its next
+                # full-state broadcast (every take) re-delivers.
+                self.directory.unpin_rows([row])
+                self._scalar_dropped += 1
+                return created
+            added_nt = max(added_nt - base, 0)
+        delta = _Delta(row, slot, added_nt, taken_nt, state.elapsed_ns, scalar)
         with self._cond:
             self._deltas.append(delta)
             self._cond.notify()
@@ -354,15 +452,37 @@ class DeviceEngine:
         added_nt: Sequence[int],
         taken_nt: Sequence[int],
         elapsed_ns: Sequence[int],
+        caps_nt: Optional[Sequence[int]] = None,
+        lane_added_nt: Optional[Sequence[int]] = None,
+        lane_taken_nt: Optional[Sequence[int]] = None,
+        scalar: Optional[Sequence[bool]] = None,
     ) -> int:
         """Bulk ingest from the native receive path: one vectorized
         directory pass, one queue append, one wake-up — the feeder loop the
         Go reference runs one packet per iteration (repo.go:54-92).
         Returns deltas accepted (the whole batch is dropped only when the
-        pool is spent with every row pinned)."""
+        pool is spent with every row pinned).
+
+        Per-delta wire semantics (−1 = field absent; see ingest_delta):
+        lane values ≥0 ⇒ exact PN lane merge; cap ≥0 only ⇒ header minus
+        wire cap, deficit-attribution merge; neither ⇒ ``scalar[i]`` picks
+        between v1 scalar state (no trailer: deficit-attribution merge
+        against OUR cap_base, dropped while that capacity is unknown) and a
+        base-trailer peer's raw own-lane header (plain lane merge;
+        the default when ``scalar`` is omitted, matching prior-version
+        senders). ``caps_nt=None`` entirely ⇒ raw lane values (internal
+        feeders: bench replay)."""
         now = self.clock()
         slots_a = np.asarray(slots, dtype=np.int64)
         keep = (slots_a >= 0) & (slots_a < self.config.nodes)
+        caps_a = None if caps_nt is None else np.asarray(caps_nt, dtype=np.int64)
+        lane_a = None if lane_added_nt is None else np.asarray(lane_added_nt, np.int64)
+        lane_t = None if lane_taken_nt is None else np.asarray(lane_taken_nt, np.int64)
+        scalar_a = None if scalar is None else np.asarray(scalar, dtype=bool)
+        if caps_a is None and scalar_a is not None:
+            # Honor the scalar flags even without a caps array (parity with
+            # ingest_delta(..., scalar=True)): all caps absent.
+            caps_a = np.full(len(slots_a), -1, dtype=np.int64)
         if not keep.all():
             idx = np.flatnonzero(keep)
             names = [names[i] for i in idx]
@@ -370,6 +490,12 @@ class DeviceEngine:
             added_nt = np.asarray(added_nt, dtype=np.int64)[idx]
             taken_nt = np.asarray(taken_nt, dtype=np.int64)[idx]
             elapsed_ns = np.asarray(elapsed_ns, dtype=np.int64)[idx]
+            if caps_a is not None:
+                caps_a = caps_a[idx]
+            if lane_a is not None:
+                lane_a, lane_t = lane_a[idx], lane_t[idx]
+            if scalar_a is not None:
+                scalar_a = scalar_a[idx]
         if not len(names):
             return 0
         accepted = 0
@@ -383,13 +509,52 @@ class DeviceEngine:
                     "pool spent (all pinned); %d deltas dropped", len(chunk_names)
                 )
                 continue
-            chunk = _DeltaChunk(
-                rows,
-                slots_a[lo:hi],
-                np.maximum(np.asarray(added_nt[lo:hi], dtype=np.int64), 0),
-                np.maximum(np.asarray(taken_nt[lo:hi], dtype=np.int64), 0),
-                np.maximum(np.asarray(elapsed_ns[lo:hi], dtype=np.int64), 0),
-            )
+            slots_c = slots_a[lo:hi]
+            added_c = np.maximum(np.asarray(added_nt[lo:hi], dtype=np.int64), 0)
+            taken_c = np.maximum(np.asarray(taken_nt[lo:hi], dtype=np.int64), 0)
+            elapsed_c = np.maximum(np.asarray(elapsed_ns[lo:hi], dtype=np.int64), 0)
+            scalar_c = None
+            if caps_a is not None:
+                caps_c = caps_a[lo:hi]
+                has_cap = caps_c >= 0
+                # Adopt peer capacities first, so same-batch v1 deltas for
+                # rows initialized here already see the base.
+                self.directory.init_cap_base_many(
+                    rows[has_cap & (caps_c > 0)], caps_c[has_cap & (caps_c > 0)]
+                )
+                # v1 (no trailer) ⇒ capacity-included scalar aggregates; a
+                # cap-less base trailer ⇒ raw own-lane header (no subtract).
+                v1 = (
+                    ~has_cap & scalar_a[lo:hi]
+                    if scalar_a is not None
+                    else np.zeros_like(has_cap)
+                )
+                base = self.directory.cap_base_nt[rows]
+                sub = np.where(has_cap, np.maximum(caps_c, 0), np.where(v1, base, 0))
+                added_c = np.maximum(added_c - sub, 0)
+                lane_ok = np.zeros_like(has_cap)
+                if lane_a is not None:
+                    # Lane-trailer packets: the exact PN lane values replace
+                    # the header-derived approximation.
+                    lane_ok = has_cap & (lane_a[lo:hi] >= 0) & (lane_t[lo:hi] >= 0)
+                    added_c = np.where(lane_ok, lane_a[lo:hi], added_c)
+                    taken_c = np.where(lane_ok, lane_t[lo:hi], taken_c)
+                # Deficit attribution for every aggregate-header delta: v1
+                # packets and cap-without-lane trailers alike.
+                scalar_c = v1 | (has_cap & ~lane_ok)
+                # v1 deltas on rows with unknown capacity: drop (the peer's
+                # next full-state broadcast re-delivers).
+                unknown = v1 & (base == 0)
+                if unknown.any():
+                    self._scalar_dropped += int(unknown.sum())
+                    self.directory.unpin_rows(rows[unknown])
+                    keep_c = ~unknown
+                    rows, slots_c = rows[keep_c], slots_c[keep_c]
+                    added_c, taken_c = added_c[keep_c], taken_c[keep_c]
+                    elapsed_c, scalar_c = elapsed_c[keep_c], scalar_c[keep_c]
+                    if not len(rows):
+                        continue
+            chunk = _DeltaChunk(rows, slots_c, added_c, taken_c, elapsed_c, scalar_c)
             with self._cond:
                 self._deltas.append(chunk)
                 self._cond.notify()
@@ -422,13 +587,30 @@ class DeviceEngine:
             return []  # evicted mid-read
         pn = pn_rows[0]  # [N, 2]
         elapsed = int(elapsed_rows[0])
+        cap = int(self.directory.cap_base_nt[row])
+        sum_a = int(pn[:, 0].sum())
+        sum_t = int(pn[:, 1].sum())
         out = []
         for slot in range(pn.shape[0]):
             a, t = int(pn[slot, 0]), int(pn[slot, 1])
             if a or t:
-                out.append(wire.from_nanotokens(name, a, t, elapsed, origin_slot=slot))
-        if not out and elapsed:
-            out.append(wire.from_nanotokens(name, 0, 0, elapsed, origin_slot=self.node_slot))
+                # Dual payload (ops/wire.py): aggregate scalars in the
+                # header (what reference peers max-merge, idempotent across
+                # the per-lane packets), exact lane values in the trailer.
+                out.append(
+                    wire.from_nanotokens(
+                        name, cap + sum_a, sum_t, elapsed,
+                        origin_slot=slot, cap_nt=cap,
+                        lane_added_nt=a, lane_taken_nt=t,
+                    )
+                )
+        if not out and (elapsed or cap):
+            out.append(
+                wire.from_nanotokens(
+                    name, cap, 0, elapsed, origin_slot=self.node_slot,
+                    cap_nt=cap, lane_added_nt=0, lane_taken_nt=0,
+                )
+            )
         return out
 
     def release_bucket(self, name: str, timeout: float = 5.0) -> bool:
@@ -470,13 +652,25 @@ class DeviceEngine:
                 continue  # evicted mid-read: don't leak another bucket's state
             pn = pn_rows[i]
             elapsed = int(elapsed_rows[i])
+            cap = int(self.directory.cap_base_nt[row])
+            sum_a = int(pn[:, 0].sum())
+            sum_t = int(pn[:, 1].sum())
             states = [
-                wire.from_nanotokens(name, int(pn[s, 0]), int(pn[s, 1]), elapsed, origin_slot=s)
+                wire.from_nanotokens(
+                    name, cap + sum_a, sum_t, elapsed,
+                    origin_slot=s, cap_nt=cap,
+                    lane_added_nt=int(pn[s, 0]), lane_taken_nt=int(pn[s, 1]),
+                )
                 for s in range(pn.shape[0])
                 if pn[s, 0] or pn[s, 1]
             ]
-            if not states and elapsed:
-                states = [wire.from_nanotokens(name, 0, 0, elapsed, origin_slot=self.node_slot)]
+            if not states and (elapsed or cap):
+                states = [
+                    wire.from_nanotokens(
+                        name, cap, 0, elapsed, origin_slot=self.node_slot,
+                        cap_nt=cap, lane_added_nt=0, lane_taken_nt=0,
+                    )
+                ]
             if states:
                 out[name] = states
         return out
@@ -541,6 +735,12 @@ class DeviceEngine:
     @property
     def evictions(self) -> int:
         return self._evictions
+
+    @property
+    def scalar_dropped(self) -> int:
+        """v1 (reference-peer) deltas dropped while the row's capacity was
+        unknown — re-delivered by the peer's next full-state broadcast."""
+        return self._scalar_dropped
 
     def backlog(self) -> int:
         """Queued-but-unapplied work rows (takes + deltas, counting each
@@ -610,6 +810,7 @@ class DeviceEngine:
         added = np.empty(total, np.int64)
         taken = np.empty(total, np.int64)
         elapsed = np.empty(total, np.int64)
+        scalar = np.zeros(total, bool)
         at = 0
         for it in items:
             if isinstance(it, _DeltaChunk):
@@ -618,6 +819,7 @@ class DeviceEngine:
                 added[at : at + it.n] = it.added_nt
                 taken[at : at + it.n] = it.taken_nt
                 elapsed[at : at + it.n] = it.elapsed_ns
+                scalar[at : at + it.n] = it.scalar
                 at += it.n
             else:
                 rows[at] = it.row
@@ -625,8 +827,9 @@ class DeviceEngine:
                 added[at] = it.added_nt
                 taken[at] = it.taken_nt
                 elapsed[at] = it.elapsed_ns
+                scalar[at] = it.scalar
                 at += 1
-        return DeltaArrays(rows, slots, added, taken, elapsed)
+        return DeltaArrays(rows, slots, added, taken, elapsed, scalar)
 
     def _fail_tickets(self, tickets: Sequence[TakeTicket]) -> None:
         unpin = [
@@ -668,7 +871,9 @@ class DeviceEngine:
                 self._cond.notify()
         return list(groups.keys()), groups
 
-    def _complete_groups(self, keys, groups, have, admitted, own_a, own_t, elapsed) -> None:
+    def _complete_groups(
+        self, keys, groups, have, admitted, own_a, own_t, elapsed, sum_a, sum_t
+    ) -> None:
         """Fan per-group kernel results out to tickets + broadcast hook.
         Completion releases each ticket's directory pin."""
         broadcasts: List[wire.WireState] = []
@@ -682,18 +887,27 @@ class DeviceEngine:
                 )
                 if t.complete(remaining, ok):
                     unpin.append(t.row)
-            # Replicate this node's lane. The reference broadcasts full state
-            # on every take, success or not (api.go:74, README.md:41-43); we
-            # skip only when our lane is still all-zero — a zero state on the
-            # wire is the incast *request* marker (repo.go:78-90).
-            if own_a[i] or own_t[i] or elapsed[i]:
+            # Replicate. The reference broadcasts full state on every take,
+            # success or not (api.go:74, README.md:41-43) — even a failed
+            # first take commits the lazy capacity init (bucket.go:194-196),
+            # which we mirror. Dual payload (ops/wire.py): the float header
+            # carries the aggregate scalar view (cap + Σadded, Σtaken) that
+            # reference peers max-merge; the trailer carries this node's
+            # exact PN lane for patrol_tpu peers. We skip only when state is
+            # still all-zero — a zero state on the wire is the incast
+            # *request* marker (repo.go:78-90).
+            cap = int(self.directory.cap_base_nt[ts[0].row])
+            if own_a[i] or own_t[i] or elapsed[i] or cap:
                 broadcasts.append(
                     wire.from_nanotokens(
                         ts[0].name,
-                        int(own_a[i]),
-                        int(own_t[i]),
+                        cap + int(sum_a[i]),
+                        int(sum_t[i]),
                         int(elapsed[i]),
                         origin_slot=self.node_slot,
+                        cap_nt=cap,
+                        lane_added_nt=int(own_a[i]),
+                        lane_taken_nt=int(own_t[i]),
                     )
                 )
         if unpin:
@@ -705,6 +919,27 @@ class DeviceEngine:
                 log.exception("broadcast hook failed")
 
     def _apply_merges(self, deltas: DeltaArrays) -> None:
+        # Scalar-semantics (reference-peer) deltas go through the
+        # deficit-attribution kernel; the common case is none of them.
+        # Lane merges apply FIRST: a scalar echo's aggregate already
+        # includes peer lanes broadcast before it, so attributing the
+        # deficit before those lane deltas land would double-count their
+        # grants into the sender's lane — permanently (lanes are monotone).
+        # Deficit attribution is monotone-decreasing in other-lane values,
+        # so lane-first is always the conservative order.
+        scalar_subset = None
+        if deltas.scalar.any():
+            sc = deltas.scalar
+            scalar_subset = DeltaArrays(*(a[sc] for a in deltas))
+            if sc.all():
+                self._apply_scalar_merges(scalar_subset)
+                return
+            deltas = DeltaArrays(*(a[~sc] for a in deltas))
+        self._apply_lane_merges(deltas)
+        if scalar_subset is not None:
+            self._apply_scalar_merges(scalar_subset)
+
+    def _apply_lane_merges(self, deltas: DeltaArrays) -> None:
         # Merge-kernel selection: "scatter" (XLA, default), "pallas" (the
         # block-sparse TPU kernel whenever it can run natively), or "auto"
         # (per-batch heuristic: pallas iff the batch is block-sparse,
@@ -742,6 +977,20 @@ class DeviceEngine:
             self.state = _jit_merge_packed()(self.state, jnp.asarray(packed))
         self._ticks += 1
 
+    def _apply_scalar_merges(self, deltas: DeltaArrays) -> None:
+        """Deficit-attribution merge of reference-peer deltas (interop)."""
+        n = len(deltas)
+        k = _pad_size(n)
+        packed = np.zeros((5, k), dtype=np.int64)
+        packed[0, :n] = deltas.rows
+        packed[1, :n] = deltas.slots
+        packed[2, :n] = deltas.added_nt
+        packed[3, :n] = deltas.taken_nt
+        packed[4, :n] = deltas.elapsed_ns
+        with self._state_mu:
+            self.state = _jit_merge_scalar_packed()(self.state, jnp.asarray(packed))
+        self._ticks += 1
+
     def _apply_takes(self, tickets: Sequence[TakeTicket]) -> None:
         keys, groups = self._group_tickets(tickets)
         k = _pad_size(len(keys), hi=MAX_TAKE_ROWS)
@@ -767,5 +1016,7 @@ class DeviceEngine:
         self._ticks += 1
 
         out = np.asarray(out)  # one D2H transfer; blocks until device done
-        have, admitted, own_a, own_t, elapsed = out
-        self._complete_groups(keys, groups, have, admitted, own_a, own_t, elapsed)
+        have, admitted, own_a, own_t, elapsed, sum_a, sum_t = out
+        self._complete_groups(
+            keys, groups, have, admitted, own_a, own_t, elapsed, sum_a, sum_t
+        )

@@ -60,6 +60,18 @@ class MeshEngine(DeviceEngine):
     def _apply(
         self, deltas: Optional[DeltaArrays], tickets: Sequence[TakeTicket]
     ) -> None:
+        # Scalar-semantics (reference-peer) deltas can't ride the fused lane
+        # merge: they need deficit attribution against the whole row. Rare
+        # interop path — peel them into the base kernel (GSPMD shards it),
+        # applied AFTER the fused step: lane merges land first so a scalar
+        # echo's aggregate (which already includes peer lanes broadcast
+        # before it) isn't double-attributed to the sender's lane.
+        scalar_subset = None
+        if deltas is not None and deltas.scalar.any():
+            sc = deltas.scalar
+            scalar_subset = DeltaArrays(*(a[sc] for a in deltas))
+            deltas = DeltaArrays(*(a[~sc] for a in deltas)) if not sc.all() else None
+
         keys, groups = self._group_tickets(tickets) if tickets else ([], {})
 
         plan = self.plan
@@ -121,6 +133,8 @@ class MeshEngine(DeviceEngine):
         with self._state_mu:
             self.state, res = self._step(self.state, mb, req)
         self._ticks += 1
+        if scalar_subset is not None:
+            self._apply_scalar_merges(scalar_subset)
 
         if not keys:
             jax.block_until_ready(self.state.pn)
@@ -131,6 +145,8 @@ class MeshEngine(DeviceEngine):
         own_a_all = np.asarray(res.own_added_nt)
         own_t_all = np.asarray(res.own_taken_nt)
         el_all = np.asarray(res.elapsed_ns)
+        sum_a_all = np.asarray(res.sum_added_nt)
+        sum_t_all = np.asarray(res.sum_taken_nt)
 
         at = [blk * k_take + slot for blk, slot in placed]
         self._complete_groups(
@@ -141,6 +157,8 @@ class MeshEngine(DeviceEngine):
             own_a_all[at],
             own_t_all[at],
             el_all[at],
+            sum_a_all[at],
+            sum_t_all[at],
         )
 
     def warmup(self) -> None:

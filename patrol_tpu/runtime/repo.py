@@ -98,8 +98,8 @@ class TPURepo:
 
     # -- replication ingest -------------------------------------------------
 
-    def apply_delta(self, state: wire.WireState, slot: int) -> None:
-        self.engine.ingest_delta(state, slot)
+    def apply_delta(self, state: wire.WireState, slot: int, scalar: bool = False) -> None:
+        self.engine.ingest_delta(state, slot, scalar=scalar)
 
     def snapshot(self, name: str) -> List[wire.WireState]:
         return self.engine.snapshot(name)

@@ -29,10 +29,13 @@ int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes, int n,
                    int n_peers);
 int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
                     double* added, double* taken, uint64_t* elapsed,
-                    uint8_t* names, int* name_lens, int* origin_slots);
+                    uint8_t* names, int* name_lens, int* origin_slots,
+                    int64_t* caps, int64_t* lane_added, int64_t* lane_taken);
 int pt_encode_batch(const double* added, const double* taken,
                     const uint64_t* elapsed, const uint8_t* names,
-                    const int* name_lens, const int* origin_slots, int n,
+                    const int* name_lens, const int* origin_slots,
+                    const int64_t* caps, const int64_t* lane_added,
+                    const int64_t* lane_taken, int n,
                     uint8_t* out, int* out_sizes);
 }
 
@@ -58,6 +61,7 @@ int main() {
     uint64_t elapsed[BATCH];
     uint8_t names[BATCH * PACKET];
     int name_lens[BATCH], slots[BATCH], sizes[BATCH];
+    int64_t caps[BATCH], lane_a[BATCH], lane_t[BATCH];
     uint8_t out[BATCH * PACKET];
     for (int r = 0; r < ROUNDS && !stop.load(); ++r) {
       for (int i = 0; i < BATCH; ++i) {
@@ -68,9 +72,13 @@ int main() {
                          "bucket-%d-%d", seed, i);
         name_lens[i] = n;
         slots[i] = i & 0xFF;
+        // Mix the three trailer forms across the batch.
+        caps[i] = (i % 3 == 0) ? -1 : 1000000000LL * (i + 1);
+        lane_a[i] = (i % 3 == 2) ? 500000000LL * i : -1;
+        lane_t[i] = (i % 3 == 2) ? 250000000LL * i : -1;
       }
-      pt_encode_batch(added, taken, elapsed, names, name_lens, slots, BATCH,
-                      out, sizes);
+      pt_encode_batch(added, taken, elapsed, names, name_lens, slots, caps,
+                      lane_a, lane_t, BATCH, out, sizes);
       pt_send_fanout(tx, out, sizes, BATCH, &loop_ip, &rx_port, 1);
     }
   };
@@ -84,11 +92,12 @@ int main() {
     uint64_t elapsed[BATCH];
     uint8_t names[BATCH * PACKET];
     int name_lens[BATCH], slots[BATCH];
+    int64_t caps[BATCH], lane_a[BATCH], lane_t[BATCH];
     while (!stop.load()) {
       int n = pt_recv_batch(rx, buf, BATCH, sizes, ips, ports, 50);
       if (n <= 0) continue;
       pt_decode_batch(buf, sizes, n, added, taken, elapsed, names, name_lens,
-                      slots);
+                      slots, caps, lane_a, lane_t);
       received.fetch_add(n);
     }
   };

@@ -200,6 +200,37 @@ class TestReplication:
             for cl in clients:
                 cl.close()
 
+    def test_oversize_name_replicates_and_rehydrates(self, cluster):
+        """Names in (lane-trailer limit 201, v1 limit 231] can't carry the
+        v2 trailer: broadcasts AND incast replies must fall back to
+        trailer-less v1 packets (capacity-included header, sender-address
+        slot resolution) rather than dropping the state."""
+        name = "o" * 210
+        clients = [KeepAliveClient(p) for p in cluster.api_ports]
+        try:
+            for _ in range(3):
+                status, _ = clients[0].take(name, "3:1h")
+                assert status == 200
+            # Replication fallback: peer converges via v1 broadcast.
+            deadline = time.time() + 5
+            ok = False
+            while time.time() < deadline and not ok:
+                status, _ = clients[1].take(name, "3:1h")
+                ok = status == 429
+                time.sleep(0.05)
+            assert ok, "oversize-name broadcast did not converge"
+            # Incast fallback: a cold node's request must get a reply.
+            deadline = time.time() + 5
+            ok = False
+            while time.time() < deadline and not ok:
+                status, _ = clients[2].take(name, "3:1h")
+                ok = status == 429
+                time.sleep(0.05)
+            assert ok, "oversize-name incast reply was dropped"
+        finally:
+            for cl in clients:
+                cl.close()
+
     def test_load_cluster_wide_limit(self, cluster):
         """60 requests round-robin against a 10-token burst bucket spread over
         all nodes (≙ command_test.go:79-107's cluster-wide limit assertion).

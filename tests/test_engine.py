@@ -125,9 +125,17 @@ class TestEngine:
         engine.flush()
         states = engine.snapshot("s")
         by_slot = {s.origin_slot: s for s in states}
-        assert by_slot[0].taken_nt == 3 * NANO
-        assert by_slot[1].added_nt == NANO
-        assert by_slot[1].taken_nt == 2 * NANO
+        # Dual payload (ops/wire.py): the float header carries the aggregate
+        # scalar view (capacity-included added, total taken — what reference
+        # peers max-merge); the trailer carries the exact per-lane values.
+        cap = 10 * NANO  # RATE = 10:1s
+        for s in states:
+            assert s.cap_nt == cap
+            assert s.added_nt == cap + NANO  # cap + Σ lane grants (1 ingested)
+            assert s.taken_nt == 5 * NANO  # 3 local + 2 ingested
+        assert by_slot[0].lane_taken_nt == 3 * NANO
+        assert by_slot[1].lane_added_nt == NANO
+        assert by_slot[1].lane_taken_nt == 2 * NANO
 
     def test_broadcast_hook_and_zero_suppression(self):
         got = []
@@ -244,9 +252,130 @@ class TestEviction:
         )
         engine.flush()
         by_slot = {s.origin_slot: s for s in engine.snapshot("v")}
-        assert by_slot[1].added_nt == 2 * NANO and by_slot[1].taken_nt == NANO
-        assert by_slot[2].added_nt == 3 * NANO
-        assert engine.snapshot("w")[0].added_nt == NANO
+        assert by_slot[1].lane_added_nt == 2 * NANO
+        assert by_slot[1].lane_taken_nt == NANO
+        assert by_slot[2].lane_added_nt == 3 * NANO
+        # Header carries the aggregate scalars (cap 0: no local take yet).
+        assert by_slot[1].added_nt == 5 * NANO and by_slot[1].taken_nt == NANO
+        assert engine.snapshot("w")[0].lane_added_nt == NANO
+
+
+class TestIngestWireSemantics:
+    """The mixed-cluster ingest contract (ops/wire.py): each sender class
+    must route through the right merge path — exact lane values for lane
+    trailers, raw lane for base (cap-less) trailers, deficit attribution
+    for aggregate headers (with-cap trailers and v1 packets)."""
+
+    def test_with_cap_only_routes_to_deficit_attribution(self, engine):
+        """A with-cap trailer's header is the sender's AGGREGATE: merging
+        it into the sender's lane directly would double-count every other
+        lane's echoed grants. It must deficit-attribute with the wire cap."""
+        engine.take("wc", RATE, 2)  # own lane: taken=2, cap_base=10
+        cap = 10 * NANO
+        # Peer (slot 1) echoes our 2 takes plus 2 of its own; its added
+        # aggregate is cap + 3 grants.
+        engine.ingest_delta(
+            wire.from_nanotokens(
+                "wc", cap + 3 * NANO, 4 * NANO, 0, origin_slot=1, cap_nt=cap
+            ),
+            slot=1,
+        )
+        engine.flush()
+        by_slot = {s.origin_slot: s for s in engine.snapshot("wc")}
+        assert by_slot[1].lane_added_nt == 3 * NANO  # header − wire cap
+        assert by_slot[1].lane_taken_nt == 2 * NANO  # 4 − our echoed 2
+
+    def test_v1_dropped_until_capacity_known_then_attributed(self, engine):
+        """A v1 (reference) delta on a row with unknown capacity is dropped
+        (the lazy-init cap can't be separated from grants); once a local
+        take reveals the capacity, the rebroadcast lands."""
+        v1 = wire.from_nanotokens("v1b", 13 * NANO, 4 * NANO, 0)
+        engine.ingest_delta(v1, slot=1, scalar=True)
+        engine.flush()
+        assert engine.scalar_dropped == 1
+        engine.take("v1b", RATE, 1)  # cap_base now 10; own taken=1
+        engine.ingest_delta(v1, slot=1, scalar=True)  # the rebroadcast
+        engine.flush()
+        by_slot = {s.origin_slot: s for s in engine.snapshot("v1b")}
+        assert by_slot[1].lane_added_nt == 3 * NANO  # 13 − our cap 10
+        assert by_slot[1].lane_taken_nt == 3 * NANO  # 4 − our echoed 1
+
+    def test_batch_classification_all_sender_classes(self, engine):
+        """One vectorized batch mixing all four sender classes must land
+        each delta through its own merge path."""
+        cap = 10 * NANO
+        engine.take("bv", RATE, 1)  # reveal capacity for the v1 delta
+        engine.ingest_deltas_batch(
+            ["bl", "bc", "bv", "bb"],
+            [2, 2, 2, 2],
+            [NANO, cap + 3 * NANO, cap + 3 * NANO, 0],
+            [2 * NANO, 4 * NANO, 4 * NANO, 5 * NANO],
+            [0, 0, 0, 0],
+            caps_nt=[cap, cap, -1, -1],
+            lane_added_nt=[NANO, -1, -1, -1],
+            lane_taken_nt=[2 * NANO, -1, -1, -1],
+            scalar=[False, False, True, False],
+        )
+        engine.flush()
+        lane = {
+            n: {s.origin_slot: s for s in engine.snapshot(n)}[2]
+            for n in ("bl", "bc", "bv", "bb")
+        }
+        # Lane trailer: exact values (the header aggregate is ignored).
+        assert lane["bl"].lane_added_nt == NANO
+        assert lane["bl"].lane_taken_nt == 2 * NANO
+        # With-cap trailer: deficit attribution with the WIRE cap (fresh
+        # row, no other lanes ⇒ full header-minus-cap attributed).
+        assert lane["bc"].lane_added_nt == 3 * NANO
+        assert lane["bc"].lane_taken_nt == 4 * NANO
+        # v1 packet: deficit attribution against our lane (taken 1).
+        assert lane["bv"].lane_added_nt == 3 * NANO
+        assert lane["bv"].lane_taken_nt == 3 * NANO
+        # Base (cap-less) trailer: raw own-lane header, no cap subtraction.
+        assert lane["bb"].lane_added_nt == 0
+        assert lane["bb"].lane_taken_nt == 5 * NANO
+
+    def test_batch_scalar_without_caps_matches_single_delta_path(self, engine):
+        """scalar flags must be honored even without a caps array — parity
+        with ingest_delta(state, slot, scalar=True)."""
+        engine.take("nsc", RATE, 1)  # cap_base 10, own taken 1
+        engine.ingest_deltas_batch(
+            ["nsc"], [1], [13 * NANO], [4 * NANO], [0], scalar=[True]
+        )
+        engine.flush()
+        by_slot = {s.origin_slot: s for s in engine.snapshot("nsc")}
+        assert by_slot[1].lane_added_nt == 3 * NANO  # 13 − our cap 10
+        assert by_slot[1].lane_taken_nt == 3 * NANO  # 4 − our echoed 1
+
+    def test_lane_merges_apply_before_scalar_in_one_tick(self, engine):
+        """A scalar echo's aggregate already includes peer lanes broadcast
+        before it. If the deficit attribution ran before those lane deltas
+        landed (they share a tick), the echoed grants would be attributed
+        to the reference peer's lane AND merged into the patrol peer's lane
+        — a permanent double count (lanes are monotone max)."""
+        cap = 10 * NANO
+        engine.take("ord", RATE, 1)  # own lane taken=1, cap known
+        # Scalar delta FIRST in the batch: reference peer (slot 1) echoes
+        # patrol peer slot 2's grant of 5 in its aggregate.
+        engine.ingest_deltas_batch(
+            ["ord", "ord"],
+            [1, 2],
+            [cap + 5 * NANO, cap + 5 * NANO],
+            [NANO, NANO],
+            [0, 0],
+            caps_nt=[-1, cap],
+            lane_added_nt=[-1, 5 * NANO],
+            lane_taken_nt=[-1, 0],
+            scalar=[True, False],
+        )
+        engine.flush()
+        by_slot = {s.origin_slot: s for s in engine.snapshot("ord")}
+        # Slot 2's lane lands first; the echo's 5 is then fully explained
+        # by it ⇒ nothing attributed to slot 1.
+        assert by_slot[2].lane_added_nt == 5 * NANO
+        assert by_slot.get(1) is None or by_slot[1].lane_added_nt == 0
+        total_added = sum(s.lane_added_nt for s in by_slot.values())
+        assert total_added == 5 * NANO  # NOT 10: no double count
 
 
 class TestTPURepo:

@@ -84,8 +84,10 @@ class TestMeshEngineBehavior:
         eng.flush()
         assert eng.tokens("m") == 3  # 10 - 2 - 5
         states = {s.origin_slot: s for s in eng.snapshot("m")}
-        assert states[0].taken_nt == 2 * NANO
-        assert states[2].taken_nt == 5 * NANO
+        # Header = aggregate scalars; trailer = exact lane (ops/wire.py).
+        assert states[0].taken_nt == 7 * NANO
+        assert states[0].lane_taken_nt == 2 * NANO
+        assert states[2].lane_taken_nt == 5 * NANO
 
     def test_broadcast_hook(self):
         got = []
@@ -95,7 +97,8 @@ class TestMeshEngineBehavior:
             eng.flush()
             assert len(got) == 1
             st = got[0][0]
-            assert st.origin_slot == 1 and st.taken_nt == 4 * NANO
+            assert st.origin_slot == 1 and st.lane_taken_nt == 4 * NANO
+            assert st.taken_nt == 4 * NANO  # aggregate == own lane: sole node
         finally:
             eng.stop()
 

@@ -20,6 +20,12 @@ class TestCodecCrossValidation:
             wire.WireState("b", 0.0, 0.0, 0, origin_slot=0),
             wire.WireState("no-trailer", 9.0, 2.0, -5),
             wire.WireState("µ≠ascii", 1.0, 1.0, 7, origin_slot=65535),
+            wire.WireState("with-cap", 12.0, 3.0, 55, origin_slot=9, cap_nt=10 * wire.NANO),
+            wire.WireState("cap-zero", 1.0, 0.0, 1, origin_slot=2, cap_nt=0),
+            wire.WireState(
+                "lane", 12.0, 3.0, 55, origin_slot=1, cap_nt=10 * wire.NANO,
+                lane_added_nt=2 * wire.NANO, lane_taken_nt=wire.NANO,
+            ),
         ]
         packets, sizes = native.encode_batch(
             [s.added for s in states],
@@ -27,6 +33,9 @@ class TestCodecCrossValidation:
             [s.elapsed_ns for s in states],
             [s.name for s in states],
             [s.origin_slot if s.origin_slot is not None else -1 for s in states],
+            [s.cap_nt if s.cap_nt is not None else -1 for s in states],
+            [s.lane_added_nt if s.lane_added_nt is not None else -1 for s in states],
+            [s.lane_taken_nt if s.lane_taken_nt is not None else -1 for s in states],
         )
         for i, s in enumerate(states):
             want = wire.encode(s)
@@ -38,6 +47,22 @@ class TestCodecCrossValidation:
             wire.WireState("x" * 100, 1e9, 2.5, 99, origin_slot=12),
             wire.WireState("", 0.5, 0.25, 2**40),
             wire.WireState("k", -3.0, float("inf"), -1),
+            wire.WireState("capped", 7.0, 1.0, 3, origin_slot=4, cap_nt=5 * wire.NANO),
+            wire.WireState(
+                "laned", 7.0, 1.0, 3, origin_slot=4, cap_nt=5 * wire.NANO,
+                lane_added_nt=wire.NANO, lane_taken_nt=2 * wire.NANO,
+            ),
+            # Hostile bit-63 trailer fields: both decoders must drop the
+            # WHOLE trailer (all-or-nothing), not partially honor it.
+            wire.WireState(
+                "evil-lane", 7.0, 1.0, 3, origin_slot=4, cap_nt=5 * wire.NANO,
+                lane_added_nt=1 << 63, lane_taken_nt=2 * wire.NANO,
+            ),
+            wire.WireState(
+                "evil-cap", 7.0, 1.0, 3, origin_slot=4, cap_nt=1 << 63,
+                lane_added_nt=wire.NANO, lane_taken_nt=2 * wire.NANO,
+            ),
+            wire.WireState("evil-caponly", 7.0, 1.0, 3, origin_slot=4, cap_nt=1 << 63),
         ]
         pkts = np.zeros((len(raw_states), native.PACKET), np.uint8)
         sizes = np.zeros(len(raw_states), np.int32)
@@ -45,7 +70,7 @@ class TestCodecCrossValidation:
             data = wire.encode(s)
             pkts[i, : len(data)] = np.frombuffer(data, np.uint8)
             sizes[i] = len(data)
-        added, taken, elapsed, names, slots, valid = native.decode_batch(pkts, sizes)
+        added, taken, elapsed, names, slots, valid, caps, lane_a, lane_t = native.decode_batch(pkts, sizes)
         for i, s in enumerate(raw_states):
             ref = wire.decode(bytes(pkts[i, : sizes[i]]))
             assert valid[i]
@@ -55,12 +80,17 @@ class TestCodecCrossValidation:
             assert int(elapsed[i]) == ref.elapsed_ns
             want_slot = ref.origin_slot if ref.origin_slot is not None else -1
             assert int(slots[i]) == want_slot
+            want_cap = ref.cap_nt if ref.cap_nt is not None else -1
+            assert int(caps[i]) == want_cap
+            want_la = ref.lane_added_nt if ref.lane_added_nt is not None else -1
+            want_lt = ref.lane_taken_nt if ref.lane_taken_nt is not None else -1
+            assert int(lane_a[i]) == want_la and int(lane_t[i]) == want_lt
 
     def test_malformed_marked_invalid(self):
         pkts = np.zeros((2, native.PACKET), np.uint8)
         sizes = np.array([10, 25], np.int32)  # short; header claims name > len
         pkts[1, 24] = 200
-        _, _, _, _, _, valid = native.decode_batch(pkts, sizes)
+        _, _, _, _, _, valid, _, _, _ = native.decode_batch(pkts, sizes)
         assert not valid[0]
         assert not valid[1]
 
@@ -73,7 +103,7 @@ class TestCodecCrossValidation:
         names = [f"bucket-{i}-{'x' * int(rng.integers(0, 100))}" for i in range(n)]
         slots = rng.integers(0, 256, n).astype(np.int32)
         pkts, sizes = native.encode_batch(added, taken, elapsed, names, slots)
-        a2, t2, e2, n2, s2, valid = native.decode_batch(pkts, sizes)
+        a2, t2, e2, n2, s2, valid, *_ = native.decode_batch(pkts, sizes)
         assert valid.all()
         np.testing.assert_array_equal(added, a2)
         np.testing.assert_array_equal(taken, t2)
@@ -106,7 +136,7 @@ class TestNativeSocket:
             deadline = time.time() + 2
             while len(got) < 20 and time.time() < deadline:
                 packets, szs, ips, ports = rx.recv_batch(timeout_ms=200)
-                a, t, e, names, slots, valid = native.decode_batch(packets, szs)
+                a, t, e, names, slots, valid, *_ = native.decode_batch(packets, szs)
                 for i in range(len(names)):
                     if valid[i]:
                         got[names[i]] = (a[i], int(slots[i]))
@@ -128,7 +158,7 @@ class TestNativeSocket:
             for rx in (rx1, rx2):
                 packets, szs, _, _ = rx.recv_batch(timeout_ms=1000)
                 assert len(packets) == 1
-                _, _, _, names, _, valid = native.decode_batch(packets, szs)
+                _, _, _, names, _, valid, *_ = native.decode_batch(packets, szs)
                 assert valid[0] and names[0] == "m"
         finally:
             rx1.close()

@@ -180,3 +180,110 @@ class TestNanotokenBoundary:
         under 0.5 nanotokens in that range)."""
         s = from_nanotokens("k", nt, 0, 0)
         assert decode(encode(s)).added_nt == nt
+
+
+class TestTrailerForms:
+    """The three v2 trailer forms (base / with-cap / lane) and their
+    reference-compatibility properties (see the module docstring)."""
+
+    @given(
+        slot=st.integers(0, 65535),
+        cap=st.integers(0, (1 << 62)),
+        la=st.integers(0, (1 << 62)),
+        lt=st.integers(0, (1 << 62)),
+    )
+    @settings(max_examples=200)
+    def test_roundtrip_lane_form(self, slot, cap, la, lt):
+        s = WireState(
+            name="bkt", added=7.5, taken=2.0, elapsed_ns=9,
+            origin_slot=slot, cap_nt=cap, lane_added_nt=la, lane_taken_nt=lt,
+        )
+        out = decode(encode(s))
+        assert out == s
+
+    def test_roundtrip_cap_form(self):
+        s = WireState(
+            name="c", added=1.0, taken=0.0, elapsed_ns=1,
+            origin_slot=3, cap_nt=5 * wire.NANO,
+        )
+        out = decode(encode(s))
+        assert out.cap_nt == 5 * wire.NANO
+        assert out.lane_added_nt is None
+
+    def test_trailer_sizes(self):
+        base = encode(WireState("x", 1.0, 0.0, 0, origin_slot=1))
+        cap = encode(WireState("x", 1.0, 0.0, 0, origin_slot=1, cap_nt=0))
+        lane = encode(
+            WireState(
+                "x", 1.0, 0.0, 0, origin_slot=1, cap_nt=0,
+                lane_added_nt=0, lane_taken_nt=0,
+            )
+        )
+        assert len(cap) - len(base) == wire.TRAILER_CAP_SIZE - wire.TRAILER_SIZE
+        assert len(lane) - len(base) == wire.TRAILER_LANE_SIZE - wire.TRAILER_SIZE
+
+    def test_reference_decoder_view_is_aggregate(self):
+        """A reference node reads exactly data[:25+L] (bucket.go:71-91): the
+        header it sees must be the aggregate scalars, unchanged by any
+        trailer form."""
+        s = WireState(
+            name="agg", added=12.5, taken=3.0, elapsed_ns=77,
+            origin_slot=4, cap_nt=10 * wire.NANO,
+            lane_added_nt=2 * wire.NANO, lane_taken_nt=wire.NANO,
+        )
+        data = encode(s)
+        truncated = data[: FIXED_SIZE + len(b"agg")]  # the reference's read
+        ref_view = decode(truncated)
+        assert ref_view.added == 12.5 and ref_view.taken == 3.0
+        assert ref_view.elapsed_ns == 77
+        assert ref_view.origin_slot is None  # and no phantom trailer
+
+    def test_lane_name_limit(self):
+        name = "x" * wire.MAX_NAME_LENGTH
+        data = encode(
+            WireState(
+                name, 1.0, 0.0, 0, origin_slot=0, cap_nt=1,
+                lane_added_nt=1, lane_taken_nt=1,
+            )
+        )
+        assert len(data) == PACKET_SIZE
+        with pytest.raises(NameTooLargeError):
+            encode(
+                WireState(
+                    name + "x", 1.0, 0.0, 0, origin_slot=0, cap_nt=1,
+                    lane_added_nt=1, lane_taken_nt=1,
+                )
+            )
+
+    def test_hostile_bit63_fields_drop_whole_trailer(self):
+        """A crafted trailer with ANY bit-63 value is discarded whole.
+
+        Partial honoring would be exploitable: keeping cap_nt while
+        dropping the lane fields routes the packet through the with-cap
+        ingest path, merging the header's AGGREGATE into the sender's
+        single lane — permanent PN-sum inflation from one crafted packet.
+        Dropping the trailer degrades the packet to v1 (deficit-attribution
+        ingest), which is safe for aggregate headers."""
+        for cap, la, lt in [
+            ((1 << 63) - 1, 1 << 63, 1),  # hostile lane_added
+            ((1 << 63) - 1, 1, 1 << 63),  # hostile lane_taken
+            (1 << 63, 1, 1),  # hostile cap
+        ]:
+            s = WireState(
+                "h", 1.0, 0.0, 0, origin_slot=0, cap_nt=cap,
+                lane_added_nt=la, lane_taken_nt=lt,
+            )
+            out = decode(encode(s))
+            assert out.origin_slot is None
+            assert out.cap_nt is None
+            assert out.lane_added_nt is None and out.lane_taken_nt is None
+        # Valid int64 max everywhere still decodes in full.
+        s = WireState(
+            "h", 1.0, 0.0, 0, origin_slot=0, cap_nt=(1 << 63) - 1,
+            lane_added_nt=(1 << 63) - 1, lane_taken_nt=(1 << 63) - 1,
+        )
+        out = decode(encode(s))
+        assert out.origin_slot == 0
+        assert out.cap_nt == (1 << 63) - 1
+        assert out.lane_added_nt == (1 << 63) - 1
+        assert out.lane_taken_nt == (1 << 63) - 1
