@@ -117,7 +117,7 @@ class API:
         if path == "/debug/pprof/" or path == "/debug/pprof":
             index = (
                 "patrol_tpu debug index\n\n"
-                "/debug/pprof/profile?seconds=N  sampling CPU profile (all threads)\n"
+                "/debug/pprof/profile?seconds=N  sampling CPU profile, pprof protobuf (&debug=1 for text)\n"
                 "/debug/pprof/goroutine          thread stack dump\n"
                 "/debug/pprof/heap               allocation summary\n"
                 "/debug/pprof/allocs             allocation summary\n"
@@ -129,8 +129,14 @@ class API:
         if path == "/debug/pprof/profile":
             seconds = float(q.get("seconds", ["5"])[0])
             prof = profiling.SamplingProfiler(duration_s=seconds)
-            body = await loop.run_in_executor(None, prof.run)
-            return 200, body.encode(), "text/plain"
+            # Go convention (api.go:29-39): gzipped pprof protobuf by
+            # default — `go tool pprof http://host/debug/pprof/profile`
+            # and speedscope open it; ?debug=1 for human-readable text.
+            if q.get("debug", ["0"])[0] not in ("0", ""):
+                body = await loop.run_in_executor(None, prof.run)
+                return 200, body.encode(), "text/plain"
+            raw = await loop.run_in_executor(None, prof.run_pprof)
+            return 200, raw, "application/octet-stream"
         if path in ("/debug/pprof/goroutine", "/debug/pprof/threadcreate"):
             return 200, profiling.thread_dump().encode(), "text/plain"
         if path in ("/debug/pprof/heap", "/debug/pprof/allocs", "/debug/pprof/block", "/debug/pprof/mutex"):

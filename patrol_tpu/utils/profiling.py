@@ -27,32 +27,57 @@ from typing import Dict, Optional
 
 class SamplingProfiler:
     """Sample every thread's stack at ``interval_s`` for ``duration_s``;
-    report leaf-frame and whole-stack counts as text."""
+    report as pprof protobuf (:meth:`run_pprof`, ≙ ``pprof.Profile``'s
+    sampled CPU profile — opens in ``go tool pprof`` / speedscope) or as
+    human-readable text (:meth:`run`)."""
 
     def __init__(self, duration_s: float = 5.0, interval_s: float = 0.005):
         self.duration_s = min(duration_s, 120.0)
         self.interval_s = interval_s
 
-    def run(self) -> str:
-        leaf: Counter = Counter()
+    def _collect(self) -> Counter:
+        """Counter over stack tuples, each a tuple of
+        ``(qualname, filename, line)`` frames leaf-first."""
         stacks: Counter = Counter()
-        samples = 0
         deadline = time.monotonic() + self.duration_s
         me = threading.get_ident()
         while time.monotonic() < deadline:
             for tid, frame in sys._current_frames().items():
                 if tid == me:
                     continue
-                samples += 1
-                code = frame.f_code
-                leaf[f"{code.co_qualname} ({code.co_filename}:{frame.f_lineno})"] += 1
                 stack = []
                 f: Optional[object] = frame
                 while f is not None:
-                    stack.append(f.f_code.co_qualname)  # type: ignore[attr-defined]
+                    code = f.f_code  # type: ignore[attr-defined]
+                    stack.append(
+                        (code.co_qualname, code.co_filename, f.f_lineno)  # type: ignore[attr-defined]
+                    )
                     f = f.f_back  # type: ignore[attr-defined]
-                stacks[";".join(reversed(stack))] += 1
+                stacks[tuple(stack)] += 1
             time.sleep(self.interval_s)
+        return stacks
+
+    def run_pprof(self) -> bytes:
+        """Gzipped pprof protobuf (profile.proto), the reference's
+        ``/debug/pprof/profile`` artifact class (api.go:29-39)."""
+        from patrol_tpu.utils.pprof import build_profile
+
+        stacks = self._collect()
+        return build_profile(
+            stacks,
+            period_ns=int(self.interval_s * 1e9),
+            duration_ns=int(self.duration_s * 1e9),
+        )
+
+    def run(self) -> str:
+        stacks = self._collect()
+        samples = sum(stacks.values())
+        leaf: Counter = Counter()
+        flat: Counter = Counter()
+        for stack, n in stacks.items():
+            name, filename, line = stack[0]
+            leaf[f"{name} ({filename}:{line})"] += n
+            flat[";".join(f[0] for f in reversed(stack))] += n
 
         lines = [
             f"sampling cpu profile: {self.duration_s:.1f}s at "
@@ -63,7 +88,7 @@ class SamplingProfiler:
         for name, n in leaf.most_common(30):
             lines.append(f"{n:8d}  {name}")
         lines += ["", "-- hottest stacks --"]
-        for stack, n in stacks.most_common(10):
+        for stack, n in flat.most_common(10):
             lines.append(f"{n:8d}  {stack}")
         return "\n".join(lines) + "\n"
 

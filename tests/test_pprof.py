@@ -1,0 +1,130 @@
+"""pprof protobuf writer tests: decode the emitted profile with an
+independent minimal protobuf reader and check it round-trips the sampled
+stacks — the contract that `go tool pprof` / speedscope can open the
+artifact (reference bar: api.go:29-39's net/http/pprof endpoints)."""
+
+import gzip
+from collections import Counter
+
+from patrol_tpu.utils.pprof import build_profile
+from patrol_tpu.utils.profiling import SamplingProfiler
+
+
+def _read_varint(data: bytes, i: int):
+    shift = val = 0
+    while True:
+        b = data[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def _parse_message(data: bytes):
+    """Parse one protobuf message into {field_num: [values]}; values are
+    ints (varint) or bytes (length-delimited)."""
+    fields = {}
+    i = 0
+    while i < len(data):
+        tag, i = _read_varint(data, i)
+        num, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, i = _read_varint(data, i)
+        elif wt == 2:
+            ln, i = _read_varint(data, i)
+            val = data[i : i + ln]
+            i += ln
+        else:  # pragma: no cover - the writer never emits other wire types
+            raise AssertionError(f"unexpected wire type {wt}")
+        fields.setdefault(num, []).append(val)
+    return fields
+
+
+def _parse_packed_varints(data: bytes):
+    out, i = [], 0
+    while i < len(data):
+        v, i = _read_varint(data, i)
+        out.append(v)
+    return out
+
+
+class TestBuildProfile:
+    def _decode(self, blob: bytes):
+        prof = _parse_message(gzip.decompress(blob))
+        strings = [s.decode() for s in prof[6]]
+        assert strings[0] == ""  # profile.proto invariant
+        functions = {}
+        for f in prof.get(5, []):
+            m = _parse_message(f)
+            functions[m[1][0]] = (strings[m[2][0]], strings[m[4][0]])
+        locations = {}
+        for loc in prof.get(4, []):
+            m = _parse_message(loc)
+            line = _parse_message(m[4][0])
+            fid, lineno = line[1][0], line[2][0]
+            locations[m[1][0]] = functions[fid] + (lineno,)
+        samples = {}
+        for s in prof.get(2, []):
+            m = _parse_message(s)
+            loc_ids = _parse_packed_varints(m[1][0])
+            values = _parse_packed_varints(m[2][0])
+            stack = tuple(
+                (locations[l][0], locations[l][1], locations[l][2]) for l in loc_ids
+            )
+            samples[stack] = values
+        return prof, strings, samples
+
+    def test_round_trips_stacks(self):
+        stacks = Counter(
+            {
+                (("leaf", "a.py", 10), ("mid", "a.py", 20), ("main", "b.py", 5)): 7,
+                (("other", "c.py", 3), ("main", "b.py", 6)): 2,
+            }
+        )
+        blob = build_profile(stacks, period_ns=5_000_000, duration_ns=10**9)
+        prof, strings, samples = self._decode(blob)
+        assert samples[(("leaf", "a.py", 10), ("mid", "a.py", 20), ("main", "b.py", 5))] == [
+            7,
+            7 * 5_000_000,
+        ]
+        assert samples[(("other", "c.py", 3), ("main", "b.py", 6))] == [2, 10_000_000]
+        # sample_type: (samples/count, cpu/nanoseconds)
+        st = [_parse_message(v) for v in prof[1]]
+        assert [strings[m[1][0]] for m in st] == ["samples", "cpu"]
+        assert [strings[m[2][0]] for m in st] == ["count", "nanoseconds"]
+        assert prof[12] == [5_000_000]  # period
+        assert prof[10] == [10**9]  # duration_nanos
+
+    def test_shared_frames_dedupe_locations(self):
+        stacks = Counter(
+            {
+                (("f", "x.py", 1), ("g", "x.py", 9)): 1,
+                (("h", "x.py", 2), ("g", "x.py", 9)): 1,
+            }
+        )
+        prof = _parse_message(gzip.decompress(build_profile(stacks, 1000, 1000)))
+        assert len(prof[4]) == 3  # f, h, and ONE shared g location
+        assert len(prof[5]) == 3  # three distinct functions
+
+    def test_live_profiler_emits_decodable_profile(self):
+        import threading
+        import time
+
+        stop = threading.Event()
+
+        def busy():
+            while not stop.is_set():
+                sum(range(100))
+                time.sleep(0)
+
+        t = threading.Thread(target=busy, daemon=True)
+        t.start()
+        try:
+            blob = SamplingProfiler(duration_s=0.2, interval_s=0.01).run_pprof()
+        finally:
+            stop.set()
+            t.join()
+        _, strings, samples = self._decode(blob)
+        assert samples, "no stacks sampled"
+        assert any("busy" in s for s in strings)
