@@ -395,51 +395,76 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
         f"codec={'c++' if use_native else 'py'}"
     )
 
+    from patrol_tpu.ops import wire as wire_mod
+
     cfg = LimiterConfig(buckets=B, nodes=N)
     engine = DeviceEngine(cfg, node_slot=0)
     try:
         chunk = 8_192
-        # Pre-encode ONE chunk of packets; a sliding window over a
-        # pre-built name pool makes the directory see every key.
-        names = [f"bench-bucket-{i}" for i in range(chunk)]
-        name_pool = [f"k{j}" for j in range(directory_keys)]
+        # Pre-encode SEVERAL chunks of packets over a rotating key window so
+        # the directory sees every one of directory_keys names; replay then
+        # cycles the pre-encoded chunks through the production rx pipeline:
+        # C++ decode (reused buffers) → vectorized hash-table resolve →
+        # classify → device merge. This is the path the native rx thread
+        # runs (net/native_replication._rx_loop).
+        n_windows = max(1, directory_keys // chunk)
         t_decode = t_dir = 0.0
         done = 0
-        t0 = time.perf_counter()
         key_off = 0
+        windows = []
         if use_native:
-            pkts, sizes = native.encode_batch(
-                [1.5 + (i % 97) * 0.25 for i in range(chunk)],
-                [0.5 + (i % 89) * 0.125 for i in range(chunk)],
-                [10_000_000 + i for i in range(chunk)],
-                names,
-                [int(i % N) for i in range(chunk)],
-            )
+            for w in range(n_windows):
+                base = w * chunk
+                names = [f"k{base + j}" for j in range(chunk)]
+                pkts, sizes = native.encode_batch(
+                    [1.5 + (i % 97) * 0.25 for i in range(chunk)],
+                    [0.5 + (i % 89) * 0.125 for i in range(chunk)],
+                    [10_000_000 + i for i in range(chunk)],
+                    names,
+                    [int(i % N) for i in range(chunk)],
+                )
+                windows.append((pkts, sizes))
+            dbuf = None
+        else:
+            name_pool = [f"k{j}" for j in range(directory_keys)]
+        t0 = time.perf_counter()
         while done < n_deltas and _left() > 45:
             if use_native:
+                pkts, sizes = windows[(key_off // chunk) % n_windows]
+                key_off += chunk
                 td = time.perf_counter()
-                added, taken, elapsed, dnames, slots, valid, *_rest = native.decode_batch(
-                    pkts, sizes
-                )
+                dbuf, n_dec = native.decode_batch_raw(pkts, sizes, dbuf)
                 t_decode += time.perf_counter() - td
+                tdir = time.perf_counter()
+                engine.ingest_deltas_batch_raw(
+                    n_dec,
+                    dbuf.names,
+                    dbuf.name_lens,
+                    dbuf.hashes,
+                    dbuf.slots[:n_dec].astype(np.int64),
+                    wire_mod.sanitize_nt_array(dbuf.added[:n_dec]),
+                    wire_mod.sanitize_nt_array(dbuf.taken[:n_dec]),
+                    np.maximum(dbuf.elapsed[:n_dec].astype(np.int64), 0),
+                    dbuf.caps[:n_dec],
+                    dbuf.lane_a[:n_dec],
+                    dbuf.lane_t[:n_dec],
+                    np.zeros(n_dec, bool),
+                )
+                t_dir += time.perf_counter() - tdir
             else:
                 slots = np.arange(chunk) % N
-                added = np.full(chunk, 1.5)
-                taken = np.full(chunk, 0.5)
-                elapsed = np.full(chunk, 10_000_000, np.uint64)
-            # rotate the key window so directory_keys distinct names appear
-            base = key_off % max(directory_keys - chunk, 1)
-            key_off += chunk
-            renamed = name_pool[base : base + chunk]
-            tdir = time.perf_counter()
-            engine.ingest_deltas_batch(
-                renamed,
-                np.asarray(slots, np.int64),
-                (np.asarray(added) * 1e9).astype(np.int64),
-                (np.asarray(taken) * 1e9).astype(np.int64),
-                np.asarray(elapsed).astype(np.int64),
-            )
-            t_dir += time.perf_counter() - tdir
+                base = key_off % max(directory_keys - chunk, 1)
+                key_off += chunk
+                renamed = name_pool[base : base + chunk]
+                tdir = time.perf_counter()
+                engine.ingest_deltas_batch(
+                    renamed,
+                    np.asarray(slots, np.int64),
+                    np.full(chunk, int(1.5e9), np.int64),
+                    np.full(chunk, int(0.5e9), np.int64),
+                    np.full(chunk, 10_000_000, np.int64),
+                )
+                t_dir += time.perf_counter() - tdir
             done += chunk
             while engine.backlog() > 65_536 and _left() > 45:  # backpressure
                 time.sleep(0.001)

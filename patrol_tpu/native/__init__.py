@@ -80,7 +80,7 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pt_send_fanout.restype = ctypes.c_int
         lib.pt_decode_batch.argtypes = [
             _u8p, _i32p, ctypes.c_int, _f64p, _f64p, _u64p, _u8p, _i32p, _i32p,
-            _i64p, _i64p, _i64p,
+            _i64p, _i64p, _i64p, _u64p,
         ]
         lib.pt_decode_batch.restype = ctypes.c_int
         lib.pt_encode_batch.argtypes = [
@@ -149,36 +149,65 @@ class NativeSocket:
         self.lib.pt_udp_close(self.fd)
 
 
-def decode_batch(packets: np.ndarray, sizes: np.ndarray):
-    """Vectorized wire decode → (added[f64], taken[f64], elapsed[i64],
-    names[list[str]], origin_slots[i32], valid[bool], caps[i64], lane_added
-    [i64], lane_taken[i64]) — caps/lane values in nanotokens, -1 = absent."""
+class DecodeBuffers:
+    """Reusable output buffers for :func:`decode_batch_raw` — the rx loop
+    allocates once instead of zeroing ~2 MB of numpy arrays per batch
+    (pt_decode_batch re-zeroes each valid name row itself)."""
+
+    def __init__(self, max_batch: int):
+        n = max_batch
+        self.added = np.zeros(n, np.float64)
+        self.taken = np.zeros(n, np.float64)
+        self.elapsed = np.zeros(n, np.uint64)
+        self.names = np.zeros((n, PACKET), np.uint8)
+        self.name_lens = np.zeros(n, np.int32)
+        self.slots = np.zeros(n, np.int32)
+        self.caps = np.zeros(n, np.int64)
+        self.lane_a = np.zeros(n, np.int64)
+        self.lane_t = np.zeros(n, np.int64)
+        self.hashes = np.zeros(n, np.uint64)
+
+
+def decode_batch_raw(
+    packets: np.ndarray, sizes: np.ndarray, buf: Optional[DecodeBuffers] = None
+) -> Tuple[DecodeBuffers, int]:
+    """Zero-materialization wire decode: fills ``buf`` (allocating one when
+    None) and returns ``(buf, n)``. Names stay raw zero-padded byte rows
+    (``buf.names[i, :name_lens[i]]``) with their FNV-1a hash in
+    ``buf.hashes`` — the directory's vectorized lookup consumes these
+    directly; Python strings are only materialized for directory misses and
+    incast requests. ``name_lens[i] < 0`` marks a malformed packet."""
     lib = load()
     n = len(packets)
-    added = np.zeros(n, np.float64)
-    taken = np.zeros(n, np.float64)
-    elapsed = np.zeros(n, np.uint64)
-    names = np.zeros((n, PACKET), np.uint8)
-    name_lens = np.zeros(n, np.int32)
-    slots = np.zeros(n, np.int32)
-    caps = np.zeros(n, np.int64)
-    lane_a = np.zeros(n, np.int64)
-    lane_t = np.zeros(n, np.int64)
+    if buf is None or len(buf.added) < n:
+        buf = DecodeBuffers(n)
     lib.pt_decode_batch(
         np.ascontiguousarray(packets, np.uint8),
         np.ascontiguousarray(sizes, np.int32),
-        n, added, taken, elapsed, names, name_lens, slots, caps, lane_a, lane_t,
+        n, buf.added, buf.taken, buf.elapsed, buf.names, buf.name_lens,
+        buf.slots, buf.caps, buf.lane_a, buf.lane_t, buf.hashes,
     )
-    valid = name_lens >= 0
+    return buf, n
+
+
+def decode_batch(packets: np.ndarray, sizes: np.ndarray):
+    """Vectorized wire decode → (added[f64], taken[f64], elapsed[i64],
+    names[list[str]], origin_slots[i32], valid[bool], caps[i64], lane_added
+    [i64], lane_taken[i64]) — caps/lane values in nanotokens, -1 = absent.
+    Materializes every name as a Python string; the hot rx loop uses
+    :func:`decode_batch_raw` instead."""
+    buf, n = decode_batch_raw(packets, sizes)
+    valid = buf.name_lens[:n] >= 0
     out_names: List[str] = [
-        bytes(names[i, : name_lens[i]]).decode("utf-8", "surrogateescape")
+        bytes(buf.names[i, : buf.name_lens[i]]).decode("utf-8", "surrogateescape")
         if valid[i]
         else ""
         for i in range(n)
     ]
     return (
-        added, taken, elapsed.astype(np.int64), out_names, slots, valid,
-        caps, lane_a, lane_t,
+        buf.added[:n].copy(), buf.taken[:n].copy(),
+        buf.elapsed[:n].astype(np.int64), out_names, buf.slots[:n].copy(),
+        valid, buf.caps[:n].copy(), buf.lane_a[:n].copy(), buf.lane_t[:n].copy(),
     )
 
 

@@ -54,6 +54,19 @@ inline void store_be64(uint8_t* p, uint64_t v) {
   std::memcpy(p, &v, 8);
 }
 
+// FNV-1a 64-bit over the raw name bytes. MUST stay bit-identical to
+// patrol_tpu.runtime.directory._fnv1a64 — the directory's vectorized
+// hash-table lookup routes on this value (bytes are then verified, so a
+// mismatch only costs the slow path, never correctness).
+inline uint64_t fnv1a64(const uint8_t* p, int n) {
+  uint64_t h = 0xcbf29ce484222325ULL;
+  for (int i = 0; i < n; i++) {
+    h ^= p[i];
+    h *= 0x100000001b3ULL;
+  }
+  return h;
+}
+
 inline double bits_to_double(uint64_t b) {
   double d;
   std::memcpy(&d, &b, 8);
@@ -205,7 +218,8 @@ int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes,
 int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
                     double* added, double* taken, uint64_t* elapsed,
                     uint8_t* names, int* name_lens, int* origin_slots,
-                    int64_t* caps, int64_t* lane_added, int64_t* lane_taken) {
+                    int64_t* caps, int64_t* lane_added, int64_t* lane_taken,
+                    uint64_t* name_hashes) {
   int ok = 0;
   for (int i = 0; i < n; i++) {
     const uint8_t* p = packets + i * kPacketSize;
@@ -214,6 +228,7 @@ int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
     caps[i] = -1;
     lane_added[i] = -1;
     lane_taken[i] = -1;
+    if (name_hashes) name_hashes[i] = 0;
     if (sz < kFixedSize) {
       name_lens[i] = -1;
       continue;
@@ -226,8 +241,14 @@ int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
     added[i] = bits_to_double(load_be64(p));
     taken[i] = bits_to_double(load_be64(p + 8));
     elapsed[i] = load_be64(p + 16);
-    std::memcpy(names + i * kPacketSize, p + kFixedSize, nlen);
+    // Zero the full name row so callers can REUSE the output buffer across
+    // batches: the directory's vectorized byte-verify compares whole
+    // zero-padded rows, which a stale longer name would corrupt.
+    uint8_t* nrow = names + i * kPacketSize;
+    std::memset(nrow, 0, kPacketSize);
+    std::memcpy(nrow, p + kFixedSize, nlen);
     name_lens[i] = nlen;
+    if (name_hashes) name_hashes[i] = fnv1a64(nrow, nlen);
     const uint8_t* tail = p + kFixedSize + nlen;
     int tail_len = sz - kFixedSize - nlen;
     if (tail_len >= kTrailerSize && tail[0] == 'P' && tail[1] == '2') {

@@ -78,6 +78,7 @@ class NativeReplicator:
     # -- receive path -------------------------------------------------------
 
     def _rx_loop(self) -> None:
+        dbuf: Optional[native.DecodeBuffers] = None
         while not self._stopped.is_set():
             try:
                 packets, sizes, ips, ports = self.sock.recv_batch(timeout_ms=100)
@@ -90,56 +91,75 @@ class NativeReplicator:
             if n == 0 or self.repo is None:
                 continue
             self.rx_packets += n
-            (
-                added, taken, elapsed, names, slots, valid, caps, lane_a, lane_t,
-            ) = native.decode_batch(packets, sizes)
-            b_names, b_slots, b_added, b_taken, b_elapsed, b_caps = (
-                [], [], [], [], [], [],
+            # Fully vectorized wire→engine: batch C++ decode into reused
+            # buffers, resolve buckets through the directory's hash table —
+            # a Python string is materialized only for incast requests and
+            # first-seen bucket names (engine.ingest_deltas_batch_raw).
+            dbuf, _ = native.decode_batch_raw(packets, sizes, dbuf)
+            valid = dbuf.name_lens[:n] >= 0
+            self.rx_errors += int(n - valid.sum())
+            live = valid.copy()
+            # Peers are few: address-keyed decisions (fault injection,
+            # v1 slot resolution) run per unique address, not per packet.
+            addr_key = (ips.astype(np.uint64) << np.uint64(16)) | ports.astype(
+                np.uint64
             )
-            b_lane_a, b_lane_t, b_scalar = [], [], []
-            incasts: list = []
-            for i in range(n):
-                if not valid[i]:
-                    self.rx_errors += 1
-                    continue
-                if self.drop_addr is not None and self.drop_addr(
-                    (_u32_to_ip(int(ips[i])), int(ports[i]))
-                ):
-                    continue
-                if added[i] == 0 and taken[i] == 0 and elapsed[i] == 0:
-                    # Incast request (repo.go:86-90) — answered in batch below.
-                    incasts.append((names[i], int(ips[i]), int(ports[i])))
-                    continue
-                slot = int(slots[i])
-                # No valid trailer ⇒ v1 (reference) peer: sender-address slot
-                # table + scalar deficit-attribution semantics. A base
-                # (cap-less) trailer is a prior-version patrol peer whose
-                # header carries raw own-lane values (lane merge).
-                no_trailer = slot < 0
-                if not 0 <= slot < self.slots.max_slots:
-                    resolved = self.slots.resolve((_u32_to_ip(int(ips[i])), int(ports[i])))
-                    if resolved is None:
-                        self.rx_errors += 1
-                        continue
-                    slot = resolved
-                b_names.append(names[i])
-                b_slots.append(slot)
-                b_added.append(wire._sanitize_nt(float(added[i])))
-                b_taken.append(wire._sanitize_nt(float(taken[i])))
-                b_elapsed.append(max(int(elapsed[i]), 0))
-                # −1 ⇒ field absent. See ingest_deltas_batch for the
-                # per-delta wire-semantics contract.
-                b_caps.append(int(caps[i]))
-                b_lane_a.append(int(lane_a[i]))
-                b_lane_t.append(int(lane_t[i]))
-                b_scalar.append(no_trailer)
-            if b_names:
-                self.repo.engine.ingest_deltas_batch(
-                    b_names, b_slots, b_added, b_taken, b_elapsed,
-                    caps_nt=b_caps, lane_added_nt=b_lane_a, lane_taken_nt=b_lane_t,
-                    scalar=b_scalar,
+            if self.drop_addr is not None and live.any():
+                for k in np.unique(addr_key[live]):
+                    addr = (_u32_to_ip(int(k) >> 16), int(k) & 0xFFFF)
+                    if self.drop_addr(addr):
+                        live &= addr_key != k
+            # Incast requests (zero-state packets, repo.go:86-90).
+            inc = (
+                live
+                & (dbuf.added[:n] == 0)
+                & (dbuf.taken[:n] == 0)
+                & (dbuf.elapsed[:n] == 0)
+            )
+            deltas = live & ~inc
+            # Slot resolution: a valid trailer carries the slot; otherwise
+            # (v1 reference peer) resolve by sender address — per unique
+            # address, peers are few. Unresolvable ⇒ dropped (slot −1).
+            slots = dbuf.slots[:n].astype(np.int64)
+            no_trailer = slots < 0
+            need = deltas & (
+                no_trailer | (slots >= self.slots.max_slots)
+            )
+            if need.any():
+                for k in np.unique(addr_key[need]):
+                    addr = (_u32_to_ip(int(k) >> 16), int(k) & 0xFFFF)
+                    resolved = self.slots.resolve(addr)
+                    sel = need & (addr_key == k)
+                    slots[sel] = -1 if resolved is None else resolved
+                unresolved = need & (slots < 0)
+                self.rx_errors += int(unresolved.sum())
+            slots[~deltas] = -1  # engine's keep-filter drops these
+            if deltas.any():
+                self.repo.engine.ingest_deltas_batch_raw(
+                    n,
+                    dbuf.names,
+                    dbuf.name_lens,
+                    dbuf.hashes,
+                    slots,
+                    wire.sanitize_nt_array(dbuf.added[:n]),
+                    wire.sanitize_nt_array(dbuf.taken[:n]),
+                    np.maximum(dbuf.elapsed[:n].astype(np.int64), 0),
+                    dbuf.caps[:n],
+                    dbuf.lane_a[:n],
+                    dbuf.lane_t[:n],
+                    no_trailer,
                 )
-            if incasts:
+            if inc.any():
+                incasts = [
+                    (
+                        bytes(dbuf.names[i, : dbuf.name_lens[i]]).decode(
+                            "utf-8", "surrogateescape"
+                        ),
+                        int(ips[i]),
+                        int(ports[i]),
+                    )
+                    for i in np.flatnonzero(inc)
+                ]
                 self._reply_incasts(incasts)
 
     def _reply_incasts(self, requests) -> None:

@@ -133,6 +133,26 @@ def _sanitize_nt(tokens: float) -> int:
     return round(nt)
 
 
+def sanitize_nt_array(tokens) -> "np.ndarray":
+    """Vectorized :func:`_sanitize_nt` for the batch rx path: float64[n]
+    wire tokens → int64[n] nanotokens with identical NaN/Inf/range/negative
+    hardening (round-half-even like Python's round). Bit-identical to the
+    scalar form on every input — native-rx and python-rx peers MUST merge
+    the same packet to the same state or replicas diverge permanently."""
+    import numpy as np
+
+    t = np.asarray(tokens, dtype=np.float64)
+    with np.errstate(over="ignore", invalid="ignore"):
+        nt = t * NANO
+        out = np.zeros(len(t), dtype=np.int64)
+        # NaN fails both comparisons → stays 0, like the scalar form.
+        edge = nt >= _INT64_MAX  # +Inf and overflowing products included
+        mid = (nt > 0) & ~edge
+        out[mid] = np.rint(nt[mid]).astype(np.int64)
+        out[edge] = _INT64_MAX
+    return out
+
+
 def from_nanotokens(
     name: str,
     added_nt: int,

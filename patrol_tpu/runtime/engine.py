@@ -337,18 +337,20 @@ class DeviceEngine:
     def _assign_pinned(self, name: str, now: int) -> Tuple[int, bool]:
         return self.assign_row(name, now, pin=True)
 
-    def _assign_many_pinned(self, names: Sequence[str], now: int):
+    def _assign_many_pinned(self, names: Sequence[str], now: int, hashes=None):
         """Batch form of :meth:`_assign_pinned`; returns rows or None when
         the pool is spent with every row pinned (callers drop the batch —
         replication is loss-tolerant)."""
         try:
-            return self.directory.assign_many(names, now, pin=True)
+            return self.directory.assign_many(names, now, pin=True, hashes=hashes)
         except DirectoryFullError:
             pass
         with self._evict_mu:
             while True:
                 try:
-                    return self.directory.assign_many(names, now, pin=True)
+                    return self.directory.assign_many(
+                        names, now, pin=True, hashes=hashes
+                    )
                 except DirectoryFullError:
                     if self._evict(len(names)) == 0:
                         return None
@@ -509,56 +511,159 @@ class DeviceEngine:
                     "pool spent (all pinned); %d deltas dropped", len(chunk_names)
                 )
                 continue
-            slots_c = slots_a[lo:hi]
-            added_c = np.maximum(np.asarray(added_nt[lo:hi], dtype=np.int64), 0)
-            taken_c = np.maximum(np.asarray(taken_nt[lo:hi], dtype=np.int64), 0)
-            elapsed_c = np.maximum(np.asarray(elapsed_ns[lo:hi], dtype=np.int64), 0)
-            scalar_c = None
-            if caps_a is not None:
-                caps_c = caps_a[lo:hi]
-                has_cap = caps_c >= 0
-                # Adopt peer capacities first, so same-batch v1 deltas for
-                # rows initialized here already see the base.
-                self.directory.init_cap_base_many(
-                    rows[has_cap & (caps_c > 0)], caps_c[has_cap & (caps_c > 0)]
+            accepted += self._classify_queue_chunk(
+                rows,
+                slots_a[lo:hi],
+                np.asarray(added_nt[lo:hi], dtype=np.int64),
+                np.asarray(taken_nt[lo:hi], dtype=np.int64),
+                np.asarray(elapsed_ns[lo:hi], dtype=np.int64),
+                None if caps_a is None else caps_a[lo:hi],
+                None if lane_a is None else lane_a[lo:hi],
+                None if lane_t is None else lane_t[lo:hi],
+                None if scalar_a is None else scalar_a[lo:hi],
+            )
+        return accepted
+
+    def _classify_queue_chunk(
+        self,
+        rows: np.ndarray,
+        slots_c: np.ndarray,
+        added_c: np.ndarray,
+        taken_c: np.ndarray,
+        elapsed_c: np.ndarray,
+        caps_c: Optional[np.ndarray],
+        lane_ac: Optional[np.ndarray],
+        lane_tc: Optional[np.ndarray],
+        scalar_c_in: Optional[np.ndarray],
+    ) -> int:
+        """Shared tail of the bulk-ingest paths: wire-semantics
+        classification (see ingest_deltas_batch) over a chunk whose rows
+        are already assigned+pinned, then one queue append + wake-up.
+        Returns deltas queued; unpins any it drops."""
+        added_c = np.maximum(added_c, 0)
+        taken_c = np.maximum(taken_c, 0)
+        elapsed_c = np.maximum(elapsed_c, 0)
+        scalar_c = None
+        if caps_c is not None:
+            has_cap = caps_c >= 0
+            # Adopt peer capacities first, so same-batch v1 deltas for
+            # rows initialized here already see the base.
+            self.directory.init_cap_base_many(
+                rows[has_cap & (caps_c > 0)], caps_c[has_cap & (caps_c > 0)]
+            )
+            # v1 (no trailer) ⇒ capacity-included scalar aggregates; a
+            # cap-less base trailer ⇒ raw own-lane header (no subtract).
+            v1 = (
+                ~has_cap & scalar_c_in
+                if scalar_c_in is not None
+                else np.zeros_like(has_cap)
+            )
+            base = self.directory.cap_base_nt[rows]
+            sub = np.where(has_cap, np.maximum(caps_c, 0), np.where(v1, base, 0))
+            added_c = np.maximum(added_c - sub, 0)
+            lane_ok = np.zeros_like(has_cap)
+            if lane_ac is not None:
+                # Lane-trailer packets: the exact PN lane values replace
+                # the header-derived approximation.
+                lane_ok = has_cap & (lane_ac >= 0) & (lane_tc >= 0)
+                added_c = np.where(lane_ok, lane_ac, added_c)
+                taken_c = np.where(lane_ok, lane_tc, taken_c)
+            # Deficit attribution for every aggregate-header delta: v1
+            # packets and cap-without-lane trailers alike.
+            scalar_c = v1 | (has_cap & ~lane_ok)
+            # v1 deltas on rows with unknown capacity: drop (the peer's
+            # next full-state broadcast re-delivers).
+            unknown = v1 & (base == 0)
+            if unknown.any():
+                self._scalar_dropped += int(unknown.sum())
+                self.directory.unpin_rows(rows[unknown])
+                keep_c = ~unknown
+                rows, slots_c = rows[keep_c], slots_c[keep_c]
+                added_c, taken_c = added_c[keep_c], taken_c[keep_c]
+                elapsed_c, scalar_c = elapsed_c[keep_c], scalar_c[keep_c]
+                if not len(rows):
+                    return 0
+        chunk = _DeltaChunk(rows, slots_c, added_c, taken_c, elapsed_c, scalar_c)
+        with self._cond:
+            self._deltas.append(chunk)
+            self._cond.notify()
+        return chunk.n
+
+    def ingest_deltas_batch_raw(
+        self,
+        n: int,
+        name_buf: np.ndarray,
+        name_lens: np.ndarray,
+        name_hashes: np.ndarray,
+        slots: np.ndarray,
+        added_nt: np.ndarray,
+        taken_nt: np.ndarray,
+        elapsed_ns: np.ndarray,
+        caps_nt: np.ndarray,
+        lane_added_nt: np.ndarray,
+        lane_taken_nt: np.ndarray,
+        scalar: np.ndarray,
+    ) -> int:
+        """Zero-materialization bulk ingest — the native rx loop's fast
+        path. Names arrive as raw zero-padded byte rows + FNV hashes
+        (native.decode_batch_raw); known buckets resolve through the
+        directory's vectorized hash table without creating ONE Python
+        string, and only directory misses (new buckets — once per bucket
+        lifetime) fall back to string materialization and the evicting
+        assign path. Wire-semantics classification is shared with
+        :meth:`ingest_deltas_batch`. BENCH_r02 motivation: string
+        materialization was 85% of decode cost on the replay bench."""
+        now = self.clock()
+        keep = (
+            (slots[:n] >= 0)
+            & (slots[:n] < self.config.nodes)
+            & (name_lens[:n] >= 0)
+        )
+        idx_all = np.flatnonzero(keep)
+        # Gather names as u64 words, not bytes: fancy-indexing cost scales
+        # with element count (8× cheaper), and the directory verifies on
+        # the same word view.
+        name_words = np.ascontiguousarray(name_buf).view(np.uint64)
+        accepted = 0
+        for lo in range(0, len(idx_all), MAX_MERGE_ROWS):
+            idx = idx_all[lo : lo + MAX_MERGE_ROWS]
+            if not idx.size:
+                continue
+            rows = self.directory.lookup_hashed_pinned(
+                name_hashes[idx], name_words[idx], name_lens[idx], now
+            )
+            miss = np.flatnonzero(rows < 0)
+            if miss.size:
+                miss_names = [
+                    bytes(name_buf[i, : name_lens[i]]).decode(
+                        "utf-8", "surrogateescape"
+                    )
+                    for i in idx[miss]
+                ]
+                miss_rows = self._assign_many_pinned(
+                    miss_names, now, hashes=name_hashes[idx[miss]]
                 )
-                # v1 (no trailer) ⇒ capacity-included scalar aggregates; a
-                # cap-less base trailer ⇒ raw own-lane header (no subtract).
-                v1 = (
-                    ~has_cap & scalar_a[lo:hi]
-                    if scalar_a is not None
-                    else np.zeros_like(has_cap)
-                )
-                base = self.directory.cap_base_nt[rows]
-                sub = np.where(has_cap, np.maximum(caps_c, 0), np.where(v1, base, 0))
-                added_c = np.maximum(added_c - sub, 0)
-                lane_ok = np.zeros_like(has_cap)
-                if lane_a is not None:
-                    # Lane-trailer packets: the exact PN lane values replace
-                    # the header-derived approximation.
-                    lane_ok = has_cap & (lane_a[lo:hi] >= 0) & (lane_t[lo:hi] >= 0)
-                    added_c = np.where(lane_ok, lane_a[lo:hi], added_c)
-                    taken_c = np.where(lane_ok, lane_t[lo:hi], taken_c)
-                # Deficit attribution for every aggregate-header delta: v1
-                # packets and cap-without-lane trailers alike.
-                scalar_c = v1 | (has_cap & ~lane_ok)
-                # v1 deltas on rows with unknown capacity: drop (the peer's
-                # next full-state broadcast re-delivers).
-                unknown = v1 & (base == 0)
-                if unknown.any():
-                    self._scalar_dropped += int(unknown.sum())
-                    self.directory.unpin_rows(rows[unknown])
-                    keep_c = ~unknown
-                    rows, slots_c = rows[keep_c], slots_c[keep_c]
-                    added_c, taken_c = added_c[keep_c], taken_c[keep_c]
-                    elapsed_c, scalar_c = elapsed_c[keep_c], scalar_c[keep_c]
-                    if not len(rows):
+                if miss_rows is None:
+                    log.warning(
+                        "pool spent (all pinned); %d deltas dropped", miss.size
+                    )
+                    hit = rows >= 0
+                    idx, rows = idx[hit], rows[hit]
+                    if not idx.size:
                         continue
-            chunk = _DeltaChunk(rows, slots_c, added_c, taken_c, elapsed_c, scalar_c)
-            with self._cond:
-                self._deltas.append(chunk)
-                self._cond.notify()
-            accepted += chunk.n
+                else:
+                    rows[miss] = miss_rows
+            accepted += self._classify_queue_chunk(
+                rows,
+                slots[idx].astype(np.int64),
+                added_nt[idx],
+                taken_nt[idx],
+                elapsed_ns[idx],
+                caps_nt[idx],
+                lane_added_nt[idx],
+                lane_taken_nt[idx],
+                scalar[idx],
+            )
         return accepted
 
     def read_rows(self, rows) -> tuple:

@@ -30,7 +30,8 @@ int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes, int n,
 int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
                     double* added, double* taken, uint64_t* elapsed,
                     uint8_t* names, int* name_lens, int* origin_slots,
-                    int64_t* caps, int64_t* lane_added, int64_t* lane_taken);
+                    int64_t* caps, int64_t* lane_added, int64_t* lane_taken,
+                    uint64_t* name_hashes);
 int pt_encode_batch(const double* added, const double* taken,
                     const uint64_t* elapsed, const uint8_t* names,
                     const int* name_lens, const int* origin_slots,
@@ -93,11 +94,12 @@ int main() {
     uint8_t names[BATCH * PACKET];
     int name_lens[BATCH], slots[BATCH];
     int64_t caps[BATCH], lane_a[BATCH], lane_t[BATCH];
+    uint64_t hashes[BATCH];
     while (!stop.load()) {
       int n = pt_recv_batch(rx, buf, BATCH, sizes, ips, ports, 50);
       if (n <= 0) continue;
       pt_decode_batch(buf, sizes, n, added, taken, elapsed, names, name_lens,
-                      slots, caps, lane_a, lane_t);
+                      slots, caps, lane_a, lane_t, hashes);
       received.fetch_add(n);
     }
   };

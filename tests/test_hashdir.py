@@ -1,0 +1,209 @@
+"""Vectorized hash-table directory path: C++/Python FNV parity, batch
+lookup/verify semantics, eviction consistency, and raw-ingest equivalence
+with the string path. This is the rx fast path that resolves wire packets
+to bucket rows without materializing Python strings (BENCH_r02: string
+materialization was 85% of decode cost)."""
+
+import numpy as np
+import pytest
+
+from patrol_tpu.models.limiter import NANO, LimiterConfig
+from patrol_tpu.ops.rate import Rate
+from patrol_tpu.runtime.directory import NAME_BYTES_MAX, BucketDirectory, _fnv1a64
+from patrol_tpu.runtime.engine import DeviceEngine
+
+CFG = LimiterConfig(buckets=64, nodes=4)
+RATE = Rate(freq=10, per_ns=NANO)
+
+
+def _buf(names):
+    """Zero-padded byte rows + lens + hashes for a list of names — the
+    shape native.decode_batch_raw produces."""
+    n = len(names)
+    buf = np.zeros((n, NAME_BYTES_MAX), np.uint8)
+    lens = np.zeros(n, np.int32)
+    hashes = np.zeros(n, np.uint64)
+    for i, nm in enumerate(names):
+        raw = nm.encode("utf-8", "surrogateescape")
+        lens[i] = len(raw)
+        buf[i, : len(raw)] = np.frombuffer(raw, np.uint8)
+        hashes[i] = _fnv1a64(raw)
+    return buf, lens, hashes
+
+
+class TestFnvParity:
+    def test_python_matches_cpp(self):
+        """The directory's FNV must be bit-identical to the C++ decoder's —
+        a silent divergence would demote every wire lookup to the slow
+        path."""
+        from patrol_tpu import native
+
+        if native.load() is None:
+            pytest.skip("native toolchain unavailable")
+        names = ["a", "bucket-42", "", "x" * 231, "üñíçødé-名前"]
+        pkts, sizes = native.encode_batch(
+            [1.0] * len(names), [0.0] * len(names), [1] * len(names),
+            names, [-1] * len(names),  # no trailer: the 231-byte name fits
+        )
+        assert (sizes >= 0).all()
+        buf, n = native.decode_batch_raw(pkts, sizes)
+        for i, nm in enumerate(names):
+            raw = nm.encode("utf-8", "surrogateescape")
+            assert int(buf.hashes[i]) == _fnv1a64(raw), nm
+
+    def test_known_vector(self):
+        # FNV-1a 64 test vectors (public): fnv1a64("") = offset basis.
+        assert _fnv1a64(b"") == 0xCBF29CE484222325
+        assert _fnv1a64(b"a") == 0xAF63DC4C8601EC8C
+
+
+class TestHashedLookup:
+    def test_hit_pins_and_misses_stay_unpinned(self):
+        d = BucketDirectory(8)
+        row, _ = d.assign("alpha", 100)
+        buf, lens, hashes = _buf(["alpha", "ghost"])
+        rows = d.lookup_hashed_pinned(hashes, buf, lens, 200)
+        assert rows[0] == row and rows[1] == -1
+        assert d.pins[row] == 1
+        assert d.last_used_ns[row] == 200
+        d.unpin_rows([row])
+
+    def test_hash_match_wrong_bytes_is_miss(self):
+        """A forged/colliding hash with different bytes must miss, never
+        resolve to the wrong bucket."""
+        d = BucketDirectory(8)
+        row, _ = d.assign("alpha", 100)
+        buf, lens, _ = _buf(["bravo"])
+        forged = np.array([_fnv1a64(b"alpha")], np.uint64)
+        rows = d.lookup_hashed_pinned(forged, buf, lens, 200)
+        assert rows[0] == -1
+        assert d.pins[row] == 0
+
+    def test_unbind_removes_from_table(self):
+        d = BucketDirectory(8)
+        d.assign("gone", 100)
+        d.release("gone")
+        buf, lens, hashes = _buf(["gone"])
+        assert d.lookup_hashed_pinned(hashes, buf, lens, 200)[0] == -1
+        # Rebinding the same name resolves again (tombstone reuse).
+        row2, _ = d.assign("gone", 300)
+        assert d.lookup_hashed_pinned(hashes, buf, lens, 400)[0] == row2
+        d.unpin_rows([row2])
+
+    def test_eviction_cycle_keeps_table_consistent(self):
+        """Churn far past capacity: every live name must resolve, every
+        evicted name must miss — across tombstone-triggered rebuilds."""
+        d = BucketDirectory(16)
+        live = {}
+        for gen in range(20):
+            for i in range(8):
+                nm = f"g{gen}-n{i}"
+                try:
+                    row, _ = d.assign(nm, gen * 100 + i)
+                except Exception:
+                    victims = d.pick_victims(8)
+                    for v in victims:
+                        live = {k: r for k, r in live.items() if r != v}
+                    d.recycle(victims)
+                    row, _ = d.assign(nm, gen * 100 + i)
+                live = {k: r for k, r in live.items() if r != row}
+                live[nm] = row
+        names = list(live) + [f"g0-n{i}" for i in range(8)]
+        buf, lens, hashes = _buf(names)
+        rows = d.lookup_hashed_pinned(hashes, buf, lens, 10**6)
+        for i, nm in enumerate(names):
+            want = live.get(nm, -1)
+            if want == -1 and nm in live:
+                want = live[nm]
+            assert rows[i] == (live[nm] if nm in live else -1), nm
+        d.unpin_rows(rows[rows >= 0])
+
+    def test_batch_with_malformed_rows_skipped(self):
+        d = BucketDirectory(8)
+        row, _ = d.assign("ok", 1)
+        buf, lens, hashes = _buf(["ok", "bad"])
+        lens[1] = -1  # malformed packet marker
+        rows = d.lookup_hashed_pinned(hashes, buf, lens, 2)
+        assert rows[0] == row and rows[1] == -1
+        d.unpin_rows([row])
+
+
+class TestRawIngestEquivalence:
+    @pytest.fixture
+    def engine(self):
+        eng = DeviceEngine(CFG, node_slot=0, clock=lambda: 0)
+        yield eng
+        eng.stop()
+
+    def test_raw_matches_string_path(self, engine):
+        """ingest_deltas_batch_raw must land the same state as
+        ingest_deltas_batch for the same wire-classified deltas."""
+        names = ["rawa", "rawb", "rawa"]
+        slots = np.array([1, 2, 3], np.int64)
+        added = np.array([2 * NANO, 3 * NANO, NANO], np.int64)
+        taken = np.array([NANO, 0, 0], np.int64)
+        elapsed = np.array([5, 7, 9], np.int64)
+        caps = np.full(3, -1, np.int64)
+        lanes = np.full(3, -1, np.int64)
+        buf, lens, hashes = _buf(names)
+        pad = np.zeros((3, 256 - NAME_BYTES_MAX), np.uint8)  # noqa: F841
+        engine.ingest_deltas_batch_raw(
+            3, buf, lens, hashes, slots, added, taken, elapsed,
+            caps, lanes, lanes, np.zeros(3, bool),
+        )
+        engine.flush()
+        by_slot = {s.origin_slot: s for s in engine.snapshot("rawa")}
+        assert by_slot[1].lane_added_nt == 2 * NANO
+        assert by_slot[1].lane_taken_nt == NANO
+        assert by_slot[3].lane_added_nt == NANO
+        assert engine.snapshot("rawb")[0].lane_added_nt == 3 * NANO
+        # Second round: all names now resolve via the hash table (hits).
+        engine.ingest_deltas_batch_raw(
+            3, buf, lens, hashes, slots,
+            np.array([4 * NANO, 3 * NANO, NANO], np.int64),
+            taken, elapsed, caps, lanes, lanes, np.zeros(3, bool),
+        )
+        engine.flush()
+        by_slot = {s.origin_slot: s for s in engine.snapshot("rawa")}
+        assert by_slot[1].lane_added_nt == 4 * NANO
+        assert engine.directory.pins.sum() == 0  # all unpinned after ticks
+
+    def test_raw_v1_scalar_classification(self, engine):
+        """The raw path must route v1 (no-trailer) deltas through deficit
+        attribution exactly like the string path."""
+        engine.take("rawv1", RATE, 1)  # cap known, own taken=1
+        buf, lens, hashes = _buf(["rawv1"])
+        engine.ingest_deltas_batch_raw(
+            1, buf, lens, hashes,
+            np.array([1], np.int64),
+            np.array([13 * NANO], np.int64),
+            np.array([4 * NANO], np.int64),
+            np.array([0], np.int64),
+            np.full(1, -1, np.int64),
+            np.full(1, -1, np.int64),
+            np.full(1, -1, np.int64),
+            np.ones(1, bool),
+        )
+        engine.flush()
+        by_slot = {s.origin_slot: s for s in engine.snapshot("rawv1")}
+        assert by_slot[1].lane_added_nt == 3 * NANO
+        assert by_slot[1].lane_taken_nt == 3 * NANO
+
+    def test_raw_drops_invalid_rows(self, engine):
+        buf, lens, hashes = _buf(["dropme", "keepme"])
+        lens[0] = -1  # malformed
+        accepted = engine.ingest_deltas_batch_raw(
+            2, buf, lens, hashes,
+            np.array([1, 1], np.int64),
+            np.array([NANO, NANO], np.int64),
+            np.zeros(2, np.int64),
+            np.zeros(2, np.int64),
+            np.full(2, -1, np.int64),
+            np.full(2, -1, np.int64),
+            np.full(2, -1, np.int64),
+            np.zeros(2, bool),
+        )
+        engine.flush()
+        assert accepted == 1
+        assert engine.snapshot("keepme")
+        assert not engine.snapshot("dropme")

@@ -150,6 +150,23 @@ class TestHostilePackets:
         assert st.added_nt == want_nt
         assert st.taken_nt == want_nt
 
+    def test_sanitize_array_matches_scalar_exactly(self):
+        """The vectorized sanitizer (native rx path) must be bit-identical
+        to the scalar one (asyncio rx path) on EVERY input — divergence
+        would permanently fork the max-merged CRDT state between peers
+        running different backends."""
+        import numpy as np
+
+        corpus = [
+            float("nan"), float("inf"), float("-inf"), -1.5, -0.0, 0.0,
+            1e300, 1e-300, 5e-324, 1.0, 0.5, 9.2e9, 9.3e9, 2.0**53,
+            (2**63 - 1) / wire.NANO, (2**63) / wire.NANO, 1.5, 2.5, 3.5,
+        ]
+        got = wire.sanitize_nt_array(corpus)
+        for v, g in zip(corpus, got):
+            assert int(g) == wire._sanitize_nt(v), v
+        assert got.dtype == np.int64
+
     def test_raw_byte_names_roundtrip(self):
         """Reference names are raw bytes (bucket.go:64-88); non-UTF8 bytes
         must round-trip exactly (surrogateescape), or distinct buckets
