@@ -48,7 +48,9 @@ def free_port() -> int:
 class Node:
     """One Command stack on a background event loop."""
 
-    def __init__(self, api_port, node_port, peers, buckets=16384, lanes=8):
+    def __init__(
+        self, api_port, node_port, peers, buckets=16384, lanes=8, front="python"
+    ):
         from patrol_tpu.command import Command
         from patrol_tpu.models.limiter import LimiterConfig
 
@@ -60,6 +62,7 @@ class Node:
             config=LimiterConfig(buckets=buckets, nodes=lanes),
             handle_signals=False,
             warmup=True,
+            http_front=front,
         )
         self.api_port = api_port
         self.loop = asyncio.new_event_loop()
@@ -163,24 +166,27 @@ def run_load(ports, targets, duration_s, workers):
     }
 
 
-def config1(duration_s=3.0, workers=8):
+def config1(duration_s=3.0, workers=8, front="python"):
     api, node = free_port(), free_port()
-    n = Node(api, node, [])
+    n = Node(api, node, [], front=front)
     try:
         # Warmup (first take compiles the kernel variants).
         run_load([api], ["/take/warm?rate=100:1s"], 0.5, 2)
         out = run_load([api], ["/take/hot?rate=100:1s&count=1"], duration_s, workers)
         out["config"] = "1: single node, 1 bucket, rate=100:1s"
+        out["front"] = front
         return out
     finally:
         n.close()
 
 
-def config2(duration_s=3.0, workers=12, keys=10_000, zipf_s=0.99):
+def config2(duration_s=3.0, workers=12, keys=10_000, zipf_s=0.99, front="python"):
     api_ports = [free_port() for _ in range(3)]
     node_ports = [free_port() for _ in range(3)]
     peers = [f"127.0.0.1:{p}" for p in node_ports]
-    nodes = [Node(api_ports[i], node_ports[i], peers) for i in range(3)]
+    nodes = [
+        Node(api_ports[i], node_ports[i], peers, front=front) for i in range(3)
+    ]
     try:
         rng = np.random.default_rng(7)
         weights = 1.0 / np.arange(1, keys + 1) ** zipf_s
@@ -190,16 +196,29 @@ def config2(duration_s=3.0, workers=12, keys=10_000, zipf_s=0.99):
         run_load(api_ports, targets[:64], 0.5, 3)  # warmup
         out = run_load(api_ports, targets, duration_s, workers)
         out["config"] = "2: 3-node cluster, 10k buckets, zipf-0.99"
+        out["front"] = front
         return out
     finally:
         for n in nodes:
             n.close()
 
 
+def _fronts():
+    from patrol_tpu import native
+
+    return ["python", "native"] if native.load() is not None else ["python"]
+
+
 def main():
     duration = float(os.environ.get("PATROL_HTTP_BENCH_SECONDS", "3"))
-    print(json.dumps(config1(duration)), flush=True)
-    print(json.dumps(config2(duration)), flush=True)
+    workers = int(os.environ.get("PATROL_HTTP_BENCH_WORKERS", "8"))
+    for front in _fronts():
+        print(json.dumps(config1(duration, workers=workers, front=front)), flush=True)
+    for front in _fronts():
+        print(
+            json.dumps(config2(duration, workers=max(workers, 12), front=front)),
+            flush=True,
+        )
 
 
 if __name__ == "__main__":

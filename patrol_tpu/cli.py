@@ -61,6 +61,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="replication transport: C++ sendmmsg/recvmmsg or asyncio",
     )
     p.add_argument(
+        "--http-front",
+        choices=["python", "native"],
+        default="python",
+        help="API server: python asyncio (h2c-capable) or the C++ epoll "
+        "front (HTTP/1.1, the /take hot path in native code)",
+    )
+    p.add_argument(
         "--shutdown-timeout",
         default="30s",
         help="graceful shutdown timeout, Go duration syntax",
@@ -125,6 +132,7 @@ def main(argv=None) -> int:
         config=LimiterConfig(buckets=args.buckets, nodes=args.node_lanes),
         log=log,
         udp_backend=args.udp_backend,
+        http_front=args.http_front,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_interval_s=parse_duration(args.checkpoint_interval) / 1e9,
         warmup=not args.no_warmup,

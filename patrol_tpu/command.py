@@ -40,6 +40,12 @@ class Command:
     # "native" = C++ recvmmsg/sendmmsg path, "asyncio" = pure python,
     # "auto" = native when the toolchain built it, else asyncio.
     udp_backend: str = "auto"
+    # HTTP front: "python" = asyncio server (protocol-complete: h2c,
+    # pipelining); "native" = C++ epoll front (net/native_http.py, the Go
+    # net/http performance class for /take; HTTP/1.1 only). Python stays
+    # the default because it speaks h2c; deployments chasing /take rps
+    # pick native.
+    http_front: str = "python"
     # Checkpoint/resume (the reference has none, SURVEY §5): restore at
     # boot when the directory holds a snapshot; save every interval (0 ⇒
     # only at shutdown) and at graceful shutdown.
@@ -138,7 +144,22 @@ class Command:
 
         api = API(repo, log=log, stats=stats)
         host, _, port = self.api_addr.rpartition(":")
-        server = await serve(api, host or "127.0.0.1", int(port))
+        native_front = None
+        server = None
+        if self.http_front == "native":
+            from patrol_tpu.net import native_http
+
+            native_front = native_http.NativeHTTPFront(
+                api, host or "127.0.0.1", int(port)
+            )
+            base_stats = stats
+
+            def stats_with_http() -> dict:  # /debug/vars includes the front
+                return {**base_stats(), **native_front.stats()}
+
+            api.stats = stats_with_http
+        else:
+            server = await serve(api, host or "127.0.0.1", int(port))
 
         self.engine, self.repo, self.replicator = engine, repo, replicator
 
@@ -177,10 +198,15 @@ class Command:
                 except Exception:  # pragma: no cover
                     log.exception("final checkpoint failed")
             log.info("shutting down")
-            server.close()
-            with contextlib.suppress(asyncio.TimeoutError):
-                await asyncio.wait_for(
-                    server.wait_closed(), timeout=self.shutdown_timeout_s
+            if server is not None:
+                server.close()
+                with contextlib.suppress(asyncio.TimeoutError):
+                    await asyncio.wait_for(
+                        server.wait_closed(), timeout=self.shutdown_timeout_s
+                    )
+            if native_front is not None:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, native_front.close
                 )
             replicator.close()
             engine.stop()

@@ -20,8 +20,10 @@ import numpy as np
 log = logging.getLogger("patrol.native")
 
 PACKET = 256
+PATH_MAX = 2048  # kPathMax in patrol_http.cpp
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "patrol_host.cpp")
+_SRC_HTTP = os.path.join(_HERE, "patrol_http.cpp")
 _LIB = os.path.join(_HERE, "libpatrolhost.so")
 
 _mu = threading.Lock()
@@ -38,11 +40,15 @@ _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 
 
 def _build() -> bool:
-    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+    srcs = [_SRC, _SRC_HTTP]
+    if os.path.exists(_LIB) and all(
+        os.path.getmtime(_LIB) >= os.path.getmtime(s) for s in srcs
+    ):
         return True
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             "-o", _LIB, *srcs],
             check=True,
             capture_output=True,
             timeout=120,
@@ -88,6 +94,46 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, _u8p, _i32p,
         ]
         lib.pt_encode_batch.restype = ctypes.c_int
+        # -- HTTP front (patrol_http.cpp) --
+        lib.pt_http_start.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+        lib.pt_http_start.restype = ctypes.c_int
+        lib.pt_http_port.argtypes = [ctypes.c_int]
+        lib.pt_http_port.restype = ctypes.c_int
+        lib.pt_http_poll.argtypes = [
+            ctypes.c_int, ctypes.c_int,
+            _u64p, _u8p, _i32p, _i64p, _i64p, _i64p, ctypes.c_int,
+            _u64p, _u8p, _i32p, _u8p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.pt_http_poll.restype = ctypes.c_int
+        lib.pt_http_complete_takes.argtypes = [
+            ctypes.c_int, _u64p, _i32p, _i64p, ctypes.c_int,
+        ]
+        lib.pt_http_complete_takes.restype = ctypes.c_int
+        lib.pt_http_complete_other.argtypes = [
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.pt_http_complete_other.restype = ctypes.c_int
+        lib.pt_http_stats.argtypes = [ctypes.c_int, _u64p]
+        lib.pt_http_stats.restype = ctypes.c_int
+        lib.pt_http_stop.argtypes = [ctypes.c_int]
+        lib.pt_http_stop.restype = ctypes.c_int
+        lib.pt_http_blast.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, _u64p,
+        ]
+        lib.pt_http_blast.restype = ctypes.c_int
+        lib.pt_parse_rate.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.pt_parse_rate.restype = ctypes.c_int
+        lib.pt_parse_duration.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.pt_parse_duration.restype = ctypes.c_int
         _lib = lib
         return lib
 

@@ -1,0 +1,926 @@
+// patrol_http: native HTTP/1.1 front for the /take hot path.
+//
+// The reference serves /take from compiled Go net/http (command.go:41-44,
+// api.go:51-86) — a performance class a Python asyncio server cannot
+// reach. This is the C++ equivalent, shaped for the microbatching TPU
+// runtime the same way patrol_host.cpp shapes the UDP plane:
+//
+//   * one epoll thread owns accept/read/parse/write — zero Python on the
+//     socket path;
+//   * /take requests are FULLY parsed in C++ (percent-decoding, Go
+//     ParseRate/ParseDuration semantics ported below) into fixed records
+//     on a ring; the Python pump drains the ring in BATCHES (one ctypes
+//     call), submits them to the device engine, and completes them in
+//     batches — so Python cost amortizes over the batch exactly like the
+//     engine's take microbatching;
+//   * responses are formatted and written back in C++;
+//   * non-/take routes (debug, metrics) ride a slow-path ring to Python.
+//
+// Concurrency: the epoll thread and the Python pump share one mutex per
+// server (batch-level contention only) plus an eventfd to kick the epoll
+// loop when completions arrive. Connection slots carry a generation tag
+// so a completion for a closed/reused connection is dropped, never
+// misdelivered.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this environment).
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cctype>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kNameMax = 256;     // matches wire NAME_BYTES_MAX
+constexpr int kNameLimit = 231;   // MAX_NAME_LENGTH_V1 (bucket.go:43-44)
+constexpr int kPathMax = 2048;    // slow-path target cap
+constexpr int kRbufMax = 16384;   // per-connection read buffer cap
+constexpr int kRingCap = 8192;    // parsed-take ring capacity
+constexpr int64_t kInt64Max = 0x7FFFFFFFFFFFFFFFLL;
+
+// ---- Go time.ParseDuration / ParseRate port (ops/rate.py parity) ----------
+
+// Unit table incl. both µ (U+00B5, "\xc2\xb5") and μ (U+03BC, "\xce\xbc").
+struct Unit { const char* s; int len; int64_t scale; };
+const Unit kUnits[] = {
+    {"ns", 2, 1LL},
+    {"us", 2, 1000LL},
+    {"\xc2\xb5s", 3, 1000LL},
+    {"\xce\xbcs", 3, 1000LL},
+    {"ms", 2, 1000000LL},
+    {"s", 1, 1000000000LL},
+    {"m", 1, 60LL * 1000000000LL},
+    {"h", 1, 3600LL * 1000000000LL},
+};
+// Bare units accepted as "1<unit>" shorthand (bucket.go:116-119): the
+// reference's list has µs but NOT μs.
+const char* kBareUnits[] = {"ns", "us", "\xc2\xb5s", "ms", "s", "m", "h"};
+
+// Longest-match unit lookup at s[i:]; returns scale or 0.
+int64_t match_unit(const std::string& s, size_t i, size_t* adv) {
+  const Unit* best = nullptr;
+  for (const auto& u : kUnits) {
+    if (s.compare(i, u.len, u.s) == 0 && (!best || u.len > best->len)) best = &u;
+  }
+  if (!best) return 0;
+  *adv = best->len;
+  return best->scale;
+}
+
+// parse_duration (ops/rate.py:41-92). Returns false on malformed input.
+bool parse_duration(const std::string& orig, int64_t* out) {
+  std::string s = orig;
+  bool neg = false;
+  if (!s.empty() && (s[0] == '+' || s[0] == '-')) {
+    neg = s[0] == '-';
+    s.erase(0, 1);
+  }
+  if (s == "0") {
+    *out = 0;
+    return true;
+  }
+  if (s.empty()) return false;
+  __int128 total = 0;
+  size_t i = 0;
+  while (i < s.size()) {
+    size_t d0 = i;
+    while (i < s.size() && s[i] >= '0' && s[i] <= '9') i++;
+    size_t int_len = i - d0;
+    __int128 int_part = 0;
+    for (size_t k = d0; k < i; k++) {
+      int_part = int_part * 10 + (s[k] - '0');
+      if (int_part > (__int128)kInt64Max * 10) return false;  // overflow guard
+    }
+    size_t f0 = i, frac_len = 0;
+    __int128 frac_part = 0;
+    if (i < s.size() && s[i] == '.') {
+      i++;
+      f0 = i;
+      while (i < s.size() && s[i] >= '0' && s[i] <= '9') i++;
+      frac_len = i - f0;
+      // Cap fraction digits the way Python's exact-int math behaves for
+      // practical inputs: accumulate into int128 (19+ digits saturate).
+      for (size_t k = f0; k < i && k < f0 + 18; k++)
+        frac_part = frac_part * 10 + (s[k] - '0');
+      for (size_t k = f0 + 18; k < i; k++) frac_len--;  // drop beyond 18
+    }
+    if (int_len == 0 && frac_len == 0 && (i == f0)) return false;
+    if (int_len == 0 && f0 == d0) return false;  // no digits at all
+    size_t adv = 0;
+    int64_t scale = match_unit(s, i, &adv);
+    if (scale == 0) return false;
+    i += adv;
+    total += int_part * scale;
+    if (frac_len > 0) {
+      __int128 p10 = 1;
+      for (size_t k = 0; k < frac_len; k++) p10 *= 10;
+      total += frac_part * scale / p10;
+    }
+    if (total > (__int128)kInt64Max) return false;
+  }
+  int64_t v = (int64_t)total;
+  *out = neg ? -v : v;
+  return true;
+}
+
+// strconv.Atoi semantics (ops/rate.py:_atoi): optional sign, ASCII digits.
+bool parse_atoi(const std::string& s, int64_t* out) {
+  size_t i = 0;
+  bool neg = false;
+  if (i < s.size() && (s[i] == '+' || s[i] == '-')) {
+    neg = s[i] == '-';
+    i++;
+  }
+  if (i >= s.size()) return false;
+  __int128 v = 0;
+  for (; i < s.size(); i++) {
+    if (s[i] < '0' || s[i] > '9') return false;
+    v = v * 10 + (s[i] - '0');
+    if (v > (__int128)kInt64Max + 1) return false;
+  }
+  if (!neg && v > (__int128)kInt64Max) return false;
+  if (neg && v > (__int128)kInt64Max + 1) return false;
+  *out = neg ? (int64_t)(-v) : (int64_t)v;
+  return true;
+}
+
+// parse_rate "freq:duration" (ops/rate.py:177-192). false ⇒ malformed
+// (callers use the zero Rate: unconditional 429, api.go:61).
+bool parse_rate(const std::string& v, int64_t* freq, int64_t* per_ns) {
+  std::string fpart = v, dpart = "1s";
+  size_t colon = v.find(':');
+  if (colon != std::string::npos) {
+    fpart = v.substr(0, colon);
+    dpart = v.substr(colon + 1);
+  }
+  if (!parse_atoi(fpart, freq)) return false;
+  for (const char* u : kBareUnits) {
+    if (dpart == u) {
+      dpart = std::string("1") + u;
+      break;
+    }
+  }
+  return parse_duration(dpart, per_ns);
+}
+
+// ---- HTTP plumbing --------------------------------------------------------
+
+int hexval(char c) {
+  if (c >= '0' && c <= '9') return c - '0';
+  if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+  if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+  return -1;
+}
+
+// Percent-decode. plus_to_space mirrors urllib parse_qs for query values;
+// path segments keep '+' literal (urllib.unquote semantics).
+std::string pct_decode(const std::string& s, bool plus_to_space) {
+  std::string out;
+  out.reserve(s.size());
+  for (size_t i = 0; i < s.size(); i++) {
+    if (s[i] == '%' && i + 2 < s.size()) {
+      int hi = hexval(s[i + 1]), lo = hexval(s[i + 2]);
+      if (hi >= 0 && lo >= 0) {
+        out.push_back((char)((hi << 4) | lo));
+        i += 2;
+        continue;
+      }
+    }
+    if (plus_to_space && s[i] == '+') {
+      out.push_back(' ');
+      continue;
+    }
+    out.push_back(s[i]);
+  }
+  return out;
+}
+
+struct TakeRec {
+  uint64_t tag;
+  int64_t freq, per_ns, count;
+  uint8_t name[kNameMax];
+  int name_len;
+};
+
+struct OtherRec {
+  uint64_t tag;
+  char method[8];
+  char target[kPathMax];  // path?query
+  int target_len;
+};
+
+struct Conn {
+  int fd = -1;
+  uint32_t gen = 0;
+  std::string rbuf;
+  std::string wbuf;
+  size_t woff = 0;
+  bool in_flight = false;   // one request at a time; pipelined bytes wait
+  bool close_after = false;
+  bool want_close = false;  // fully close once wbuf drains
+  size_t body_skip = 0;     // request body bytes still to drain
+};
+
+struct Server {
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int event_fd = -1;
+  uint16_t port = 0;
+  std::thread thread;
+  bool running = false;
+
+  std::mutex mu;
+  std::condition_variable cv;  // signals the Python pump: work available
+  std::vector<Conn> conns;     // slot-indexed
+  std::vector<int> free_slots;
+  std::deque<TakeRec> take_q;
+  std::deque<OtherRec> other_q;
+  // Completions flow: pump → (mu) wbuf append → eventfd kick.
+
+  // stats
+  uint64_t accepted = 0, requests = 0, dropped = 0;
+};
+
+Server* g_servers[8] = {nullptr};
+// Guards registry lookup+use in the completion entry points vs teardown:
+// pt_http_stop nulls the slot under this mutex BEFORE deleting, and the
+// completion calls hold it across their whole body, so a late completion
+// can never touch a freed Server. (pt_http_poll is exempt: the Python
+// front joins its pump thread before calling pt_http_stop.)
+std::mutex g_reg_mu;
+
+uint64_t make_tag(int slot, uint32_t gen) {
+  return ((uint64_t)(uint32_t)slot << 32) | gen;
+}
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+const char* status_text(int code) {
+  switch (code) {
+    case 200: return "OK";
+    case 400: return "Bad Request";
+    case 404: return "Not Found";
+    case 405: return "Method Not Allowed";
+    case 429: return "Too Many Requests";
+    case 431: return "Request Header Fields Too Large";
+    case 500: return "Internal Server Error";
+    case 503: return "Service Unavailable";
+    default: return "OK";
+  }
+}
+
+// Append a full response to the conn's write buffer (mu held).
+void queue_response(Server* s, Conn* c, int code, const char* ctype,
+                    const char* body, size_t body_len) {
+  char head[256];
+  int hl = snprintf(head, sizeof(head),
+                    "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                    "Content-Length: %zu\r\n%s\r\n",
+                    code, status_text(code), ctype, body_len,
+                    c->close_after ? "Connection: close\r\n" : "");
+  // snprintf returns the would-be length on truncation; clamping keeps a
+  // hostile/long Content-Type from overreading the stack buffer.
+  if (hl > (int)sizeof(head) - 1) hl = (int)sizeof(head) - 1;
+  c->wbuf.append(head, hl);
+  c->wbuf.append(body, body_len);
+  c->in_flight = false;
+  if (c->close_after) c->want_close = true;
+}
+
+void epoll_mod(Server* s, int slot) {
+  Conn& c = s->conns[slot];
+  epoll_event ev{};
+  ev.events = EPOLLIN | (c.wbuf.size() > c.woff ? EPOLLOUT : 0);
+  ev.data.u64 = make_tag(slot, c.gen);
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c.fd, &ev);
+}
+
+void close_conn(Server* s, int slot) {
+  Conn& c = s->conns[slot];
+  if (c.fd >= 0) {
+    epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c.fd, nullptr);
+    ::close(c.fd);
+  }
+  c.fd = -1;
+  c.gen++;  // invalidate outstanding tags
+  c.rbuf.clear();
+  c.rbuf.shrink_to_fit();
+  c.wbuf.clear();
+  c.wbuf.shrink_to_fit();
+  c.woff = 0;
+  c.in_flight = c.close_after = c.want_close = false;
+  c.body_skip = 0;
+  s->free_slots.push_back(slot);
+}
+
+// Parse one request out of c->rbuf (mu held). Returns false when more
+// bytes are needed. May queue an immediate response or push ring records.
+bool try_parse_one(Server* s, int slot) {
+  Conn& c = s->conns[slot];
+  if (c.in_flight || c.want_close) return false;
+  if (c.body_skip > 0) {
+    size_t n = c.rbuf.size() < c.body_skip ? c.rbuf.size() : c.body_skip;
+    c.rbuf.erase(0, n);
+    c.body_skip -= n;
+    if (c.body_skip > 0) return false;
+  }
+  size_t hdr_end = c.rbuf.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) {
+    if (c.rbuf.size() > kRbufMax) {
+      c.close_after = true;
+      queue_response(s, &c, 431, "text/plain", "header too large\n", 17);
+    }
+    // h2c preface detection: reject cleanly (use the python front for h2).
+    if (c.rbuf.compare(0, 3, "PRI") == 0 && c.rbuf.size() >= 3) {
+      c.close_after = true;
+      queue_response(s, &c, 400, "text/plain", "h2c not supported here\n", 23);
+    }
+    return false;
+  }
+  std::string head = c.rbuf.substr(0, hdr_end);
+  size_t consumed = hdr_end + 4;
+
+  // Request line.
+  size_t eol = head.find("\r\n");
+  std::string reqline = head.substr(0, eol == std::string::npos ? head.size() : eol);
+  size_t sp1 = reqline.find(' ');
+  size_t sp2 = reqline.rfind(' ');
+  if (sp1 == std::string::npos || sp2 == sp1) {
+    c.close_after = true;
+    queue_response(s, &c, 400, "text/plain", "bad request\n", 12);
+    c.rbuf.erase(0, consumed);
+    return true;
+  }
+  std::string method = reqline.substr(0, sp1);
+  std::string target = reqline.substr(sp1 + 1, sp2 - sp1 - 1);
+
+  // Headers we care about: Content-Length, Connection.
+  size_t content_len = 0;
+  bool conn_close = false;
+  size_t pos = (eol == std::string::npos) ? head.size() : eol + 2;
+  while (pos < head.size()) {
+    size_t e = head.find("\r\n", pos);
+    if (e == std::string::npos) e = head.size();
+    std::string line = head.substr(pos, e - pos);
+    pos = e + 2;
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string key = line.substr(0, colon);
+    for (auto& ch : key) ch = (char)tolower((unsigned char)ch);
+    size_t v0 = colon + 1;
+    while (v0 < line.size() && line[v0] == ' ') v0++;
+    std::string val = line.substr(v0);
+    if (key == "content-length") content_len = strtoul(val.c_str(), nullptr, 10);
+    if (key == "connection") {
+      for (auto& ch : val) ch = (char)tolower((unsigned char)ch);
+      if (val.find("close") != std::string::npos) conn_close = true;
+    }
+  }
+  c.rbuf.erase(0, consumed);
+  // Drain any request body (take input rides the URL, api.py contract).
+  if (content_len > 0) {
+    size_t n = c.rbuf.size() < content_len ? c.rbuf.size() : content_len;
+    c.rbuf.erase(0, n);
+    c.body_skip = content_len - n;
+  }
+  c.close_after = conn_close;
+  s->requests++;
+
+  std::string path = target, query;
+  size_t qm = target.find('?');
+  if (qm != std::string::npos) {
+    path = target.substr(0, qm);
+    query = target.substr(qm + 1);
+  }
+
+  if (path.compare(0, 6, "/take/") == 0) {
+    if (method != "POST") {
+      queue_response(s, &c, 405, "text/plain", "method not allowed\n", 19);
+      return true;
+    }
+    std::string name = pct_decode(path.substr(6), false);
+    if (name.size() > kNameLimit) {
+      // api.go:55-58 → 400 with the error text.
+      char body[64];
+      int bl = snprintf(body, sizeof(body), "bucket name larger than %d", kNameLimit);
+      queue_response(s, &c, 400, "text/plain", body, bl);
+      return true;
+    }
+    // Query: first rate= and count= win (parse_qs[0] semantics).
+    int64_t freq = 0, per_ns = 0, count = 0;
+    bool have_rate = false, have_count = false;
+    size_t qp = 0;
+    while (qp <= query.size() && query.size()) {
+      size_t amp = query.find('&', qp);
+      if (amp == std::string::npos) amp = query.size();
+      std::string kv = query.substr(qp, amp - qp);
+      qp = amp + 1;
+      size_t eq = kv.find('=');
+      std::string k = kv.substr(0, eq == std::string::npos ? kv.size() : eq);
+      std::string v = eq == std::string::npos ? "" : pct_decode(kv.substr(eq + 1), true);
+      if (k == "rate" && !have_rate) {
+        have_rate = true;
+        if (!parse_rate(v, &freq, &per_ns)) freq = per_ns = 0;  // zero Rate
+      } else if (k == "count" && !have_count) {
+        have_count = true;
+        // int(v): Python strips ASCII whitespace; sign + digits.
+        size_t b = 0, e2 = v.size();
+        while (b < e2 && isspace((unsigned char)v[b])) b++;
+        while (e2 > b && isspace((unsigned char)v[e2 - 1])) e2--;
+        int64_t cv = 0;
+        if (parse_atoi(v.substr(b, e2 - b), &cv) && cv >= 0) count = cv;
+      }
+      if (amp == query.size()) break;
+    }
+    if (count == 0) count = 1;  // api.go:63-65 (incl. bad/negative count)
+
+    if ((int)s->take_q.size() >= kRingCap) {
+      s->dropped++;
+      queue_response(s, &c, 503, "text/plain", "overloaded\n", 11);
+      return true;
+    }
+    TakeRec r{};
+    r.tag = make_tag(slot, c.gen);
+    r.freq = freq;
+    r.per_ns = per_ns;
+    r.count = count;
+    r.name_len = (int)name.size();
+    memcpy(r.name, name.data(), name.size());
+    c.in_flight = true;
+    s->take_q.push_back(r);
+    s->cv.notify_one();
+    return true;
+  }
+
+  // Slow path: hand method+target to Python (debug routes, 404s).
+  if (target.size() >= kPathMax || (int)s->other_q.size() >= 1024) {
+    queue_response(s, &c, target.size() >= kPathMax ? 431 : 503, "text/plain",
+                   "unavailable\n", 12);
+    return true;
+  }
+  OtherRec o{};
+  o.tag = make_tag(slot, c.gen);
+  snprintf(o.method, sizeof(o.method), "%.7s", method.c_str());
+  memcpy(o.target, target.data(), target.size());
+  o.target_len = (int)target.size();
+  c.in_flight = true;
+  s->other_q.push_back(o);
+  s->cv.notify_one();
+  return true;
+}
+
+void flush_writes(Server* s, int slot) {
+  Conn& c = s->conns[slot];
+  while (true) {
+    while (c.woff < c.wbuf.size()) {
+      ssize_t n = ::send(c.fd, c.wbuf.data() + c.woff, c.wbuf.size() - c.woff,
+                         MSG_NOSIGNAL);
+      if (n > 0) {
+        c.woff += (size_t)n;
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        epoll_mod(s, slot);  // arm EPOLLOUT
+        return;
+      }
+      close_conn(s, slot);
+      return;
+    }
+    c.wbuf.clear();
+    c.woff = 0;
+    if (c.want_close) {
+      close_conn(s, slot);
+      return;
+    }
+    // Response done: a pipelined next request may already be buffered —
+    // and may queue an immediate response (405/400), so loop until the
+    // write buffer stays empty.
+    bool parsed = false;
+    while (try_parse_one(s, slot)) parsed = true;
+    if (!parsed || c.wbuf.empty()) break;
+  }
+  if (c.fd >= 0) epoll_mod(s, slot);
+}
+
+void serve_loop(Server* s) {
+  epoll_event evs[256];
+  while (s->running) {
+    int n = epoll_wait(s->epoll_fd, evs, 256, 100);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    std::unique_lock<std::mutex> lk(s->mu);
+    for (int i = 0; i < n; i++) {
+      uint64_t tag = evs[i].data.u64;
+      if (tag == (uint64_t)-1) {  // listen socket
+        while (true) {
+          int fd = accept4(s->listen_fd, nullptr, nullptr, SOCK_NONBLOCK);
+          if (fd < 0) break;
+          int one = 1;
+          setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          int slot;
+          if (!s->free_slots.empty()) {
+            slot = s->free_slots.back();
+            s->free_slots.pop_back();
+          } else {
+            slot = (int)s->conns.size();
+            s->conns.emplace_back();
+          }
+          Conn& c = s->conns[slot];
+          c.fd = fd;
+          s->accepted++;
+          epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.u64 = make_tag(slot, c.gen);
+          epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+        }
+        continue;
+      }
+      if (tag == (uint64_t)-2) {  // eventfd kick: completions queued
+        uint64_t v;
+        ssize_t rd = read(s->event_fd, &v, 8);
+        (void)rd;
+        // Flush every conn with pending writes.
+        for (int slot = 0; slot < (int)s->conns.size(); slot++) {
+          if (s->conns[slot].fd >= 0 &&
+              s->conns[slot].wbuf.size() > s->conns[slot].woff)
+            flush_writes(s, slot);
+        }
+        continue;
+      }
+      int slot = (int)(tag >> 32);
+      uint32_t gen = (uint32_t)tag;
+      if (slot >= (int)s->conns.size() || s->conns[slot].gen != gen ||
+          s->conns[slot].fd < 0)
+        continue;
+      Conn& c = s->conns[slot];
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        close_conn(s, slot);
+        continue;
+      }
+      if (evs[i].events & EPOLLIN) {
+        char buf[8192];
+        bool closed = false;
+        while (true) {
+          ssize_t rd = recv(c.fd, buf, sizeof(buf), 0);
+          if (rd > 0) {
+            c.rbuf.append(buf, rd);
+            if (c.rbuf.size() > (size_t)kRbufMax * 4) {  // hostile flood
+              closed = true;
+              break;
+            }
+            continue;
+          }
+          if (rd == 0) closed = true;
+          break;  // EAGAIN or close
+        }
+        if (closed && c.rbuf.empty()) {
+          close_conn(s, slot);
+          continue;
+        }
+        while (try_parse_one(s, slot)) {
+        }
+        if (c.fd >= 0 && c.wbuf.size() > c.woff) flush_writes(s, slot);
+        if (closed && c.fd >= 0 && !c.in_flight) close_conn(s, slot);
+      }
+      if (c.fd >= 0 && (evs[i].events & EPOLLOUT)) flush_writes(s, slot);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start a server; returns handle ≥0 or -errno.
+int pt_http_start(const char* ip, uint16_t port) {
+  int h = -1;
+  for (int i = 0; i < 8; i++)
+    if (!g_servers[i]) {
+      h = i;
+      break;
+    }
+  if (h < 0) return -EMFILE;
+
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -EINVAL;
+  }
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) < 0 || listen(fd, 1024) < 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+
+  Server* s = new Server();
+  s->listen_fd = fd;
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &alen);
+  s->port = ntohs(addr.sin_port);
+  s->epoll_fd = epoll_create1(0);
+  s->event_fd = eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = (uint64_t)-1;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+  ev.events = EPOLLIN;
+  ev.data.u64 = (uint64_t)-2;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->event_fd, &ev);
+  s->running = true;
+  s->thread = std::thread(serve_loop, s);
+  g_servers[h] = s;
+  return h;
+}
+
+int pt_http_port(int h) {
+  Server* s = g_servers[h];
+  return s ? s->port : -1;
+}
+
+// Drain parsed requests. Blocks up to timeout_ms when both queues are
+// empty (GIL released by ctypes). Fills up to cap_t takes and cap_o
+// others; *n_other receives the other-count; returns the take-count.
+int pt_http_poll(int h, int timeout_ms,
+                 uint64_t* tags, uint8_t* names, int* name_lens,
+                 int64_t* freqs, int64_t* pers, int64_t* counts, int cap_t,
+                 uint64_t* otags, uint8_t* otargets, int* otarget_lens,
+                 uint8_t* omethods, int cap_o, int* n_other) {
+  Server* s = g_servers[h];
+  if (!s) return -EBADF;
+  std::unique_lock<std::mutex> lk(s->mu);
+  if (s->take_q.empty() && s->other_q.empty() && timeout_ms > 0) {
+    s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+      return !s->take_q.empty() || !s->other_q.empty() || !s->running;
+    });
+  }
+  int nt = 0;
+  while (nt < cap_t && !s->take_q.empty()) {
+    TakeRec& r = s->take_q.front();
+    tags[nt] = r.tag;
+    memset(names + nt * kNameMax, 0, kNameMax);
+    memcpy(names + nt * kNameMax, r.name, r.name_len);
+    name_lens[nt] = r.name_len;
+    freqs[nt] = r.freq;
+    pers[nt] = r.per_ns;
+    counts[nt] = r.count;
+    s->take_q.pop_front();
+    nt++;
+  }
+  int no = 0;
+  while (no < cap_o && !s->other_q.empty()) {
+    OtherRec& o = s->other_q.front();
+    otags[no] = o.tag;
+    memcpy(otargets + no * kPathMax, o.target, o.target_len);
+    otarget_lens[no] = o.target_len;
+    memset(omethods + no * 8, 0, 8);
+    memcpy(omethods + no * 8, o.method, strnlen(o.method, 7));
+    s->other_q.pop_front();
+    no++;
+  }
+  *n_other = no;
+  return nt;
+}
+
+// Complete a batch of takes: status 200/429 + remaining-tokens body.
+int pt_http_complete_takes(int h, const uint64_t* tags, const int* statuses,
+                           const int64_t* remaining, int n) {
+  std::lock_guard<std::mutex> reg(g_reg_mu);
+  Server* s = g_servers[h];
+  if (!s) return -EBADF;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (int i = 0; i < n; i++) {
+      int slot = (int)(tags[i] >> 32);
+      uint32_t gen = (uint32_t)tags[i];
+      if (slot >= (int)s->conns.size()) continue;
+      Conn& c = s->conns[slot];
+      if (c.fd < 0 || c.gen != gen) continue;  // conn died mid-flight
+      char body[24];
+      int bl = snprintf(body, sizeof(body), "%lld", (long long)remaining[i]);
+      queue_response(s, &c, statuses[i], "text/plain", body, bl);
+    }
+  }
+  uint64_t one = 1;
+  ssize_t wr = write(s->event_fd, &one, 8);
+  (void)wr;
+  return 0;
+}
+
+// Complete one slow-path request with an arbitrary body.
+int pt_http_complete_other(int h, uint64_t tag, int status, const char* ctype,
+                           const uint8_t* body, int body_len) {
+  std::lock_guard<std::mutex> reg(g_reg_mu);
+  Server* s = g_servers[h];
+  if (!s) return -EBADF;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    int slot = (int)(tag >> 32);
+    uint32_t gen = (uint32_t)tag;
+    if (slot < (int)s->conns.size()) {
+      Conn& c = s->conns[slot];
+      if (c.fd >= 0 && c.gen == gen)
+        queue_response(s, &c, status, ctype, (const char*)body, body_len);
+    }
+  }
+  uint64_t one = 1;
+  ssize_t wr = write(s->event_fd, &one, 8);
+  (void)wr;
+  return 0;
+}
+
+int pt_http_stats(int h, uint64_t* out4) {
+  std::lock_guard<std::mutex> reg(g_reg_mu);
+  Server* s = g_servers[h];
+  if (!s) return -EBADF;
+  std::lock_guard<std::mutex> lk(s->mu);
+  out4[0] = s->accepted;
+  out4[1] = s->requests;
+  out4[2] = 0;
+  for (const auto& c : s->conns)
+    if (c.fd >= 0) out4[2]++;
+  out4[3] = s->dropped;
+  return 0;
+}
+
+int pt_http_stop(int h) {
+  Server* s;
+  {
+    // Unregister FIRST (under the registry lock) so any completion that
+    // races with shutdown either sees the slot and finishes before we
+    // proceed, or sees nullptr and returns EBADF — never a freed Server.
+    std::lock_guard<std::mutex> reg(g_reg_mu);
+    s = g_servers[h];
+    if (!s) return -EBADF;
+    g_servers[h] = nullptr;
+  }
+  s->running = false;
+  s->cv.notify_all();
+  uint64_t one = 1;
+  ssize_t wr = write(s->event_fd, &one, 8);
+  (void)wr;
+  if (s->thread.joinable()) s->thread.join();
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (int i = 0; i < (int)s->conns.size(); i++)
+      if (s->conns[i].fd >= 0) close_conn(s, i);
+  }
+  ::close(s->listen_fd);
+  ::close(s->epoll_fd);
+  ::close(s->event_fd);
+  delete s;
+  return 0;
+}
+
+// Closed-loop load client: `conns` keep-alive connections, each keeping
+// `pipeline` requests in flight, for `duration_ms`. A C++ client is the
+// only way to measure the server on a 1-core box — a Python client costs
+// more per request than the C++ front does and dominates the machine.
+// out3 = {requests_completed, p50_ns, p99_ns} (latency per response at
+// pipeline depth, i.e. includes queueing behind the pipeline window).
+int pt_http_blast(const char* ip, uint16_t port, const char* target,
+                  int conns, int pipeline, int duration_ms, uint64_t* out3) {
+  std::string req = std::string("POST ") + target +
+                    " HTTP/1.1\r\nHost: x\r\n\r\n";
+  struct CC {
+    int fd = -1;
+    std::string rbuf;
+    std::string wpend;  // partially-sent bytes (non-blocking send)
+    size_t woff = 0;
+    int inflight = 0;
+    std::deque<std::chrono::steady_clock::time_point> sent;
+  };
+  std::vector<CC> cs(conns);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) return -EINVAL;
+  int ep = epoll_create1(0);
+  for (int i = 0; i < conns; i++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) < 0) {
+      ::close(fd);
+      ::close(ep);
+      return -errno;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    set_nonblock(fd);
+    cs[i].fd = fd;
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u32 = i;
+    epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev);
+  }
+  auto now = [] { return std::chrono::steady_clock::now(); };
+  auto t_end = now() + std::chrono::milliseconds(duration_ms);
+  std::vector<uint64_t> lats;
+  lats.reserve(1 << 20);
+  uint64_t done = 0;
+
+  auto pump_conn = [&](CC& c) {  // fill the pipeline window
+    // Queue whole requests, then flush as far as the socket allows: a
+    // partial non-blocking send must never splice the NEXT request into
+    // the middle of a half-written one.
+    while (c.inflight < pipeline) {
+      c.wpend += req;
+      c.inflight++;
+      c.sent.push_back(now());
+    }
+    while (c.woff < c.wpend.size()) {
+      ssize_t wr = ::send(c.fd, c.wpend.data() + c.woff,
+                          c.wpend.size() - c.woff, MSG_NOSIGNAL);
+      if (wr <= 0) break;  // EAGAIN: socket buffer full
+      c.woff += (size_t)wr;
+    }
+    if (c.woff >= c.wpend.size()) {
+      c.wpend.clear();
+      c.woff = 0;
+    }
+  };
+  for (auto& c : cs) pump_conn(c);
+
+  epoll_event evs[64];
+  char buf[65536];
+  while (now() < t_end) {
+    int n = epoll_wait(ep, evs, 64, 50);
+    for (int i = 0; i < n; i++) {
+      CC& c = cs[evs[i].data.u32];
+      while (true) {
+        ssize_t rd = recv(c.fd, buf, sizeof(buf), 0);
+        if (rd <= 0) break;
+        c.rbuf.append(buf, rd);
+      }
+      // Count complete responses (Content-Length framing).
+      while (true) {
+        size_t he = c.rbuf.find("\r\n\r\n");
+        if (he == std::string::npos) break;
+        size_t clen = 0;
+        size_t p = c.rbuf.find("Content-Length:");
+        if (p != std::string::npos && p < he)
+          clen = strtoul(c.rbuf.c_str() + p + 15, nullptr, 10);
+        if (c.rbuf.size() < he + 4 + clen) break;
+        c.rbuf.erase(0, he + 4 + clen);
+        c.inflight--;
+        done++;
+        if (!c.sent.empty()) {
+          lats.push_back(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                             now() - c.sent.front())
+                             .count());
+          c.sent.pop_front();
+        }
+      }
+      pump_conn(c);
+    }
+  }
+  for (auto& c : cs) ::close(c.fd);
+  ::close(ep);
+  out3[0] = done;
+  if (!lats.empty()) {
+    std::sort(lats.begin(), lats.end());
+    out3[1] = lats[lats.size() / 2];
+    out3[2] = lats[(size_t)(lats.size() * 0.99)];
+  } else {
+    out3[1] = out3[2] = 0;
+  }
+  return 0;
+}
+
+// Exposed for differential tests against ops/rate.py.
+int pt_parse_rate(const char* v, int64_t* freq, int64_t* per_ns) {
+  return parse_rate(std::string(v), freq, per_ns) ? 0 : -1;
+}
+
+int pt_parse_duration(const char* v, int64_t* out) {
+  return parse_duration(std::string(v), out) ? 0 : -1;
+}
+
+}  // extern "C"

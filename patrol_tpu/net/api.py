@@ -68,8 +68,17 @@ class API:
     # -- the hot route (api.go:51-86) ---------------------------------------
 
     async def _take(self, raw_name: str, query: str) -> Tuple[int, bytes, str]:
-        name = unquote(raw_name)
-        if len(name.encode("utf-8", "surrogatepass")) > MAX_NAME_LENGTH_V1:
+        # surrogateescape: reference names are raw bytes (bucket.go:64-88);
+        # %FF must stay byte 0xFF end-to-end — through this handler, the
+        # directory, and the wire codec — and both HTTP fronts must agree
+        # (the C++ front decodes to raw bytes natively). The default
+        # 'replace' would collapse distinct non-UTF8 names into U+FFFD.
+        name = unquote(raw_name, errors="surrogateescape")
+        try:
+            name_bytes_len = len(name.encode("utf-8", "surrogateescape"))
+        except UnicodeEncodeError:  # lone surrogates not from the escape range
+            name_bytes_len = len(name.encode("utf-8", "surrogatepass"))
+        if name_bytes_len > MAX_NAME_LENGTH_V1:
             # api.go:55-58 → 400 with the error text.
             return (
                 400,

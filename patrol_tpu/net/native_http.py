@@ -1,0 +1,205 @@
+"""Native HTTP front: the C++ epoll server (native/patrol_http.cpp) pumped
+by a Python batch loop.
+
+The reference serves /take from compiled Go net/http (command.go:41-44);
+the asyncio front (net/api.py) is the protocol-complete equivalent but
+pays Python per request. This front moves the entire socket path — accept,
+epoll, HTTP parse, percent-decoding, Go-semantics rate parsing, response
+formatting — into C++, and crosses into Python in BATCHES:
+
+* the pump thread drains up to ``batch`` parsed /take records in ONE
+  ctypes call, submits them as engine tickets (they coalesce into the
+  same device tick), waits, and completes them in ONE call back;
+* non-/take routes (debug, metrics — rare) are dispatched to the existing
+  :class:`patrol_tpu.net.api.API` handlers on a private asyncio loop, so
+  both fronts share one routing/semantics implementation.
+
+h2c is NOT spoken here — the asyncio front keeps that role; deployments
+that need h2 use ``--http-front python``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import logging
+import threading
+from typing import Optional
+
+import numpy as np
+
+from patrol_tpu import native
+from patrol_tpu.ops.rate import Rate
+
+log = logging.getLogger("patrol.native-http")
+
+NAME_MAX = 256
+
+
+class NativeHTTPFront:
+    """C++ epoll HTTP/1.1 server + Python batch pump."""
+
+    def __init__(self, api, host: str, port: int, batch: int = 1024):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self.lib = lib
+        self.api = api
+        self.h = lib.pt_http_start(host.encode(), port)
+        if self.h < 0:
+            import os
+
+            raise OSError(-self.h, os.strerror(-self.h))
+        self.batch = batch
+        b = batch
+        self._tags = np.zeros(b, np.uint64)
+        self._names = np.zeros((b, NAME_MAX), np.uint8)
+        self._name_lens = np.zeros(b, np.int32)
+        self._freqs = np.zeros(b, np.int64)
+        self._pers = np.zeros(b, np.int64)
+        self._counts = np.zeros(b, np.int64)
+        self._statuses = np.zeros(b, np.int32)
+        self._remaining = np.zeros(b, np.int64)
+        ob = 64
+        self._otags = np.zeros(ob, np.uint64)
+        self._otargets = np.zeros((ob, native.PATH_MAX), np.uint8)
+        self._otarget_lens = np.zeros(ob, np.int32)
+        self._omethods = np.zeros((ob, 8), np.uint8)
+        self._ob = ob
+
+        self._stopped = threading.Event()
+        # Private loop for the async debug handlers (they use
+        # run_in_executor internally, so they need a real running loop).
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._run_loop, name="patrol-http-debug", daemon=True
+        )
+        self._loop_thread.start()
+        # Pipelined pump: the poll/submit thread hands (tags, tickets)
+        # groups to the completer, so batch N+1 is being drained and
+        # submitted WHILE batch N's device tick runs — without this the
+        # front runs lock-step at ~2 ticks of latency per request.
+        import queue as _queue
+
+        self._cq: "_queue.Queue" = _queue.Queue(maxsize=64)
+        self._completer_thread = threading.Thread(
+            target=self._completer, name="patrol-http-complete", daemon=True
+        )
+        self._completer_thread.start()
+        self._pump_thread = threading.Thread(
+            target=self._pump, name="patrol-http-pump", daemon=True
+        )
+        self._pump_thread.start()
+
+    @property
+    def port(self) -> int:
+        return self.lib.pt_http_port(self.h)
+
+    def _run_loop(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_forever()
+
+    # -- the batch pump ------------------------------------------------------
+
+    def _pump(self) -> None:
+        repo = self.api.repo
+        n_other = ctypes.c_int(0)
+        while not self._stopped.is_set():
+            nt = self.lib.pt_http_poll(
+                self.h, 50,
+                self._tags, self._names, self._name_lens,
+                self._freqs, self._pers, self._counts, self.batch,
+                self._otags, self._otargets, self._otarget_lens,
+                self._omethods, self._ob, ctypes.byref(n_other),
+            )
+            if nt < 0:
+                return
+            if nt > 0:
+                try:
+                    self._submit_takes(repo, nt)
+                except Exception:  # pragma: no cover - keep the front alive
+                    log.exception("take pump failed; answering 500")
+                    tags = self._tags[:nt].copy()
+                    st = np.full(nt, 500, np.int32)
+                    rem = np.zeros(nt, np.int64)
+                    self.lib.pt_http_complete_takes(self.h, tags, st, rem, nt)
+            for j in range(n_other.value):
+                self._dispatch_other(j)
+        self._cq.put(None)  # unblock the completer at shutdown
+
+    def _submit_takes(self, repo, nt: int) -> None:
+        tags = self._tags[:nt].copy()
+        tickets = []
+        for i in range(nt):
+            name = bytes(self._names[i, : self._name_lens[i]]).decode(
+                "utf-8", "surrogateescape"
+            )
+            rate = Rate(freq=int(self._freqs[i]), per_ns=int(self._pers[i]))
+            tickets.append(repo.submit_take(name, rate, int(self._counts[i])))
+        self._cq.put((tags, tickets))
+
+    def _completer(self) -> None:
+        while True:
+            group = self._cq.get()
+            if group is None:
+                return
+            tags, tickets = group
+            nt = len(tickets)
+            statuses = np.empty(nt, np.int32)
+            remaining = np.empty(nt, np.int64)
+            # Tickets submitted together complete in the same engine
+            # tick(s); ordered waits cost one tick total, not one each.
+            for i, t in enumerate(tickets):
+                t.wait()
+                statuses[i] = 200 if t.ok else 429
+                remaining[i] = t.remaining
+            self.lib.pt_http_complete_takes(self.h, tags, statuses, remaining, nt)
+
+    def _dispatch_other(self, j: int) -> None:
+        tag = int(self._otags[j])
+        method = bytes(self._omethods[j]).split(b"\0", 1)[0].decode("ascii", "replace")
+        target = bytes(self._otargets[j, : self._otarget_lens[j]]).decode(
+            "utf-8", "surrogateescape"
+        )
+        path, _, query = target.partition("?")
+
+        async def run():
+            return await self.api.handle(method, path, query)
+
+        fut = asyncio.run_coroutine_threadsafe(run(), self._loop)
+
+        def done(f) -> None:
+            try:
+                status, body, ctype = f.result()
+            except Exception:  # pragma: no cover
+                log.exception("debug route failed")
+                status, body, ctype = 500, b"internal error\n", "text/plain"
+            self.lib.pt_http_complete_other(
+                self.h, tag, status, ctype.encode(), body, len(body)
+            )
+
+        fut.add_done_callback(done)
+
+    # -- lifecycle / observability -------------------------------------------
+
+    def stats(self) -> dict:
+        out = np.zeros(4, np.uint64)
+        self.lib.pt_http_stats(self.h, out)
+        return {
+            "http_accepted": int(out[0]),
+            "http_requests": int(out[1]),
+            "http_active_conns": int(out[2]),
+            "http_dropped": int(out[3]),
+        }
+
+    def close(self) -> None:
+        self._stopped.set()
+        self._pump_thread.join(timeout=5)
+        self._completer_thread.join(timeout=5)
+        self.lib.pt_http_stop(self.h)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._loop_thread.join(timeout=5)
+
+
+def available() -> bool:
+    return native.load() is not None

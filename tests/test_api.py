@@ -24,15 +24,25 @@ def free_port() -> int:
 
 
 class ServerHarness:
-    """Real server on loopback in a background event loop thread."""
+    """Real server on loopback — python (asyncio) or native (C++ epoll)
+    front over the same API object, so one behavior suite pins both."""
 
-    def __init__(self):
+    def __init__(self, front: str = "python"):
         self.clock_ns = 0
         self.engine = DeviceEngine(
             LimiterConfig(buckets=64, nodes=4), node_slot=0, clock=lambda: self.clock_ns
         )
         self.repo = TPURepo(self.engine)
         self.api = API(self.repo, stats=lambda: {"engine_ticks": self.engine.ticks})
+        self.front = front
+        self.loop = None
+        self.native_front = None
+        if front == "native":
+            from patrol_tpu.net.native_http import NativeHTTPFront
+
+            self.native_front = NativeHTTPFront(self.api, "127.0.0.1", 0)
+            self.port = self.native_front.port
+            return
         self.port = free_port()
         self.loop = asyncio.new_event_loop()
         self._started = threading.Event()
@@ -70,14 +80,28 @@ class ServerHarness:
         return status, body.decode()
 
     def close(self):
-        self.loop.call_soon_threadsafe(self.loop.stop)
-        self.thread.join(timeout=5)
+        if self.native_front is not None:
+            self.native_front.close()
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self.thread.join(timeout=5)
         self.engine.stop()
 
 
-@pytest.fixture(scope="module")
-def srv():
-    h = ServerHarness()
+def _native_available() -> bool:
+    from patrol_tpu import native
+
+    return native.load() is not None
+
+
+@pytest.fixture(
+    scope="module",
+    params=["python", pytest.param("native", marks=pytest.mark.skipif(
+        not _native_available(), reason="native toolchain unavailable"
+    ))],
+)
+def srv(request):
+    h = ServerHarness(front=request.param)
     yield h
     h.close()
 
@@ -89,6 +113,19 @@ class TestTakeRoute:
         status, body = srv.request("POST", "/take/" + "x" * 232 + "?rate=1:1s")
         assert status == 400
         assert "bucket name larger than 231" in body
+
+    def test_non_utf8_percent_name_is_one_raw_byte_bucket(self, srv):
+        """%FF must decode to the raw byte 0xFF (reference names are raw
+        bytes, bucket.go:64-88) identically on BOTH fronts: the limit
+        counts 1 byte, and repeated takes address ONE bucket."""
+        srv.clock_ns += 60 * NANO  # fresh refill window
+        codes = [
+            srv.request("POST", "/take/" + "%ff" * 78 + "?rate=1:1h")[0]
+            for _ in range(2)
+        ]
+        assert codes == [200, 429]  # 78 raw bytes ≤ 231; same bucket twice
+        row = srv.engine.directory.lookup("\udcff" * 78)
+        assert row is not None  # bound as raw bytes, not U+FFFD
 
     def test_missing_rate_429_body_zero(self, srv):
         status, body = srv.request("POST", "/take/no-rate")

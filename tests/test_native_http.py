@@ -1,0 +1,155 @@
+"""Native HTTP front (native/patrol_http.cpp + net/native_http.py).
+
+The full API behavior suite already runs against this front via the
+parameterized harness in test_api.py; here live the native-specific
+contracts: the C++ Go-semantics rate parser (differential vs ops/rate.py),
+connection handling (keep-alive, close, pipelining, h2c rejection), and
+the C++ load client used by benchmarks/HTTP_BENCH.md."""
+
+import ctypes
+import random
+import socket
+
+import numpy as np
+import pytest
+
+from patrol_tpu import native
+from patrol_tpu.models.limiter import LimiterConfig
+from patrol_tpu.net.api import API
+from patrol_tpu.ops.rate import parse_rate
+from patrol_tpu.runtime.engine import DeviceEngine
+from patrol_tpu.runtime.repo import TPURepo
+
+pytestmark = pytest.mark.skipif(
+    native.load() is None, reason="native toolchain unavailable"
+)
+
+
+class TestRateParserParity:
+    """pt_parse_rate must be indistinguishable from ops/rate.py:parse_rate
+    — the C++ front parses rates without Python, so a divergence would
+    admit/deny differently depending on the chosen front."""
+
+    CORPUS = [
+        "5:1s", "50:1m", "1:s", "3", "0:1h", "100:1.5h", "2:300ms",
+        "7:2h45m", "5:µs", "5:1µs", "5:1μs", "-3:1s", "+4:1s", "garbage",
+        "5:", "5:xyz", ":1s", "5:0", "1:1ns", "9223372036854775807:1s",
+        "9223372036854775808:1s", "5:1h30m10.5s", "2:.5s", "2:1.s",
+        "5:μs", "1:0.000000001s", "1:-1s", "1:+2s", "1:0", "",
+    ]
+
+    def _cpp(self, s: str):
+        lib = native.load()
+        f = ctypes.c_int64()
+        p = ctypes.c_int64()
+        rc = lib.pt_parse_rate(s.encode(), ctypes.byref(f), ctypes.byref(p))
+        return (f.value, p.value) if rc == 0 else None
+
+    def _py(self, s: str):
+        try:
+            r = parse_rate(s)
+            return (r.freq, r.per_ns)
+        except ValueError:
+            return None
+
+    def test_corpus(self):
+        for s in self.CORPUS:
+            assert self._cpp(s) == self._py(s), s
+
+    def test_fuzz(self):
+        rng = random.Random(11)
+        alphabet = "0123456789.:smhnuµμ+-x"
+        for _ in range(5000):
+            s = "".join(
+                rng.choice(alphabet) for _ in range(rng.randint(1, 12))
+            )
+            assert self._cpp(s) == self._py(s), s
+
+
+@pytest.fixture(scope="module")
+def front():
+    engine = DeviceEngine(LimiterConfig(buckets=256, nodes=4), node_slot=0)
+    repo = TPURepo(engine)
+    api = API(repo, stats=lambda: {"engine_ticks": engine.ticks})
+    from patrol_tpu.net.native_http import NativeHTTPFront
+
+    f = NativeHTTPFront(api, "127.0.0.1", 0)
+    yield f
+    f.close()
+    engine.stop()
+
+
+class TestConnectionHandling:
+    def _roundtrip(self, sock, payload: bytes, responses: int):
+        sock.sendall(payload)
+        buf = b""
+        got = []
+        while len(got) < responses:
+            chunk = sock.recv(65536)
+            assert chunk, f"connection closed after {len(got)} responses"
+            buf += chunk
+            while True:
+                he = buf.find(b"\r\n\r\n")
+                if he < 0:
+                    break
+                head = buf[:he]
+                clen = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        clen = int(line.split(b":")[1])
+                if len(buf) < he + 4 + clen:
+                    break
+                got.append((int(head.split(b" ", 2)[1]), buf[he + 4 : he + 4 + clen]))
+                buf = buf[he + 4 + clen :]
+        return got
+
+    def test_pipelined_requests_answered_in_order(self, front):
+        with socket.create_connection(("127.0.0.1", front.port), timeout=5) as s:
+            req = b"POST /take/pipe?rate=2:1h&count=1 HTTP/1.1\r\nHost: x\r\n\r\n"
+            got = self._roundtrip(s, req * 3, 3)
+        assert [g[0] for g in got] == [200, 200, 429]
+        assert [g[1] for g in got] == [b"1", b"0", b"0"]
+
+    def test_connection_close_honored(self, front):
+        with socket.create_connection(("127.0.0.1", front.port), timeout=5) as s:
+            s.sendall(
+                b"POST /take/cc?rate=5:1s HTTP/1.1\r\nHost: x\r\n"
+                b"Connection: close\r\n\r\n"
+            )
+            data = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        assert b"Connection: close" in data
+        assert data.split(b" ", 2)[1] == b"200"
+
+    def test_request_body_drained(self, front):
+        """A body on /take must be drained, not parsed as the next
+        request (input rides the URL, api.py contract)."""
+        with socket.create_connection(("127.0.0.1", front.port), timeout=5) as s:
+            body = b"GET /nope HTTP/1.1\r\n\r\n"  # hostile: body looks like a request
+            req = (
+                b"POST /take/bd?rate=5:1h HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+            )
+            got = self._roundtrip(s, req * 2, 2)
+        assert [g[0] for g in got] == [200, 200]
+
+    def test_h2c_preface_rejected_cleanly(self, front):
+        with socket.create_connection(("127.0.0.1", front.port), timeout=5) as s:
+            s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+            data = s.recv(65536)
+        assert data.split(b" ", 2)[1] in (b"400", b"404")
+
+    def test_blast_client_end_to_end(self, front):
+        """The benchmark's C++ load client against the real front."""
+        lib = native.load()
+        out = np.zeros(3, np.uint64)
+        rc = lib.pt_http_blast(
+            b"127.0.0.1", front.port, b"/take/blast?rate=1000:1s", 4, 2, 500, out
+        )
+        assert rc == 0
+        assert int(out[0]) > 100  # completed requests
+        assert 0 < int(out[1]) <= int(out[2])  # p50 <= p99
