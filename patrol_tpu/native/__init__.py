@@ -119,6 +119,21 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pt_http_stats.restype = ctypes.c_int
         lib.pt_http_stop.argtypes = [ctypes.c_int]
         lib.pt_http_stop.restype = ctypes.c_int
+        lib.pt_dir_create.argtypes = [ctypes.c_int64, _u8p, _i32p]
+        lib.pt_dir_create.restype = ctypes.c_int
+        lib.pt_dir_insert.argtypes = [ctypes.c_int, ctypes.c_uint64, ctypes.c_int32]
+        lib.pt_dir_insert.restype = ctypes.c_int
+        lib.pt_dir_insert_batch.argtypes = [ctypes.c_int, _u64p, _i32p, ctypes.c_int]
+        lib.pt_dir_insert_batch.restype = ctypes.c_int
+        lib.pt_dir_delete.argtypes = [ctypes.c_int, ctypes.c_uint64, ctypes.c_int32]
+        lib.pt_dir_delete.restype = ctypes.c_int
+        lib.pt_dir_resolve.argtypes = [
+            ctypes.c_int, ctypes.c_int, _u64p, _u8p, _i32p, _i64p, _i32p,
+            _i64p, ctypes.c_int64,
+        ]
+        lib.pt_dir_resolve.restype = ctypes.c_int64
+        lib.pt_dir_destroy.argtypes = [ctypes.c_int]
+        lib.pt_dir_destroy.restype = ctypes.c_int
         lib.pt_http_blast.argtypes = [
             ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, _u64p,

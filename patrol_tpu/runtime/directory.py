@@ -92,21 +92,68 @@ class BucketDirectory:
         self._name_words = self.name_bytes.view(np.uint64)
         self.name_len = np.zeros(capacity, dtype=np.int32)
         self.name_hash = np.zeros(capacity, dtype=np.uint64)
-        # Open addressing, linear probing, ≤25% load for short chains.
-        m = 64
-        while m < capacity * 4:
-            m <<= 1
-        self._ht_mask = np.uint64(m - 1)
-        self._ht_hash = np.zeros(m, dtype=np.uint64)
-        self._ht_row = np.full(m, -1, dtype=np.int32)  # -1 empty, -2 tombstone
-        self._ht_tombs = 0
-        self._ht_maxprobe = 1
+        # The (hash → row) table: C++ (native/patrol_host.cpp pt_dir —
+        # reads name_bytes/name_len through shared pointers, resolves a
+        # whole batch per call) with a pure-numpy open-addressing fallback.
+        self._ptlib = None
+        self._ptdir = -1
+        self._closed = False
+        try:
+            from patrol_tpu import native
+
+            lib = native.load()
+            if lib is not None:
+                hdl = lib.pt_dir_create(capacity, self.name_bytes, self.name_len)
+                if hdl >= 0:
+                    self._ptlib, self._ptdir = lib, hdl
+        except Exception:  # pragma: no cover - fall back to numpy
+            pass
+        if self._ptlib is None:
+            # numpy open addressing, linear probing, ≤25% load.
+            m = 64
+            while m < capacity * 4:
+                m <<= 1
+            self._ht_mask = np.uint64(m - 1)
+            self._ht_hash = np.zeros(m, dtype=np.uint64)
+            self._ht_row = np.full(m, -1, dtype=np.int32)  # -1 empty, -2 tomb
+            self._ht_tombs = 0
+            self._ht_maxprobe = 1
+
+    def close(self) -> None:
+        """Release the native resolve table (engine.stop calls this).
+
+        Runs under ``_mu``: every native table call holds the lock, so the
+        destroy cannot race an in-flight resolve (including rx threads a
+        timed-out join left behind). Post-close the directory stays
+        FUNCTIONAL minus hash routing: binds/unbinds skip the table and
+        hashed lookups miss (string lookups still work) — shutdown-
+        concurrent requests degrade instead of raising."""
+        with self._mu:
+            self._closed = True
+            if self._ptlib is not None and self._ptdir >= 0:
+                lib, hdl = self._ptlib, self._ptdir
+                self._ptlib, self._ptdir = None, -1
+                lib.pt_dir_destroy(hdl)
+
+    def __del__(self):  # pragma: no cover - GC-time safety net
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # -- hash table (guarded by _mu) ----------------------------------------
 
     def _bind_locked(
-        self, name: str, row: int, now_ns: int, h: Optional[int] = None
-    ) -> None:
+        self,
+        name: str,
+        row: int,
+        now_ns: int,
+        h: Optional[int] = None,
+        defer_insert: bool = False,
+    ) -> bool:
+        """Bind bookkeeping; returns True when the caller must insert the
+        (hash, row) into the resolve table (``defer_insert`` batches the
+        inserts — one native call per chunk instead of one per bucket)."""
         self._rows[name] = row
         self._names[row] = name
         self._bound[row] = True
@@ -121,11 +168,19 @@ class BucketDirectory:
             if h is None:
                 h = _fnv1a64(raw)  # wire path passes the C++-computed hash
             self.name_hash[row] = h
-            self._ht_insert_locked(h, row)
+            if self._closed:
+                return False  # post-close: no table, hashed lookups miss
+            if defer_insert:
+                return True
+            if self._ptlib is not None:
+                self._ptlib.pt_dir_insert(self._ptdir, h, row)
+            else:
+                self._ht_insert_locked(h, row)
         else:
             # Unreachable from the wire (packets bound names at 231 bytes);
             # reachable only via hashed lookup, so skip the table.
             self.name_hash[row] = 0
+        return False
 
     def _unbind_row_locked(self, row: int) -> None:
         name = self._names[row]
@@ -133,8 +188,11 @@ class BucketDirectory:
             del self._rows[name]
             self._names[row] = None
         self._bound[row] = False
-        if self.name_len[row] <= NAME_BYTES_MAX:
-            self._ht_delete_locked(int(self.name_hash[row]), row)
+        if self.name_len[row] <= NAME_BYTES_MAX and not self._closed:
+            if self._ptlib is not None:
+                self._ptlib.pt_dir_delete(self._ptdir, int(self.name_hash[row]), row)
+            else:
+                self._ht_delete_locked(int(self.name_hash[row]), row)
         self.name_len[row] = 0
 
     def _ht_insert_locked(self, h: int, row: int) -> None:
@@ -206,12 +264,29 @@ class BucketDirectory:
         rows = np.full(n, -1, dtype=np.int64)
         if n == 0:
             return rows
-        hashes = hashes.astype(np.uint64, copy=False)
-        if name_buf.dtype == np.uint64:
-            words = name_buf
-        else:
-            words = np.ascontiguousarray(name_buf).view(np.uint64)
+        hashes = np.ascontiguousarray(hashes, dtype=np.uint64)
         with self._mu:
+            # Implementation choice under the lock: close() also nulls the
+            # native handle under it, so resolve can never race teardown.
+            if self._ptlib is not None:
+                buf8 = (
+                    name_buf.view(np.uint8)
+                    if name_buf.dtype == np.uint64
+                    else name_buf
+                )
+                buf8 = np.ascontiguousarray(buf8, dtype=np.uint8)
+                lens = np.ascontiguousarray(name_lens, dtype=np.int32)
+                self._ptlib.pt_dir_resolve(
+                    self._ptdir, n, hashes, buf8, lens, rows,
+                    self.pins, self.last_used_ns, now_ns,
+                )
+                return rows
+            if self._closed:
+                return rows  # all miss; the string slow path still works
+            if name_buf.dtype == np.uint64:
+                words = name_buf
+            else:
+                words = np.ascontiguousarray(name_buf).view(np.uint64)
             pos = (hashes & self._ht_mask).astype(np.int64)
             pend = np.flatnonzero(name_lens >= 0)
             for _ in range(self._ht_maxprobe):
@@ -294,17 +369,25 @@ class BucketDirectory:
                     raise DirectoryFullError(
                         f"bucket directory needs {need} rows, pool spent"
                     )
+                pend_rows: List[int] = []
                 for i in missing:
                     nm = names[i]
                     r = fresh[nm]
                     if r < 0:
                         r = self._alloc_locked()
                         fresh[nm] = r
-                        self._bind_locked(
+                        if self._bind_locked(
                             nm, r, now_ns,
                             h=None if hashes is None else int(hashes[i]),
-                        )
+                            defer_insert=self._ptlib is not None,
+                        ):
+                            pend_rows.append(r)
                     rows[i] = r
+                if pend_rows:
+                    pr = np.asarray(pend_rows, dtype=np.int32)
+                    self._ptlib.pt_dir_insert_batch(
+                        self._ptdir, self.name_hash[pr], pr, len(pr)
+                    )
             arr = np.asarray(rows, dtype=np.int64)
             self.last_used_ns[arr] = now_ns
             if pin:

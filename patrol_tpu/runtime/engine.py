@@ -832,6 +832,7 @@ class DeviceEngine:
             self._stopped = True
             self._cond.notify_all()
         self._thread.join(timeout=5)
+        self.directory.close()  # releases the native resolve table
 
     @property
     def ticks(self) -> int:

@@ -57,9 +57,29 @@ class TestFnvParity:
         assert _fnv1a64(b"a") == 0xAF63DC4C8601EC8C
 
 
+@pytest.fixture(params=["native", "numpy"])
+def make_dir(request, monkeypatch):
+    """Directory factory running each test against BOTH resolve-table
+    implementations: the C++ pt_dir and the pure-numpy fallback."""
+    if request.param == "numpy":
+        from patrol_tpu import native
+
+        monkeypatch.setattr(native, "load", lambda: None)
+
+    def make(capacity):
+        d = BucketDirectory(capacity)
+        if request.param == "native":
+            assert d._ptlib is not None, "native table expected"
+        else:
+            assert d._ptlib is None
+        return d
+
+    return make
+
+
 class TestHashedLookup:
-    def test_hit_pins_and_misses_stay_unpinned(self):
-        d = BucketDirectory(8)
+    def test_hit_pins_and_misses_stay_unpinned(self, make_dir):
+        d = make_dir(8)
         row, _ = d.assign("alpha", 100)
         buf, lens, hashes = _buf(["alpha", "ghost"])
         rows = d.lookup_hashed_pinned(hashes, buf, lens, 200)
@@ -68,10 +88,10 @@ class TestHashedLookup:
         assert d.last_used_ns[row] == 200
         d.unpin_rows([row])
 
-    def test_hash_match_wrong_bytes_is_miss(self):
+    def test_hash_match_wrong_bytes_is_miss(self, make_dir):
         """A forged/colliding hash with different bytes must miss, never
         resolve to the wrong bucket."""
-        d = BucketDirectory(8)
+        d = make_dir(8)
         row, _ = d.assign("alpha", 100)
         buf, lens, _ = _buf(["bravo"])
         forged = np.array([_fnv1a64(b"alpha")], np.uint64)
@@ -79,8 +99,8 @@ class TestHashedLookup:
         assert rows[0] == -1
         assert d.pins[row] == 0
 
-    def test_unbind_removes_from_table(self):
-        d = BucketDirectory(8)
+    def test_unbind_removes_from_table(self, make_dir):
+        d = make_dir(8)
         d.assign("gone", 100)
         d.release("gone")
         buf, lens, hashes = _buf(["gone"])
@@ -90,10 +110,10 @@ class TestHashedLookup:
         assert d.lookup_hashed_pinned(hashes, buf, lens, 400)[0] == row2
         d.unpin_rows([row2])
 
-    def test_eviction_cycle_keeps_table_consistent(self):
+    def test_eviction_cycle_keeps_table_consistent(self, make_dir):
         """Churn far past capacity: every live name must resolve, every
         evicted name must miss — across tombstone-triggered rebuilds."""
-        d = BucketDirectory(16)
+        d = make_dir(16)
         live = {}
         for gen in range(20):
             for i in range(8):
@@ -118,14 +138,30 @@ class TestHashedLookup:
             assert rows[i] == (live[nm] if nm in live else -1), nm
         d.unpin_rows(rows[rows >= 0])
 
-    def test_batch_with_malformed_rows_skipped(self):
-        d = BucketDirectory(8)
+    def test_batch_with_malformed_rows_skipped(self, make_dir):
+        d = make_dir(8)
         row, _ = d.assign("ok", 1)
         buf, lens, hashes = _buf(["ok", "bad"])
         lens[1] = -1  # malformed packet marker
         rows = d.lookup_hashed_pinned(hashes, buf, lens, 2)
         assert rows[0] == row and rows[1] == -1
         d.unpin_rows([row])
+
+    def test_post_close_degrades_not_raises(self, make_dir):
+        """After close() (engine.stop), shutdown-concurrent work must
+        degrade — hashed lookups miss, binds/unbinds skip the table —
+        never raise; string lookups keep working."""
+        d = make_dir(8)
+        row, _ = d.assign("pre", 1)
+        d.close()
+        buf, lens, hashes = _buf(["pre", "post"])
+        rows = d.lookup_hashed_pinned(hashes, buf, lens, 2)
+        assert (rows == -1).all()  # hash routing is gone
+        r2, created = d.assign("post", 3)  # bind still works (no table)
+        assert created and d.lookup("post") == r2
+        assert d.lookup("pre") == row  # string path unaffected
+        d.release("pre")
+        d.close()  # idempotent
 
 
 class TestRawIngestEquivalence:
