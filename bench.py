@@ -335,7 +335,11 @@ def _stage_mesh_flagship(out, B, N) -> None:
     eng = MeshEngine(cfg, replicas=1, node_slot=0)
     try:
         rate = Rate(freq=100, per_ns=NANO)
-        kt, km = 256, 4096
+        # Modest batch: the squared (k, k) tick padding makes per-tick arg
+        # transfer k-proportional, and on the axon tunnel host→device bytes
+        # dominate the smoke (real local TPUs don't care). 1024 still
+        # exercises merge+take+converge at flagship state size.
+        kt, km = 256, 1024
         rng = np.random.default_rng(3)
 
         def round_trip(tag: int) -> None:

@@ -955,7 +955,17 @@ class DeviceEngine:
     def _group_tickets(self, tickets: Sequence[TakeTicket]):
         """Coalesce by (row, rate, count) preserving arrival order; defer
         rows seen with a second key to the next tick (kernel invariant:
-        unique rows per batch). → (keys, groups)."""
+        unique rows per batch). → (keys, groups).
+
+        Starvation bound (rate-diversity adversary): deferred tickets are
+        re-queued at the FRONT in arrival order, so a ticket can only wait
+        behind same-row tickets that arrived BEFORE it — one tick per
+        distinct earlier key, and never behind later arrivals. A client
+        hammering one bucket with N distinct rates therefore delays only
+        that bucket, by exactly its own queue depth (the same cost any
+        FIFO service gives N requests), and cannot push an
+        already-queued victim back (pinned by
+        tests/test_engine.py::TestRateDiversity)."""
         groups: Dict[tuple, List[TakeTicket]] = {}
         row_key: Dict[int, tuple] = {}
         deferred: List[TakeTicket] = []

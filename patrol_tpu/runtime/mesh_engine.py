@@ -98,6 +98,12 @@ class MeshEngine(DeviceEngine):
             d_placed.append(blk)
             fill_d[blk] += 1
         k_merge = _pad_size(max(fill_d) if fill_d else 1, lo=8, hi=1 << 14)
+        # Square the paddings: only DIAGONAL (k, k) shapes ever compile, so
+        # warmup's size sweep covers every runtime tick — an off-diagonal
+        # (k_take, k_merge) pair would JIT a fresh variant mid-serve (a
+        # multi-second p99 spike on a remote-compile TPU). Padded rows are
+        # no-ops, so the cost is a slightly wider batch, not extra steps.
+        k_take = k_merge = max(k_take, k_merge)
 
         takes = []
         for key in keys:

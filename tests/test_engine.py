@@ -260,6 +260,55 @@ class TestEviction:
         assert engine.snapshot("w")[0].lane_added_nt == NANO
 
 
+class TestRateDiversity:
+    """The _group_tickets starvation bound: a rate-diversity flood on one
+    bucket cannot starve an already-queued ticket (FIFO per row), and
+    every tick makes at least one key of progress per row."""
+
+    def test_diverse_key_flood_cannot_overtake_earlier_ticket(self, engine):
+        import threading
+
+        done_order: list = []
+        lock = threading.Lock()
+
+        def track(tag, ticket):
+            def record():
+                with lock:
+                    done_order.append(tag)
+
+            ticket.add_done_callback(record)
+
+        # Victim queued first, then a flood of 40 distinct-rate tickets on
+        # the SAME bucket arriving after it.
+        victim, _ = engine.submit_take("hotbkt", Rate(freq=100, per_ns=NANO), 1)
+        track("victim", victim)
+        flood = []
+        for i in range(40):
+            t, _ = engine.submit_take(
+                "hotbkt", Rate(freq=200 + i, per_ns=NANO), 1
+            )
+            track(("flood", i), t)
+            flood.append(t)
+        assert victim.wait(30), "victim starved by diverse-key flood"
+        for t in flood:
+            assert t.wait(30), "flood ticket itself starved"
+        # FIFO bound: the victim completed before every flood ticket.
+        with lock:
+            assert done_order[0] == "victim"
+
+    def test_all_diverse_keys_complete_one_per_tick_bound(self, engine):
+        t0 = engine.ticks
+        tickets = [
+            engine.submit_take("divbkt", Rate(freq=50 + i, per_ns=NANO), 1)[0]
+            for i in range(16)
+        ]
+        for t in tickets:
+            assert t.wait(30)
+        # ≥1 key of progress per tick: 16 distinct keys cost ≤ 16 ticks
+        # of same-row serialization (plus a bounded few for scheduling).
+        assert engine.ticks - t0 <= 16 + 4
+
+
 class TestIngestWireSemantics:
     """The mixed-cluster ingest contract (ops/wire.py): each sender class
     must route through the right merge path — exact lane values for lane
