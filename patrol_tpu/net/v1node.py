@@ -159,3 +159,33 @@ class V1Node:
         self._stopped.set()
         self._thread.join(timeout=2)
         self.sock.close()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """Run a standalone v1-semantics node: the migration bridge for hosts
+    without an accelerator, speaking the reference protocol on the wire.
+
+    python -m patrol_tpu.net.v1node --node-addr H:P [--peer-addr H:P]...
+    """
+    import argparse
+    import time
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--node-addr", default="127.0.0.1:16000")
+    p.add_argument("--peer-addr", action="append", default=[], dest="peer_addrs")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    node = V1Node(args.node_addr, args.peer_addrs)
+    log.info(
+        "v1 node serving on %s (%d peers)", args.node_addr, len(node.peers)
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        node.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -317,22 +317,34 @@ class DeviceEngine:
         log.info("evicted %d idle buckets (pool pressure)", victims.size)
         return int(victims.size)
 
-    def assign_row(self, name: str, now: int, pin: bool = False) -> Tuple[int, bool]:
-        """Directory assign with second-chance eviction on a spent pool.
-        Loops because concurrent fast-path assigners may consume freed rows
-        before we re-try; each iteration that evicts makes global progress.
-        Raises DirectoryFullError only when every row is mid-flight."""
+    def _with_evict_retry(self, call, need: int):
+        """Second-chance eviction scaffolding shared by every assign
+        variant: fast path, then evict-and-retry under ``_evict_mu``.
+        Loops because concurrent fast-path assigners may consume freed
+        rows before the re-try; each iteration that evicts makes global
+        progress. Returns None when every row is mid-flight (nothing
+        evictable)."""
         try:
-            return self.directory.assign(name, now, pin=pin)
+            return call()
         except DirectoryFullError:
             pass
         with self._evict_mu:
             while True:
                 try:
-                    return self.directory.assign(name, now, pin=pin)
+                    return call()
                 except DirectoryFullError:
-                    if self._evict(1) == 0:
-                        raise
+                    if self._evict(need) == 0:
+                        return None
+
+    def assign_row(self, name: str, now: int, pin: bool = False) -> Tuple[int, bool]:
+        """Directory assign with second-chance eviction on a spent pool.
+        Raises DirectoryFullError only when every row is mid-flight."""
+        res = self._with_evict_retry(
+            lambda: self.directory.assign(name, now, pin=pin), 1
+        )
+        if res is None:
+            raise DirectoryFullError("every bucket row is mid-flight")
+        return res
 
     def _assign_pinned(self, name: str, now: int) -> Tuple[int, bool]:
         return self.assign_row(name, now, pin=True)
@@ -341,19 +353,21 @@ class DeviceEngine:
         """Batch form of :meth:`_assign_pinned`; returns rows or None when
         the pool is spent with every row pinned (callers drop the batch —
         replication is loss-tolerant)."""
-        try:
-            return self.directory.assign_many(names, now, pin=True, hashes=hashes)
-        except DirectoryFullError:
-            pass
-        with self._evict_mu:
-            while True:
-                try:
-                    return self.directory.assign_many(
-                        names, now, pin=True, hashes=hashes
-                    )
-                except DirectoryFullError:
-                    if self._evict(len(names)) == 0:
-                        return None
+        return self._with_evict_retry(
+            lambda: self.directory.assign_many(names, now, pin=True, hashes=hashes),
+            len(names),
+        )
+
+    def _assign_many_pinned_wire(self, names, name_rows, name_lens, hashes, now):
+        """Wire-decoded variant of :meth:`_assign_many_pinned` — fresh
+        binds copy the already-decoded name bytes vectorized
+        (directory.assign_many_wire); same eviction-retry contract."""
+        return self._with_evict_retry(
+            lambda: self.directory.assign_many_wire(
+                names, name_rows, name_lens, hashes, now, pin=True
+            ),
+            len(names),
+        )
 
     # -- entry points -------------------------------------------------------
 
@@ -634,14 +648,15 @@ class DeviceEngine:
             )
             miss = np.flatnonzero(rows < 0)
             if miss.size:
+                mi = idx[miss]
                 miss_names = [
                     bytes(name_buf[i, : name_lens[i]]).decode(
                         "utf-8", "surrogateescape"
                     )
-                    for i in idx[miss]
+                    for i in mi
                 ]
-                miss_rows = self._assign_many_pinned(
-                    miss_names, now, hashes=name_hashes[idx[miss]]
+                miss_rows = self._assign_many_pinned_wire(
+                    miss_names, name_buf[mi], name_lens[mi], name_hashes[mi], now
                 )
                 if miss_rows is None:
                     log.warning(

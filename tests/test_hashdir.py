@@ -164,6 +164,59 @@ class TestHashedLookup:
         d.close()  # idempotent
 
 
+class TestAssignManyWireAtomicity:
+    """assign_many_wire must honor the same contract as assign_many
+    (pinned by tests/test_engine.py for the string variant): a full pool
+    raises with ZERO rows assigned or pinned, and duplicate names within
+    one batch bind once."""
+
+    def test_full_pool_assigns_and_pins_nothing(self, make_dir):
+        d = make_dir(2)
+        d.assign("a", 0)
+        d.assign("b", 0)
+        names = ["c", "d"]
+        buf, lens, hashes = _buf(names)
+        with pytest.raises(Exception) as exc:
+            d.assign_many_wire(names, buf, lens, hashes, 1, pin=True)
+        assert "pool spent" in str(exc.value)
+        assert d.lookup("c") is None and d.lookup("d") is None
+        assert d.pins.sum() == 0
+        # Existing rows were not pinned either (nothing-happened contract).
+        assert len(d) == 2
+
+    def test_duplicate_names_bind_once_and_pin_per_entry(self, make_dir):
+        d = make_dir(4)
+        names = ["dup", "dup", "solo"]
+        buf, lens, hashes = _buf(names)
+        rows = d.assign_many_wire(names, buf, lens, hashes, 5, pin=True)
+        assert rows[0] == rows[1] != rows[2]
+        assert len(d) == 2
+        assert d.pins[rows[0]] == 2  # one pin per batch entry
+        assert d.pins[rows[2]] == 1
+        # The fresh binds are hash-resolvable immediately.
+        r2 = d.lookup_hashed_pinned(hashes, buf, lens, 6)
+        assert (r2 == rows).all()
+        d.unpin_rows(rows)
+        d.unpin_rows(r2)
+
+    def test_wire_retry_path_drops_batch_when_all_pinned(self):
+        """_assign_many_pinned_wire returns None (batch dropped, no pin
+        leak) when the pool is spent with every row in flight."""
+        eng = DeviceEngine(LimiterConfig(buckets=2, nodes=4), node_slot=0, clock=lambda: 0)
+        try:
+            eng.directory.assign("a", 0, pin=True)  # pinned: not evictable
+            eng.directory.assign("b", 0, pin=True)
+            names = ["c"]
+            buf, lens, hashes = _buf(names)
+            before = eng.directory.pins.sum()
+            got = eng._assign_many_pinned_wire(names, buf, lens, hashes, 1)
+            assert got is None
+            assert eng.directory.pins.sum() == before  # no pin leak
+        finally:
+            eng.directory.unpin_rows([0, 1])
+            eng.stop()
+
+
 class TestRawIngestEquivalence:
     @pytest.fixture
     def engine(self):
