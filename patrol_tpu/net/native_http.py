@@ -129,14 +129,20 @@ class NativeHTTPFront:
 
     def _submit_takes(self, repo, nt: int) -> None:
         tags = self._tags[:nt].copy()
-        tickets = []
-        for i in range(nt):
-            name = bytes(self._names[i, : self._name_lens[i]]).decode(
+        names = [
+            bytes(self._names[i, : self._name_lens[i]]).decode(
                 "utf-8", "surrogateescape"
             )
-            rate = Rate(freq=int(self._freqs[i]), per_ns=int(self._pers[i]))
-            tickets.append(repo.submit_take(name, rate, int(self._counts[i])))
-        self._cq.put((tags, tickets))
+            for i in range(nt)
+        ]
+        rates = [
+            Rate(freq=int(self._freqs[i]), per_ns=int(self._pers[i]))
+            for i in range(nt)
+        ]
+        res = repo.submit_takes_batch(names, rates, self._counts[:nt])
+        if res is None:  # pool spent with everything pinned: rare overload
+            raise RuntimeError("bucket pool spent; takes dropped")
+        self._cq.put((tags, [t for t, _ in res]))
 
     def _completer(self) -> None:
         while True:

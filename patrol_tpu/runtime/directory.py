@@ -547,10 +547,21 @@ class BucketDirectory:
         return base
 
     def init_cap_base_many(self, rows: np.ndarray, caps_nt: np.ndarray) -> None:
-        """Vectorized :meth:`init_cap_base` for the bulk ingest path: rows
-        whose base is still 0 adopt the given (non-zero) capacity."""
+        """Vectorized :meth:`init_cap_base` for the bulk paths: rows whose
+        base is still 0 adopt the given capacity. Zero caps are no-ops and
+        the FIRST occurrence wins on duplicate rows within one batch
+        (reversed fancy-assign: numpy writes last-one-wins, so reversing
+        restores the single-call first-nonzero-wins semantics,
+        bucket.go:194-196)."""
+        if not len(rows):
+            return
+        caps_nt = np.asarray(caps_nt, dtype=np.int64)
+        rows = np.asarray(rows, dtype=np.int64)
+        nz = caps_nt != 0
+        if not nz.all():
+            rows, caps_nt = rows[nz], caps_nt[nz]
         if not len(rows):
             return
         with self._mu:
             unset = self.cap_base_nt[rows] == 0
-            self.cap_base_nt[rows[unset]] = caps_nt[unset]
+            self.cap_base_nt[rows[unset][::-1]] = caps_nt[unset][::-1]

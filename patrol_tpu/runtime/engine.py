@@ -399,6 +399,42 @@ class DeviceEngine:
         ticket.wait()
         return ticket.remaining, ticket.ok, created
 
+    def submit_takes_batch(
+        self,
+        names: Sequence[str],
+        rates: Sequence[Rate],
+        counts: Sequence[int],
+        now_ns: Optional[int] = None,
+    ) -> Optional[List[Tuple[TakeTicket, bool]]]:
+        """Batched :meth:`submit_take` for the native HTTP pump: ONE
+        directory pass (assign_many), one capacity init, one queue
+        append + wake-up, instead of per-request lock/notify churn.
+        Returns [(ticket, created), ...] in request order, or None when
+        the pool is spent with every row pinned (the caller falls back or
+        fails the batch)."""
+        now = self.clock() if now_ns is None else now_ns
+        rows = self._assign_many_pinned(list(names), now)
+        if rows is None:
+            return None
+        created_arr = self.directory.cap_base_nt[rows] == 0
+        # Sequential-parity: only the FIRST occurrence of a row in the
+        # batch counts as the creating miss (submit_take called twice
+        # returns created=(True, False)).
+        first = np.zeros(len(rows), dtype=bool)
+        first[np.unique(rows, return_index=True)[1]] = True
+        created = (created_arr & first).tolist()
+        self.directory.init_cap_base_many(
+            rows, np.asarray([r.freq for r in rates], np.int64) * NANO
+        )
+        tickets = [
+            TakeTicket(names[i], int(rows[i]), rates[i], int(counts[i]), now)
+            for i in range(len(names))
+        ]
+        with self._cond:
+            self._takes.extend(tickets)
+            self._cond.notify()
+        return list(zip(tickets, created))
+
     def ingest_delta(self, state: wire.WireState, slot: int, scalar: bool = False) -> bool:
         """Queue one replication delta for merge; returns created flag.
         Dropped (not an error) if the pool is spent with everything pinned —

@@ -59,6 +59,18 @@ class TPURepo:
             self._maybe_incast(name)
         return ticket
 
+    def submit_takes_batch(self, names, rates, counts):
+        """Batched :meth:`submit_take` (native HTTP pump): one engine
+        directory pass, then the per-created incast solicitations.
+        → [(ticket, created), ...] or None on a fully-pinned pool."""
+        res = self.engine.submit_takes_batch(names, rates, counts)
+        if res is None:
+            return None
+        for (ticket, created), name in zip(res, names):
+            if created:
+                self._maybe_incast(name)
+        return res
+
     def take(
         self, name: str, rate: Rate, count: int, now_ns: Optional[int] = None
     ) -> Tuple[int, bool]:

@@ -62,6 +62,22 @@ class TestDirectory:
         assert d.init_cap_base(row, 5 * NANO) == 5 * NANO
         assert d.init_cap_base(row, 9 * NANO) == 5 * NANO
 
+    def test_cap_base_many_first_nonzero_wins_on_dups(self):
+        """Batched init must keep the single-call semantics: zero caps are
+        no-ops and the FIRST nonzero occurrence wins for a row duplicated
+        within one batch (numpy fancy-assign alone would be last-wins)."""
+        import numpy as np
+
+        d = BucketDirectory(4)
+        r0, _ = d.assign("a", 0)
+        r1, _ = d.assign("b", 0)
+        d.init_cap_base_many(
+            np.array([r0, r0, r1, r1]),
+            np.array([0, 7 * NANO, 3 * NANO, 9 * NANO]),
+        )
+        assert d.cap_base_nt[r0] == 7 * NANO  # zero skipped, first nonzero
+        assert d.cap_base_nt[r1] == 3 * NANO  # first of the dups
+
 
 class TestEngine:
     def test_basic_take(self, engine):
@@ -258,6 +274,48 @@ class TestEviction:
         # Header carries the aggregate scalars (cap 0: no local take yet).
         assert by_slot[1].added_nt == 5 * NANO and by_slot[1].taken_nt == NANO
         assert engine.snapshot("w")[0].lane_added_nt == NANO
+
+
+class TestSubmitTakesBatch:
+    def test_batch_matches_singles(self, engine):
+        """submit_takes_batch must admit/deny identically to per-request
+        submit_take, coalescing same-bucket takes into one tick group."""
+        rates = [RATE] * 6
+        res = engine.submit_takes_batch(
+            ["bt", "bt", "bt", "other", "bt", "bt"], rates, [2, 2, 2, 1, 2, 2]
+        )
+        assert res is not None
+        outcomes = []
+        for t, _created in res:
+            t.wait()
+            outcomes.append((t.ok, t.remaining))
+        # bucket "bt" cap 10: five count-2 takes admit exactly five... cap
+        # 10 admits 5×2; all five succeed, draining to 0.
+        bt = [o for i, o in enumerate(outcomes) if i != 3]
+        assert [ok for ok, _ in bt] == [True] * 5
+        assert bt[-1][1] == 0
+        assert outcomes[3] == (True, 9)
+        # And the bucket is now empty:
+        _, ok, _ = engine.take("bt", RATE, 1)
+        assert not ok
+
+    def test_batch_created_flags_and_pool_spent(self):
+        eng = DeviceEngine(LimiterConfig(buckets=2, nodes=4), node_slot=0, clock=lambda: 0)
+        try:
+            res = eng.submit_takes_batch(["x", "x", "y"], [RATE] * 3, [1] * 3)
+            flags = [c for _, c in res]
+            # Sequential parity: only the FIRST occurrence of each bucket
+            # is the creating miss (submit_take twice → (True, False)).
+            assert flags == [True, False, True]
+            for t, _ in res:
+                t.wait()
+            # Pool of 2 spent and pinned ⇒ batch for a third name → None.
+            eng.directory.assign("x", 0, pin=True)
+            eng.directory.assign("y", 0, pin=True)
+            assert eng.submit_takes_batch(["z"], [RATE], [1]) is None
+            eng.directory.unpin_rows([eng.directory.lookup("x"), eng.directory.lookup("y")])
+        finally:
+            eng.stop()
 
 
 class TestRateDiversity:
