@@ -221,23 +221,41 @@ def route_requests(
         t["created_ns"][at] = created_ns
         fill_t[blk] += 1
 
-    fill_d = [0] * B
-    rr = 0
-    for row, slot, added_nt, taken_nt, elapsed_ns in deltas:
-        shard, local = divmod(row, plan.rows_per_shard)
-        replica = row % plan.replicas if deltas_to_home else rr % plan.replicas
-        rr += 1
-        blk = plan.block_index(replica, shard)
-        i = fill_d[blk]
-        if i >= k_merge:
-            raise ValueError(f"merge block {blk} overflow (k_merge={k_merge})")
-        at = blk * k_merge + i
-        d["rows"][at] = local
-        d["slots"][at] = slot
-        d["added_nt"][at] = max(added_nt, 0)
-        d["taken_nt"][at] = max(taken_nt, 0)
-        d["elapsed_ns"][at] = max(elapsed_ns, 0)
-        fill_d[blk] += 1
+    # Deltas pack vectorized — thousands per tick ride this path (takes
+    # are pre-coalesced to a few keys, so their loop stays Python).
+    # ``deltas`` is a 5-tuple of int64 arrays (rows, slots, added_nt,
+    # taken_nt, elapsed_ns) or a sequence of 5-tuples (tests).
+    if deltas is not None and len(deltas):
+        if isinstance(deltas, tuple) and isinstance(deltas[0], np.ndarray):
+            rows_a, slots_a, added_a, taken_a, elapsed_a = (
+                np.asarray(x, dtype=np.int64) for x in deltas
+            )
+        else:
+            arr = np.asarray(list(deltas), dtype=np.int64).T
+            rows_a, slots_a, added_a, taken_a, elapsed_a = arr
+        K = len(rows_a)
+        shard = rows_a // plan.rows_per_shard
+        local = rows_a % plan.rows_per_shard
+        replica = (
+            rows_a % plan.replicas
+            if deltas_to_home
+            else np.arange(K, dtype=np.int64) % plan.replicas
+        )
+        blk = replica * plan.shards + shard
+        counts = np.bincount(blk, minlength=B)
+        if counts.max(initial=0) > k_merge:
+            raise ValueError(
+                f"merge block {int(counts.argmax())} overflow (k_merge={k_merge})"
+            )
+        order = np.argsort(blk, kind="stable")
+        sblk = blk[order]
+        run_start = np.concatenate(([0], np.cumsum(counts)))[sblk]
+        at = sblk * k_merge + (np.arange(K, dtype=np.int64) - run_start)
+        d["rows"][at] = local[order]
+        d["slots"][at] = slots_a[order]
+        d["added_nt"][at] = np.maximum(added_a[order], 0)
+        d["taken_nt"][at] = np.maximum(taken_a[order], 0)
+        d["elapsed_ns"][at] = np.maximum(elapsed_a[order], 0)
 
     return (
         TakeRequest(**{k: jnp.asarray(v) for k, v in t.items()}),

@@ -76,9 +76,9 @@ class MeshEngine(DeviceEngine):
 
         plan = self.plan
         B = plan.blocks
-        d_rows = deltas.rows.tolist() if deltas is not None else []
 
-        # Per-block occupancy → padded block capacity.
+        # Per-block occupancy → padded block capacity. Take keys are
+        # pre-coalesced (few), deltas are bulk → vectorized bincount.
         fill_t = [0] * B
         placed: List[Tuple[int, int]] = []  # (block, slot-in-block) per key
         for key in keys:
@@ -89,15 +89,15 @@ class MeshEngine(DeviceEngine):
             fill_t[blk] += 1
         k_take = _pad_size(max(fill_t) if fill_t else 1, lo=8, hi=1 << 14)
 
-        fill_d = [0] * B
-        d_placed: List[int] = []
-        for i, row in enumerate(d_rows):
-            shard, _ = divmod(row, plan.rows_per_shard)
-            replica = i % plan.replicas
-            blk = plan.block_index(replica, shard)
-            d_placed.append(blk)
-            fill_d[blk] += 1
-        k_merge = _pad_size(max(fill_d) if fill_d else 1, lo=8, hi=1 << 14)
+        if deltas is not None and len(deltas):
+            d_rows = np.asarray(deltas.rows, dtype=np.int64)
+            blk = (
+                np.arange(len(d_rows), dtype=np.int64) % plan.replicas
+            ) * plan.shards + d_rows // plan.rows_per_shard
+            max_fill = int(np.bincount(blk, minlength=B).max(initial=0))
+        else:
+            max_fill = 0
+        k_merge = _pad_size(max(max_fill, 1), lo=8, hi=1 << 14)
         # Square the paddings: only DIAGONAL (k, k) shapes ever compile, so
         # warmup's size sweep covers every runtime tick — an off-diagonal
         # (k_take, k_merge) pair would JIT a fresh variant mid-serve (a
@@ -121,21 +121,19 @@ class MeshEngine(DeviceEngine):
                     int(self.directory.created_ns[first.row]),
                 )
             )
-        delta_tuples = (
-            list(
-                zip(
-                    d_rows,
-                    deltas.slots.tolist(),
-                    deltas.added_nt.tolist(),
-                    deltas.taken_nt.tolist(),
-                    deltas.elapsed_ns.tolist(),
-                )
+        delta_arrays = (
+            (
+                np.asarray(deltas.rows, np.int64),
+                np.asarray(deltas.slots, np.int64),
+                np.asarray(deltas.added_nt, np.int64),
+                np.asarray(deltas.taken_nt, np.int64),
+                np.asarray(deltas.elapsed_ns, np.int64),
             )
-            if deltas is not None
-            else []
+            if deltas is not None and len(deltas)
+            else None
         )
 
-        req, mb = topo.route_requests(plan, takes, delta_tuples, k_take, k_merge)
+        req, mb = topo.route_requests(plan, takes, delta_arrays, k_take, k_merge)
         with self._state_mu:
             self.state, res = self._step(self.state, mb, req)
         self._ticks += 1
