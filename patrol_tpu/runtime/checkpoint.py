@@ -105,9 +105,10 @@ def restore(directory: str, engine) -> int:
     with d._mu:
         for name, row in meta["rows"].items():
             row = int(row)
-            d._rows[name] = row
-            d._names[row] = name
-            d.created_ns[row] = meta["created_ns"][str(row)]
-            d.cap_base_nt[row] = meta["cap_base_nt"][str(row)]
+            # Full bind (not just the dict): sets _bound (eviction
+            # eligibility), name bytes + hash, and the resolve-table entry
+            # so restored buckets are hash-resolvable by the wire rx path.
+            d._bind_locked(name, row, int(meta["created_ns"][str(row)]))
+            d.cap_base_nt[row] = int(meta["cap_base_nt"][str(row)])
             d._next_fresh = max(d._next_fresh, row + 1)
     return len(meta["rows"])

@@ -217,6 +217,31 @@ class TestAssignManyWireAtomicity:
             eng.stop()
 
 
+class TestCheckpointRestoreBindings:
+    def test_restored_buckets_are_hash_resolvable_and_evictable(self, tmp_path):
+        """Checkpoint restore must FULLY bind names — resolve-table entry,
+        name bytes, bound flag — or restored buckets would never resolve
+        on the wire fast path and never qualify for eviction."""
+        from patrol_tpu.runtime import checkpoint as ckpt
+
+        eng = DeviceEngine(CFG, node_slot=0, clock=lambda: 0)
+        eng.take("ckpt-bucket", RATE, 3)
+        ckpt.save(str(tmp_path), eng)
+        eng.stop()
+
+        eng2 = DeviceEngine(CFG, node_slot=0, clock=lambda: 0)
+        try:
+            assert ckpt.restore(str(tmp_path), eng2) == 1
+            buf, lens, hashes = _buf(["ckpt-bucket"])
+            rows = eng2.directory.lookup_hashed_pinned(hashes, buf, lens, 5)
+            assert rows[0] == eng2.directory.lookup("ckpt-bucket")
+            eng2.directory.unpin_rows(rows)
+            victims = eng2.directory.pick_victims(64)
+            assert rows[0] in victims  # bound ⇒ evictable
+        finally:
+            eng2.stop()
+
+
 class TestRawIngestEquivalence:
     @pytest.fixture
     def engine(self):
