@@ -158,6 +158,23 @@ class API:
             import sys
 
             return 200, "\x00".join(sys.argv).encode(), "text/plain"
+        if path == "/debug/pprof/symbol":
+            # go tool pprof symbolization probe (api.go:29-39 route set).
+            # Python profiles carry symbol names inline (utils/pprof.py
+            # string table), so there is nothing to resolve — answer the
+            # probe in the expected format.
+            return 200, b"num_symbols: 1\n", "text/plain"
+        if path == "/debug/pprof/trace":
+            # Go returns a runtime execution trace; the device-side
+            # equivalent here is the JAX XPlane trace.
+            seconds = float(q.get("seconds", ["1"])[0])
+            out = await loop.run_in_executor(None, profiling.jax_trace, seconds)
+            return (
+                200,
+                f"execution trace is device-side here: XPlane written to {out}\n"
+                "(open in xprof/tensorboard; see /debug/jax/trace)\n".encode(),
+                "text/plain",
+            )
         return 404, b"not found\n", "text/plain"
 
     def _metrics(self) -> bytes:

@@ -94,6 +94,51 @@ class TestCodecCrossValidation:
         assert not valid[0]
         assert not valid[1]
 
+    def test_garbage_packet_differential_fuzz(self):
+        """Arbitrary byte packets must decode IDENTICALLY in C++ and
+        Python — any divergence (validity, fields, trailer handling)
+        would let one backend accept state the other rejects, forking
+        replicas. 2000 random packets incl. truncations and
+        trailer-magic-bearing tails."""
+        rng = np.random.default_rng(99)
+        n = 2000
+        pkts = np.zeros((n, native.PACKET), np.uint8)
+        sizes = np.zeros(n, np.int32)
+        for i in range(n):
+            sz = int(rng.integers(0, native.PACKET + 1))
+            body = rng.integers(0, 256, sz, dtype=np.uint8)
+            if sz > 30 and i % 3 == 0:
+                # Plant a plausible-ish header + trailer magic to reach
+                # the deep trailer-validation branches.
+                body[24] = int(rng.integers(0, sz - 25 + 1))
+                tpos = 25 + int(body[24])  # python int: no uint8 wraparound
+                if tpos + 6 <= sz:
+                    body[tpos : tpos + 2] = (ord("P"), ord("2"))
+                    body[tpos + 2] = int(rng.integers(0, 4))
+            pkts[i, :sz] = body
+            sizes[i] = sz
+        added, taken, elapsed, names, slots, valid, caps, la, lt = (
+            native.decode_batch(pkts, sizes)
+        )
+        for i in range(n):
+            data = bytes(pkts[i, : sizes[i]])
+            try:
+                ref = wire.decode(data)
+            except ValueError:
+                assert not valid[i], f"pkt {i}: py rejects, c++ accepts"
+                continue
+            assert valid[i], f"pkt {i}: py accepts, c++ rejects"
+            assert names[i] == ref.name
+            same = added[i] == ref.added or (added[i] != added[i] and ref.added != ref.added)
+            assert same, f"pkt {i} added"
+            want_slot = ref.origin_slot if ref.origin_slot is not None else -1
+            assert int(slots[i]) == want_slot, f"pkt {i} slot"
+            want_cap = ref.cap_nt if ref.cap_nt is not None else -1
+            assert int(caps[i]) == want_cap, f"pkt {i} cap"
+            want_la = ref.lane_added_nt if ref.lane_added_nt is not None else -1
+            want_lt = ref.lane_taken_nt if ref.lane_taken_nt is not None else -1
+            assert int(la[i]) == want_la and int(lt[i]) == want_lt, f"pkt {i} lane"
+
     def test_roundtrip_random(self):
         rng = np.random.default_rng(5)
         n = 200
