@@ -291,6 +291,24 @@ class DeviceEngine:
         self._ticks = 0  # device calls issued (observability)
         self._evictions = 0  # rows recycled under pool pressure
         self._scalar_dropped = 0  # v1 deltas dropped for unknown capacity
+        # Completion pipeline: the feeder DISPATCHES device ticks and hands
+        # (thunk, tickets) to this queue; the completer thread blocks on
+        # the device result (np.asarray) and fans results out to tickets.
+        # Host-side completion work (result read, per-ticket fanout, wire
+        # encode for broadcasts) therefore overlaps the NEXT tick's device
+        # compute instead of serializing with it — on TPU the device step
+        # is ~28 µs while completion is comparable-or-larger Python time,
+        # so the overlap roughly doubles sustained tick rate. Bounded so a
+        # slow completer back-pressures the feeder instead of buffering
+        # unboundedly.
+        self._pcond = threading.Condition()
+        self._pending: deque = deque()
+        self._completing = False
+        self._feeder_done = False
+        self._completer = threading.Thread(
+            target=self._complete_loop, name="patrol-engine-complete", daemon=True
+        )
+        self._completer.start()
         self._thread = threading.Thread(target=self._run, name="patrol-engine", daemon=True)
         self._thread.start()
 
@@ -869,12 +887,16 @@ class DeviceEngine:
 
     def flush(self, timeout: float = 5.0) -> bool:
         """Block until all currently queued work has been applied to device
-        state. Test/introspection helper, not a hot-path call."""
+        state AND every completion has fanned out. Test/introspection
+        helper, not a hot-path call."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._cond:
-                if not self._takes and not self._deltas and not self._busy:
-                    return True
+                idle = not self._takes and not self._deltas and not self._busy
+            if idle:
+                with self._pcond:
+                    if not self._pending and not self._completing:
+                        return True
             time.sleep(0.0005)
         return False
 
@@ -882,8 +904,61 @@ class DeviceEngine:
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
+        with self._pcond:
+            # Wake a feeder parked in _enqueue_completion back-pressure NOW
+            # (not after its 5s join) so the graceful drain can finish.
+            self._pcond.notify_all()
         self._thread.join(timeout=5)
+        with self._pcond:
+            # The feeder is done dispatching: nothing further can be
+            # enqueued, so the completer may exit once pending drains.
+            self._feeder_done = True
+            self._pcond.notify_all()
+        self._completer.join(timeout=5)
         self.directory.close()  # releases the native resolve table
+
+    # -- completion pipeline ------------------------------------------------
+
+    def _enqueue_completion(self, thunk, keys, groups) -> None:
+        """Hand a tick's completion to the completer thread. Only the
+        grouped (non-deferred) tickets belong to the tick — deferred ones
+        are already re-queued and must never be failed here — so the
+        flatten lives in this one place. Bounded: a slow completer
+        back-pressures dispatch rather than buffering device results
+        without limit."""
+        tickets = [t for key in keys for t in groups[key]]
+        with self._pcond:
+            while len(self._pending) >= 64 and not self._stopped:
+                self._pcond.wait()
+            self._pending.append((thunk, tickets))
+            self._pcond.notify_all()
+
+    def _complete_loop(self) -> None:
+        while True:
+            with self._pcond:
+                # Exit only when the FEEDER is done dispatching AND every
+                # pending completion ran: the feeder's graceful drain keeps
+                # producing ticks after _stopped is set, and abandoning one
+                # would hang its callers with their row pins leaked.
+                while not self._pending and not self._feeder_done:
+                    self._pcond.wait()
+                if not self._pending:
+                    return  # feeder exited and the queue is drained
+                thunk, tickets = self._pending.popleft()
+                self._completing = True
+                self._pcond.notify_all()  # wake a back-pressured feeder
+            try:
+                thunk()
+            except Exception:  # pragma: no cover - completer must not die
+                log.exception("tick completion failed")
+                try:
+                    self._fail_tickets(tickets)
+                except Exception:
+                    log.exception("ticket failure fan-out failed")
+            finally:
+                with self._pcond:
+                    self._completing = False
+                    self._pcond.notify_all()
 
     @property
     def ticks(self) -> int:
@@ -1182,8 +1257,11 @@ class DeviceEngine:
             )
         self._ticks += 1
 
-        out = np.asarray(out)  # one D2H transfer; blocks until device done
-        have, admitted, own_a, own_t, elapsed, sum_a, sum_t = out
-        self._complete_groups(
-            keys, groups, have, admitted, own_a, own_t, elapsed, sum_a, sum_t
-        )
+        def complete() -> None:
+            res = np.asarray(out)  # one D2H transfer; blocks until device done
+            have, admitted, own_a, own_t, elapsed, sum_a, sum_t = res
+            self._complete_groups(
+                keys, groups, have, admitted, own_a, own_t, elapsed, sum_a, sum_t
+            )
+
+        self._enqueue_completion(complete, keys, groups)

@@ -144,26 +144,29 @@ class MeshEngine(DeviceEngine):
             jax.block_until_ready(self.state.pn)
             return
 
-        have_all = np.asarray(res.have_nt)
-        adm_all = np.asarray(res.admitted)
-        own_a_all = np.asarray(res.own_added_nt)
-        own_t_all = np.asarray(res.own_taken_nt)
-        el_all = np.asarray(res.elapsed_ns)
-        sum_a_all = np.asarray(res.sum_added_nt)
-        sum_t_all = np.asarray(res.sum_taken_nt)
+        def complete() -> None:
+            have_all = np.asarray(res.have_nt)
+            adm_all = np.asarray(res.admitted)
+            own_a_all = np.asarray(res.own_added_nt)
+            own_t_all = np.asarray(res.own_taken_nt)
+            el_all = np.asarray(res.elapsed_ns)
+            sum_a_all = np.asarray(res.sum_added_nt)
+            sum_t_all = np.asarray(res.sum_taken_nt)
 
-        at = [blk * k_take + slot for blk, slot in placed]
-        self._complete_groups(
-            keys,
-            groups,
-            have_all[at],
-            adm_all[at],
-            own_a_all[at],
-            own_t_all[at],
-            el_all[at],
-            sum_a_all[at],
-            sum_t_all[at],
-        )
+            at = [blk * k_take + slot for blk, slot in placed]
+            self._complete_groups(
+                keys,
+                groups,
+                have_all[at],
+                adm_all[at],
+                own_a_all[at],
+                own_t_all[at],
+                el_all[at],
+                sum_a_all[at],
+                sum_t_all[at],
+            )
+
+        self._enqueue_completion(complete, keys, groups)
 
     def warmup(self) -> None:
         """Pre-compile the fused step at each padded block size."""

@@ -276,6 +276,24 @@ class TestEviction:
         assert engine.snapshot("w")[0].lane_added_nt == NANO
 
 
+class TestShutdownDrain:
+    def test_stop_completes_multi_tick_backlog(self):
+        """stop()'s graceful drain produces ticks AFTER the stop flag is
+        set (deferred diverse-key tickets need extra ticks); the completer
+        must run every one of them — an abandoned completion would hang
+        its caller forever with the row pin leaked."""
+        eng = DeviceEngine(CFG, node_slot=0, clock=lambda: 0)
+        # Same bucket, many distinct rates: forces one tick per key.
+        tickets = [
+            eng.submit_take("drain", Rate(freq=100 + i, per_ns=NANO), 1)[0]
+            for i in range(12)
+        ]
+        eng.stop()  # drains the backlog, then joins feeder + completer
+        for t in tickets:
+            assert t.wait(10), "caller hung across shutdown drain"
+        assert eng.directory.pins.sum() == 0  # no leaked pins
+
+
 class TestSubmitTakesBatch:
     def test_batch_matches_singles(self, engine):
         """submit_takes_batch must admit/deny identically to per-request
