@@ -107,6 +107,83 @@ def _heal(cluster):
         cmd.replicator.drop_addr = None
 
 
+class TestSoak:
+    def test_sustained_mixed_load_leaves_invariants_clean(self):
+        """Several seconds of concurrent takes (diverse keys and rates),
+        bulk ingest, eviction churn, and introspection reads against one
+        engine — then every bookkeeping invariant must be exactly clean:
+        zero pins, empty queues, no hung tickets. This is the pin-economy
+        soak: any leak on any path (deferral, eviction retry, completion
+        pipeline, unknown-cap drops) shows up here."""
+        import threading
+        import time as _time
+
+        import numpy as np
+
+        from patrol_tpu.models.limiter import LimiterConfig
+        from patrol_tpu.ops.rate import Rate
+        from patrol_tpu.runtime.engine import DeviceEngine
+
+        eng = DeviceEngine(
+            LimiterConfig(buckets=128, nodes=8), node_slot=0
+        )  # small pool ⇒ eviction churn under the keyspace below
+        stop = _time.monotonic() + 4.0
+        errors: list = []
+
+        def taker(k):
+            i = 0
+            try:
+                while _time.monotonic() < stop:
+                    name = f"soak-{(i * 7 + k) % 512}"  # 4× the pool
+                    rate = Rate(freq=5 + (i % 3), per_ns=NANO)
+                    remaining, ok, _ = eng.take(name, rate, 1)
+                    assert remaining >= 0
+                    i += 1
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        def ingester():
+            rng = np.random.default_rng(0)
+            try:
+                while _time.monotonic() < stop:
+                    n = 256
+                    eng.ingest_deltas_batch(
+                        [f"soak-{int(r)}" for r in rng.integers(0, 512, n)],
+                        rng.integers(0, 8, n),
+                        rng.integers(0, 3 * NANO, n),
+                        rng.integers(0, NANO, n),
+                        rng.integers(0, NANO, n),
+                    )
+                    _time.sleep(0.002)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        def introspector():
+            try:
+                while _time.monotonic() < stop:
+                    eng.snapshot("soak-1")
+                    eng.tokens("soak-2")
+                    _time.sleep(0.005)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = (
+            [threading.Thread(target=taker, args=(k,)) for k in range(8)]
+            + [threading.Thread(target=ingester), threading.Thread(target=introspector)]
+        )
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "soak worker hung"
+        assert not errors, errors
+        assert eng.flush(timeout=30), "engine never went idle"
+        assert eng.directory.pins.sum() == 0, "leaked row pins"
+        assert eng.backlog() == 0
+        assert eng.evictions > 0, "keyspace 4x pool must have churned"
+        eng.stop()
+
+
 class TestPartitionHeal:
     def test_split_brain_multiplies_limit_then_heals(self, cluster):
         """Under partition each side independently enforces the limit
