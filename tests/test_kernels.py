@@ -15,6 +15,7 @@ from patrol_tpu.ops.merge import (
     MergeBatch,
     merge_batch,
     merge_dense,
+    merge_scalar_batch,
     read_rows,
 )
 from patrol_tpu.ops.rate import Rate
@@ -282,6 +283,84 @@ class TestMergeKernels:
         assert int(rs.pn[0, 1, ADDED]) == 11
         assert int(rs.elapsed[0]) == 2
         assert int(rs.pn[1].sum()) == 0
+
+
+class TestScalarMergeLaws:
+    """Kernel-level laws of the deficit-attribution merge (the interop
+    echo-cancellation kernel, ops/merge.py:merge_scalar_batch). Behavioral
+    coverage lives in tests/test_interop.py; these pin the algebra."""
+
+    def _state_with(self, cfg, pn_vals):
+        state = init_state(cfg)
+        pn = np.asarray(state.pn).copy()
+        for (row, slot, plane), v in pn_vals.items():
+            pn[row, slot, plane] = v
+        return state._replace(pn=jnp.asarray(pn))
+
+    def _scalar(self, row, slot, added, taken, elapsed=0):
+        return MergeBatch(
+            rows=jnp.array([row], jnp.int32),
+            slots=jnp.array([slot], jnp.int32),
+            added_nt=jnp.array([added], jnp.int64),
+            taken_nt=jnp.array([taken], jnp.int64),
+            elapsed_ns=jnp.array([elapsed], jnp.int64),
+        )
+
+    def test_idempotent(self):
+        cfg = LimiterConfig(buckets=4, nodes=4)
+        state = self._state_with(cfg, {(1, 0, TAKEN): 2 * NANO})
+        b = self._scalar(1, 2, 5 * NANO, 4 * NANO)
+        once = merge_scalar_batch(state, b)
+        twice = merge_scalar_batch(once, b)
+        assert (np.asarray(once.pn) == np.asarray(twice.pn)).all()
+
+    def test_single_peer_exact(self):
+        """With no other-lane state, attribution is the full delta —
+        degenerates to a plain lane max (the reference's own view)."""
+        cfg = LimiterConfig(buckets=4, nodes=4)
+        out = merge_scalar_batch(
+            init_state(cfg), self._scalar(2, 1, 7 * NANO, 3 * NANO)
+        )
+        pn = np.asarray(out.pn)
+        assert pn[2, 1, ADDED] == 7 * NANO
+        assert pn[2, 1, TAKEN] == 3 * NANO
+
+    def test_echo_fully_cancelled(self):
+        """A scalar delta entirely explained by other lanes attributes
+        nothing — the echoed grants are not double-counted."""
+        cfg = LimiterConfig(buckets=4, nodes=4)
+        state = self._state_with(
+            cfg, {(0, 0, ADDED): 4 * NANO, (0, 3, ADDED): 2 * NANO}
+        )
+        out = merge_scalar_batch(state, self._scalar(0, 1, 6 * NANO, 0))
+        assert np.asarray(out.pn)[0, 1, ADDED] == 0
+
+    def test_attribution_bounded_and_monotone(self):
+        """attr ≤ delta always; lanes never decrease; total Σ never
+        exceeds what a sum-free scalar observer could justify."""
+        rng = random.Random(3)
+        cfg = LimiterConfig(buckets=4, nodes=4)
+        for _ in range(50):
+            pn_vals = {
+                (0, s, p): rng.randrange(5 * NANO)
+                for s in range(4)
+                for p in (ADDED, TAKEN)
+                if rng.random() < 0.6
+            }
+            state = self._state_with(cfg, pn_vals)
+            before = np.asarray(state.pn).copy()
+            slot = rng.randrange(4)
+            d_a, d_t = rng.randrange(8 * NANO), rng.randrange(8 * NANO)
+            out = np.asarray(
+                merge_scalar_batch(state, self._scalar(0, slot, d_a, d_t)).pn
+            )
+            assert (out >= before).all()  # monotone join
+            assert out[0, slot, ADDED] <= max(before[0, slot, ADDED], d_a)
+            assert out[0, slot, TAKEN] <= max(before[0, slot, TAKEN], d_t)
+            # Only the target lane may have changed.
+            mask = np.ones_like(before, bool)
+            mask[0, slot] = False
+            assert (out[mask] == before[mask]).all()
 
 
 class TestMonotoneForfeit:
