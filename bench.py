@@ -79,17 +79,27 @@ def _left() -> float:
     return BUDGET_S - (time.time() - START)
 
 
-def _bench(fn, state, *args, iters=10, warmup=2):
+def _bench(fn, state, *args, iters=10, warmup=2, repeats=3):
+    """Best-of-``repeats`` timing windows, each averaging ``iters`` calls.
+    The tunneled chip shows multi-second throttling hiccups (BENCH r2:
+    one run recorded the scatter stage 13× slower than its neighbors);
+    min-of-windows reports the hardware's capability, not the tunnel's
+    worst moment."""
     import jax
 
     for _ in range(warmup):
         state = fn(state, *args)
     jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state = fn(state, *args)
-    jax.block_until_ready(state)
-    return (time.perf_counter() - t0) / iters, state
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state = fn(state, *args)
+        jax.block_until_ready(state)
+        best = min(best, (time.perf_counter() - t0) / iters)
+        if _left() < 30:  # budget guard: keep the first window's number
+            break
+    return best, state
 
 
 def _probe_backend() -> str:
