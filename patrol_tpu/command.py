@@ -137,6 +137,7 @@ class Command:
                 "engine_ticks": engine.ticks,
                 "engine_evictions": engine.evictions,
                 "engine_scalar_dropped": engine.scalar_dropped,
+                "engine_pending_completions": engine.pending_completions,
                 "buckets": len(engine.directory),
                 "node_slot": slots.self_slot,
                 **replicator.stats(),

@@ -974,6 +974,13 @@ class DeviceEngine:
         unknown — re-delivered by the peer's next full-state broadcast."""
         return self._scalar_dropped
 
+    @property
+    def pending_completions(self) -> int:
+        """Dispatched ticks whose results haven't fanned out yet — the
+        completion pipeline's depth (backpressure signal)."""
+        with self._pcond:
+            return len(self._pending) + (1 if self._completing else 0)
+
     def backlog(self) -> int:
         """Queued-but-unapplied work rows (takes + deltas, counting each
         delta inside a bulk chunk): the public backpressure signal for bulk
