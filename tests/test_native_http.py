@@ -143,6 +143,39 @@ class TestConnectionHandling:
             data = s.recv(65536)
         assert data.split(b" ", 2)[1] in (b"400", b"404")
 
+    def test_connection_churn_and_aborts(self, front):
+        """Open/close storms with mid-request aborts: slot recycling and
+        generation tags must never deliver a response to the wrong
+        connection or wedge the server. 120 one-shot connections, a third
+        aborted after a partial request, interleaved with live takes."""
+        import http.client
+
+        for i in range(120):
+            s = socket.create_connection(("127.0.0.1", front.port), timeout=5)
+            if i % 3 == 0:
+                # Abort mid-header: the server must just reap the conn.
+                s.sendall(b"POST /take/churn?rate=5:")
+                s.close()
+                continue
+            s.sendall(
+                b"POST /take/churn-%d?rate=5:1h HTTP/1.1\r\nHost: x\r\n\r\n"
+                % (i % 7)
+            )
+            data = b""
+            while b"\r\n\r\n" not in data:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+            assert data.split(b" ", 2)[1] in (b"200", b"429"), data[:60]
+            s.close()
+        # Server is still healthy and answers exactly on a fresh conn.
+        c = http.client.HTTPConnection("127.0.0.1", front.port, timeout=5)
+        c.request("POST", "/take/churn-final?rate=2:1h")
+        r = c.getresponse()
+        assert r.status == 200 and r.read() == b"1"
+        c.close()
+
     def test_blast_client_end_to_end(self, front):
         """The benchmark's C++ load client against the real front."""
         lib = native.load()
