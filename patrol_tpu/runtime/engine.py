@@ -7,14 +7,17 @@ The TPU-native inversion: *batching replaces locking*. All mutation of
 limiter state happens on one engine thread that drains two queues — take
 tickets and replication deltas — into padded, fixed-shape kernel calls:
 
-    submit_take()/ingest_delta()  →  queues  →  engine tick:
-        merge_batch(deltas)   one scatter-max call
-        take_batch(groups)    one fused take call
-        complete tickets, emit broadcast states
+    submit_take()/ingest_delta()  →  queues  →  engine tick (feeder):
+        merge_batch(deltas)   one scatter-max call (async dispatch)
+        take_batch(groups)    one fused take call (async dispatch)
+    completion pipeline (completer thread):
+        read results, complete tickets, emit broadcast states
 
-Natural batching: the engine dispatches immediately when work exists;
+Natural batching: the feeder dispatches immediately when work exists;
 requests that arrive during a device call form the next batch, so batch size
-adapts to load and idle latency stays at one device round-trip.
+adapts to load and idle latency stays at one device round-trip. Completion
+(the host-side fanout) runs on its own thread and overlaps the next tick's
+device compute — see _enqueue_completion.
 
 Hot buckets are coalesced algebraically (see ops/take.py): identical
 (bucket, rate, count) tickets become one kernel row with ``nreq``; a bucket
