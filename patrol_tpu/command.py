@@ -14,7 +14,7 @@ import contextlib
 import dataclasses
 import logging
 import signal
-from typing import Callable, List, Optional
+from typing import List, Optional
 
 from patrol_tpu.models.limiter import LimiterConfig, SMALL
 from patrol_tpu.net.api import API, serve

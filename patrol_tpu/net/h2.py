@@ -23,7 +23,7 @@ import ctypes
 import ctypes.util
 import struct
 import threading
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Tuple
 
 # -- frame constants (RFC 7540 §6) ------------------------------------------
 

@@ -24,7 +24,6 @@ import asyncio
 import ctypes
 import logging
 import threading
-from typing import Optional
 
 import numpy as np
 

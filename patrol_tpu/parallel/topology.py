@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from patrol_tpu.models.limiter import LimiterConfig, LimiterState, init_state
+from patrol_tpu.models.limiter import LimiterConfig, LimiterState
 from patrol_tpu.ops.merge import MergeBatch, merge_batch
 from patrol_tpu.ops.take import TakeRequest, TakeResult, take_batch
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time as _time
-from typing import Callable, Dict, Iterable, Optional, Tuple
+from typing import Callable, Dict, Iterable, Tuple
 
 from patrol_tpu.ops.rate import Rate, format_duration
 

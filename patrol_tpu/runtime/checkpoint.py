@@ -19,7 +19,6 @@ from __future__ import annotations
 import json
 import os
 import tempfile
-from typing import Optional, Tuple
 
 import numpy as np
 

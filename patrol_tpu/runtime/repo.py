@@ -18,9 +18,7 @@ import time
 from typing import Callable, List, Optional, Tuple
 
 
-import numpy as np
 
-from patrol_tpu.models.limiter import NANO
 from patrol_tpu.ops.rate import Rate
 from patrol_tpu.ops import wire
 from patrol_tpu.runtime.bucket import Bucket
