@@ -237,6 +237,7 @@ struct Conn {
   bool close_after = false;
   bool want_close = false;  // fully close once wbuf drains
   size_t body_skip = 0;     // request body bytes still to drain
+  std::chrono::steady_clock::time_point req_start{};  // latency stamp
 };
 
 struct Server {
@@ -257,6 +258,11 @@ struct Server {
 
   // stats
   uint64_t accepted = 0, requests = 0, dropped = 0;
+  // Server-side request latency (parse → response queued): a fixed-size
+  // sample ring; percentiles computed on read. ~32 KB, overwrites oldest.
+  static constexpr int kLatRing = 4096;
+  uint64_t lat_ns[kLatRing] = {0};
+  uint64_t lat_count = 0;
 };
 
 Server* g_servers[8] = {nullptr};
@@ -293,6 +299,13 @@ const char* status_text(int code) {
 // Append a full response to the conn's write buffer (mu held).
 void queue_response(Server* s, Conn* c, int code, const char* ctype,
                     const char* body, size_t body_len) {
+  if (c->req_start.time_since_epoch().count() != 0) {
+    uint64_t ns = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                      std::chrono::steady_clock::now() - c->req_start)
+                      .count();
+    s->lat_ns[s->lat_count++ % Server::kLatRing] = ns;
+    c->req_start = {};
+  }
   char head[256];
   int hl = snprintf(head, sizeof(head),
                     "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
@@ -406,6 +419,7 @@ bool try_parse_one(Server* s, int slot) {
   }
   c.close_after = conn_close;
   s->requests++;
+  c.req_start = std::chrono::steady_clock::now();
 
   std::string path = target, query;
   size_t qm = target.find('?');
@@ -757,17 +771,30 @@ int pt_http_complete_other(int h, uint64_t tag, int status, const char* ctype,
   return 0;
 }
 
-int pt_http_stats(int h, uint64_t* out4) {
+// out8 = {accepted, requests, active_conns, dropped, lat_p50_ns,
+// lat_p99_ns, lat_max_ns, lat_samples} — latency is server-side
+// (request parsed → response queued) over a 4096-sample ring.
+int pt_http_stats(int h, uint64_t* out8) {
   std::lock_guard<std::mutex> reg(g_reg_mu);
   Server* s = g_servers[h];
   if (!s) return -EBADF;
   std::lock_guard<std::mutex> lk(s->mu);
-  out4[0] = s->accepted;
-  out4[1] = s->requests;
-  out4[2] = 0;
+  out8[0] = s->accepted;
+  out8[1] = s->requests;
+  out8[2] = 0;
   for (const auto& c : s->conns)
-    if (c.fd >= 0) out4[2]++;
-  out4[3] = s->dropped;
+    if (c.fd >= 0) out8[2]++;
+  out8[3] = s->dropped;
+  uint64_t n = s->lat_count < Server::kLatRing ? s->lat_count : Server::kLatRing;
+  out8[4] = out8[5] = out8[6] = 0;
+  out8[7] = n;
+  if (n > 0) {
+    std::vector<uint64_t> lat(s->lat_ns, s->lat_ns + n);
+    std::sort(lat.begin(), lat.end());
+    out8[4] = lat[n / 2];
+    out8[5] = lat[(size_t)(n * 0.99) < n ? (size_t)(n * 0.99) : n - 1];
+    out8[6] = lat[n - 1];
+  }
   return 0;
 }
 

@@ -188,13 +188,17 @@ class NativeHTTPFront:
     # -- lifecycle / observability -------------------------------------------
 
     def stats(self) -> dict:
-        out = np.zeros(4, np.uint64)
+        out = np.zeros(8, np.uint64)
         self.lib.pt_http_stats(self.h, out)
         return {
             "http_accepted": int(out[0]),
             "http_requests": int(out[1]),
             "http_active_conns": int(out[2]),
             "http_dropped": int(out[3]),
+            # Server-side (parse → response queued), 4096-sample ring.
+            "http_latency_p50_us": int(out[4]) // 1000,
+            "http_latency_p99_us": int(out[5]) // 1000,
+            "http_latency_max_us": int(out[6]) // 1000,
         }
 
     def close(self) -> None:
