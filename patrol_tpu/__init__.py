@@ -12,7 +12,7 @@ system:
 * The reference's lossy scalar max-merge (bucket.go:240-263) becomes a true
   PN-counter: one (added, taken) slot per node, elementwise max on merge,
   bucket value = capacity + Σadded − Σtaken.
-* Replication within a TPU slice rides ICI (`lax.pmax` across a mesh axis);
+* Replication within a TPU slice rides ICI (a max all-reduce across a mesh axis);
   replication between hosts keeps the reference's 25-byte-header / 256-byte
   UDP wire format (bucket.go:34-91) for interop.
 * A host runtime microbatches HTTP takes and incoming UDP deltas into single

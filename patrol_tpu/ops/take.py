@@ -123,7 +123,7 @@ def take_batch(
     # Over-capacity forfeit, monotone form: the reference commits a NEGATIVE
     # grant when merges pushed tokens above capacity (bucket.go:211-213),
     # which would make the added-lane non-monotone — and any max-based join
-    # (UDP merge or pmax convergence) would resurrect the forfeited tokens
+    # (UDP merge or mesh max-convergence) would resurrect forfeited tokens
     # (the reference's own protocol has exactly that quirk). Booking the
     # forfeit as extra TAKEN keeps both lanes monotone G-counters with the
     # same observable balance: a − t is unchanged.

@@ -10,18 +10,18 @@ and node count. They map onto a 2-D ``jax.sharding.Mesh``:
   routing, no cross-device traffic on the hot path).
 * axis ``"r"`` — **replication**: full state replicas that each ingest a
   partition of the incoming take/merge stream and converge with one
-  ``lax.pmax`` per step. This is Patrol's UDP broadcast re-expressed as an
+  max all-reduce per step. This is Patrol's UDP broadcast re-expressed as an
   ICI collective — the 256-byte-datagram protocol (repo.go:123-158) becomes
   an elementwise int64 max across the mesh, five orders of magnitude more
   bandwidth.
 
-Correctness of pmax-convergence relies on two invariants:
+Correctness of max-convergence relies on two invariants:
 
 1. All CRDT planes are monotone (PN lanes and the elapsed G-counter only
    grow), so elementwise max is a join and convergence is exact.
 2. Each bucket row has one *home replica* (``row % R``) that applies its
-   takes; other replicas receive the result via pmax. Two replicas
-   incrementing the same lane concurrently would race exactly like the
+   takes; other replicas receive the result via the max all-reduce. Two
+   replicas incrementing the same lane concurrently would race like the
    reference's lossy scalar merge (SURVEY §2, known bug) — home routing
    makes the write single-writer per lane while reads/merges stay
    everywhere.

@@ -5,7 +5,7 @@ Same public surface and host protocol behavior as
 over a ``(replicas × shards)`` ``jax.sharding.Mesh``
 (:mod:`patrol_tpu.parallel.topology`): bucket rows partition across the
 ``"b"`` axis, full replicas along ``"r"`` ingest disjoint slices of each
-tick's work and converge with a ``lax.pmax`` — the intra-slice analogue of
+tick's work and converge with a max all-reduce — the intra-slice analogue of
 the reference's UDP broadcast (repo.go:123-158), riding ICI.
 
 Each tick fuses merge + take + converge into ONE shard_map'd device call;
