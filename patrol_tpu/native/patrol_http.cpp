@@ -360,14 +360,20 @@ bool try_parse_one(Server* s, int slot) {
   }
   size_t hdr_end = c.rbuf.find("\r\n\r\n");
   if (hdr_end == std::string::npos) {
-    if (c.rbuf.size() > kRbufMax) {
-      c.close_after = true;
-      queue_response(s, &c, 431, "text/plain", "header too large\n", 17);
-    }
     // h2c preface detection: reject cleanly (use the python front for h2).
-    if (c.rbuf.compare(0, 3, "PRI") == 0 && c.rbuf.size() >= 3) {
+    // Only the full 16-byte connection-preface request line ("PRI * ...")
+    // triggers it — a request whose method merely starts with "PRI"
+    // (e.g. "PRINT") must keep accumulating; and the 431 branch below is
+    // exclusive so an oversized PRI-prefixed buffer queues ONE response.
+    static const char kPreface[] = "PRI * HTTP/2.0\r\n";
+    constexpr size_t kPrefaceLen = sizeof(kPreface) - 1;
+    if (c.rbuf.size() >= kPrefaceLen &&
+        c.rbuf.compare(0, kPrefaceLen, kPreface) == 0) {
       c.close_after = true;
       queue_response(s, &c, 400, "text/plain", "h2c not supported here\n", 23);
+    } else if (c.rbuf.size() > kRbufMax) {
+      c.close_after = true;
+      queue_response(s, &c, 431, "text/plain", "header too large\n", 17);
     }
     return false;
   }
@@ -387,6 +393,15 @@ bool try_parse_one(Server* s, int slot) {
   }
   std::string method = reqline.substr(0, sp1);
   std::string target = reqline.substr(sp1 + 1, sp2 - sp1 - 1);
+  if (method == "PRI") {
+    // A complete h2 preface ("PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n") contains
+    // \r\n\r\n, so it reaches the normal parse path rather than the
+    // incomplete-header preface check above.
+    c.close_after = true;
+    queue_response(s, &c, 400, "text/plain", "h2c not supported here\n", 23);
+    c.rbuf.erase(0, consumed);
+    return true;
+  }
 
   // Headers we care about: Content-Length, Connection.
   size_t content_len = 0;

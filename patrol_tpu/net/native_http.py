@@ -205,7 +205,17 @@ class NativeHTTPFront:
         self._stopped.set()
         self._pump_thread.join(timeout=5)
         self._completer_thread.join(timeout=5)
-        self.lib.pt_http_stop(self.h)
+        if self._pump_thread.is_alive() or self._completer_thread.is_alive():
+            # pt_http_poll/complete_takes deliberately skip the registry
+            # lock (they assume the pumps are joined first); destroying the
+            # Server under a live pump would be a use-after-free. Leak the
+            # native server instead — the process is shutting down anyway.
+            log.error(
+                "http pump threads did not exit in 5s; leaking native server "
+                "handle %d to avoid a use-after-free", self.h,
+            )
+        else:
+            self.lib.pt_http_stop(self.h)
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._loop_thread.join(timeout=5)
 

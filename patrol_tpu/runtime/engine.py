@@ -911,12 +911,10 @@ class DeviceEngine:
             # Wake a feeder parked in _enqueue_completion back-pressure NOW
             # (not after its 5s join) so the graceful drain can finish.
             self._pcond.notify_all()
+        # _feeder_done is set by the feeder's own exit path (_run), never
+        # here: a timed-out join must not let the completer quit while the
+        # drain is still producing ticks (stranded tickets, leaked pins).
         self._thread.join(timeout=5)
-        with self._pcond:
-            # The feeder is done dispatching: nothing further can be
-            # enqueued, so the completer may exit once pending drains.
-            self._feeder_done = True
-            self._pcond.notify_all()
         self._completer.join(timeout=5)
         self.directory.close()  # releases the native resolve table
 
@@ -996,6 +994,18 @@ class DeviceEngine:
     # -- engine loop --------------------------------------------------------
 
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        finally:
+            with self._pcond:
+                # The feeder itself declares dispatch over — stop() cannot,
+                # because its 5s join may time out while the drain is still
+                # producing ticks, and a flag set too early (or never) either
+                # strands enqueued completions or parks the completer forever.
+                self._feeder_done = True
+                self._pcond.notify_all()
+
+    def _run_loop(self) -> None:
         while True:
             with self._cond:
                 while not (self._takes or self._deltas or self._stopped):
