@@ -79,27 +79,110 @@ def _left() -> float:
     return BUDGET_S - (time.time() - START)
 
 
-def _bench(fn, state, *args, iters=10, warmup=2, repeats=3):
-    """Best-of-``repeats`` timing windows, each averaging ``iters`` calls.
-    The tunneled chip shows multi-second throttling hiccups (BENCH r2:
-    one run recorded the scatter stage 13× slower than its neighbors);
-    min-of-windows reports the hardware's capability, not the tunnel's
-    worst moment."""
-    import jax
+_PROBE_CACHE = {}
 
+
+def _force(tree) -> int:
+    """TRUE completion barrier: device_get of a full-state checksum
+    reduction. The checksum's bytes cannot exist before every element of
+    the final state does, so a transport that acks ``block_until_ready``
+    lazily (the axon tunnel — BENCH r2's 0.04 ms "dense sweep" implied
+    ~300 TB/s of HBM traffic on a ~0.8 TB/s chip, VERDICT r2 item 1)
+    cannot fake it. Returns the checksum (int64, wrapping)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = tuple(jax.tree_util.tree_leaves(tree))
+    key = tuple((l.shape, str(l.dtype)) for l in leaves)
+    probe = _PROBE_CACHE.get(key)
+    if probe is None:
+
+        def _sum(ls):
+            tot = jnp.zeros((), jnp.int64)
+            for l in ls:
+                tot = tot + jnp.sum(l).astype(jnp.int64)
+            return tot
+
+        probe = jax.jit(_sum)
+        _PROBE_CACHE[key] = probe
+    return int(jax.device_get(probe(leaves)))
+
+
+def _bench(fn, state, *args, iters=10, warmup=2, repeats=3, iters_hi=None):
+    """Differential forced-completion timing. Each window runs n kernel
+    steps then forces completion via :func:`_force`; per repeat a SHORT
+    window (``iters``) and a LONG window (``iters_hi``, default 11×) are
+    both timed and the per-step time is (T_hi − T_lo)/(n_hi − n_lo) —
+    constant per-window costs (the checksum reduction, the tunnel round
+    trip) cancel exactly, so the probe does not inflate per-step numbers.
+    Best-of-``repeats``: the tunneled chip shows multi-second throttling
+    hiccups (BENCH r2: one run recorded the scatter stage 13× slower than
+    its neighbors); min-of-windows reports the hardware's capability, not
+    the tunnel's worst moment — and every window is now a forced-complete
+    measurement, so the minimum is still a real one."""
+    n_lo = iters
+    n_hi = iters_hi if iters_hi is not None else iters * 11
     for _ in range(warmup):
         state = fn(state, *args)
-    jax.block_until_ready(state)
-    best = float("inf")
+    _force(state)
+    # min() each window size over repeats SEPARATELY, then difference the
+    # minima: min over per-repeat differences would jointly pick the
+    # fastest hi against the slowest lo (biased low — and a tunnel hiccup
+    # landing in one short window could even make a difference negative
+    # and lock in an absurd per-step time).
+    best_lo = best_hi = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        for _ in range(iters):
+        for _ in range(n_lo):
             state = fn(state, *args)
-        jax.block_until_ready(state)
-        best = min(best, (time.perf_counter() - t0) / iters)
+        _force(state)
+        best_lo = min(best_lo, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for _ in range(n_hi):
+            state = fn(state, *args)
+        _force(state)
+        best_hi = min(best_hi, time.perf_counter() - t0)
         if _left() < 30:  # budget guard: keep the first window's number
             break
-    return best, state
+    return max(best_hi - best_lo, 1e-9) / (n_hi - n_lo), state
+
+
+# Datasheet HBM-bandwidth classes per TPU generation (public numbers,
+# GB/s): the roofline denominator for the cross-checks below.
+_HBM_PEAKS = (
+    ("v5 lite", 819.0),  # v5e
+    ("v5e", 819.0),
+    ("v5p", 2765.0),
+    ("v6", 1640.0),  # Trillium v6e
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def _hbm_peak_gbps() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for pat, gbps in _HBM_PEAKS:
+        if pat in kind:
+            return gbps
+    return 0.0  # unknown device (CPU runs): no roofline to enforce
+
+
+def _roofline(out, stage: str, bytes_touched: int, dt: float) -> None:
+    """Emit the implied HBM rate for a stage and flag physical violations
+    (VERDICT r2 item 1): a stage whose implied bytes/s exceeds the chip's
+    datasheet bandwidth is an artifact, not a measurement."""
+    implied = bytes_touched / dt / 1e9
+    out[f"{stage}_implied_hbm_gbps"] = round(implied, 1)
+    peak = out.get("hbm_peak_gbps_est", 0.0)
+    if peak and implied > 1.15 * peak:
+        out.setdefault("roofline_violations", []).append(stage)
+        _log(
+            f"ROOFLINE VIOLATION: {stage} implies {implied:.0f} GB/s "
+            f"on a {peak:.0f} GB/s chip — measurement is not credible"
+        )
 
 
 def _probe_backend() -> str:
@@ -216,6 +299,8 @@ def _run_stages(out) -> None:
     N = int(os.environ.get("PATROL_BENCH_NODES", 256 if on_accel else 32))
     out["buckets"] = B
     out["node_lanes"] = N
+    out["forced_completion"] = True  # every window ends in a dependent readback
+    out["hbm_peak_gbps_est"] = _hbm_peak_gbps()
     target = 50e6  # BASELINE.json: ≥50M bucket-merges/sec on v5e-4
 
     # Deterministic non-trivial state, built from cheap iota patterns (one
@@ -245,10 +330,14 @@ def _run_stages(out) -> None:
         return
     dense = jax.jit(merge_dense, donate_argnums=0)
     _log("dense sweep (compile #2)…")
-    dt_dense, state = _bench(dense, state, other, iters=10)
+    # One sweep reads both pn planes and writes one (3 × B·N·2·8 bytes)
+    # plus the three elapsed passes: the bandwidth-bound stage whose r2
+    # number violated the roofline ~380× and triggered this rework.
+    dt_dense, state = _bench(dense, state, other, iters=2, iters_hi=12)
     out["value"] = round(B / dt_dense)
     out["vs_baseline"] = round(B / dt_dense / target, 3)
     out["dense_sweep_ms"] = round(dt_dense * 1e3, 3)
+    _roofline(out, "dense", 3 * (B * N * 2 * 8 + B * 8), dt_dense)
     _stage_done("dense")
     _log(f"dense: {out['value']:.3g} merges/s ({out['dense_sweep_ms']} ms/sweep)")
 
@@ -259,9 +348,12 @@ def _run_stages(out) -> None:
     deltas = _mk_merge_batch(K, B, N)
     scatter = jax.jit(merge_batch, donate_argnums=0)
     _log("scatter merge (compile #3)…")
-    dt_scatter, state = _bench(scatter, state, deltas, iters=10)
+    dt_scatter, state = _bench(scatter, state, deltas, iters=10, iters_hi=110)
     out["scatter_merges_per_s"] = round(K / dt_scatter)
     out["scatter_batch"] = K
+    # Per delta: 5 int64 inputs + read/write of 2 pn lanes + 3 elapsed
+    # touches ≈ 128 B (in-place scatter on the donated buffer).
+    _roofline(out, "scatter", K * 128, dt_scatter)
     _stage_done("scatter")
     _log(f"scatter: {out['scatter_merges_per_s']:.3g} merges/s")
 
@@ -282,8 +374,9 @@ def _run_stages(out) -> None:
         elapsed_ns=(idx * 9973) % (100 * NANO),
     )
     _log("hot-key merge (cached compile)…")
-    dt_hot, state = _bench(scatter, state, hot, iters=10)
+    dt_hot, state = _bench(scatter, state, hot, iters=10, iters_hi=110)
     out["hotkey_merges_per_s"] = round(K / dt_hot)
+    _roofline(out, "hotkey", K * 128, dt_hot)
     _stage_done("hotkey")
     _log(f"hotkey: {out['hotkey_merges_per_s']:.3g} merges/s")
 
@@ -304,9 +397,12 @@ def _run_stages(out) -> None:
     )
     take = jax.jit(lambda s, r: take_batch(s, r, 0)[0], donate_argnums=0)
     _log("fused take (compile #4)…")
-    dt_take, state = _bench(take, state, reqs, iters=10)
+    dt_take, state = _bench(take, state, reqs, iters=10, iters_hi=110)
     out["take_requests_per_s"] = round(KT * 4 / dt_take)  # nreq=4 per row
     out["take_step_us"] = round(dt_take * 1e6, 1)
+    # Dominant traffic: the [K, N, 2] row gather (+ own-lane scatter-back
+    # and the 8 int64 request arrays).
+    _roofline(out, "take", KT * (N * 2 * 8 + 96), dt_take)
     _stage_done("take")
     _log(f"take: {out['take_requests_per_s']:.3g} req/s ({out['take_step_us']} µs/step)")
 
@@ -317,78 +413,98 @@ def _run_stages(out) -> None:
         return
     _stage_ingest_replay(out, B, N, on_accel)
 
-    # -- flagship-scale MeshEngine smoke (VERDICT r2 item 7) ----------------
-    if _budget_out("mesh flagship"):
+    # -- flagship-scale fused mesh step (VERDICT r2 item 4) -----------------
+    if _budget_out("mesh step"):
         return
-    _stage_mesh_flagship(out, B, N)
+    _stage_mesh_step(out, B, N)
 
 
-def _stage_mesh_flagship(out, B, N) -> None:
-    """The flagship config on the MeshEngine: allocate the full sharded
-    state over the local device mesh, run mixed take+merge ticks through
-    the fused shard_map cluster step, and record step time + HBM headroom.
-    Proves the multi-chip code path compiles AND steps natively on the
-    real accelerator (the driver's dryrun_multichip only proves it on
-    virtual CPU devices)."""
+def _stage_mesh_step(out, B, N) -> None:
+    """Amortized kernel-loop timing of the fused cluster step
+    (topology.build_cluster_step: merge + take + converge in ONE
+    shard_map'd call) at flagship state size on the local device mesh —
+    pre-built batches, differential forced-completion windows, exactly
+    like the single-device stages. This replaces r2's closed-loop
+    MeshEngine round trip, which measured the ~60 ms/execute axon tunnel,
+    not the step (VERDICT r2 weak #3). The host-protocol half of the mesh
+    path is covered by dryrun_multichip and tests/test_mesh_engine.py."""
     import gc
 
     import jax
     import numpy as np
 
     from patrol_tpu.models.limiter import NANO, LimiterConfig
-    from patrol_tpu.ops.rate import Rate
-    from patrol_tpu.runtime.mesh_engine import MeshEngine
+    from patrol_tpu.parallel import topology as topo
 
     gc.collect()  # drop the previous stage's device buffers
-    _log(f"mesh flagship: {B}x{N} over {len(jax.devices())} device(s)…")
+    n_dev = len(jax.devices())
+    _log(f"mesh step: {B}x{N} over {n_dev} device(s)…")
     cfg = LimiterConfig(buckets=B, nodes=N)
-    eng = MeshEngine(cfg, replicas=1, node_slot=0)
+    mesh = topo.make_mesh(replicas=1)
+    plan = topo.plan_for(mesh, cfg)
+    state = topo.init_sharded_state(cfg, mesh)
+    step = topo.build_cluster_step(mesh, 0)
+
+    kt, km = 256, 1024
+    k = 1024  # square padding, as the engine compiles it (mesh_engine.py)
+    takes = [
+        (int((i * 2654435761) % B), 1000 * NANO, 100, NANO, NANO, 4,
+         100 * NANO, 0)
+        for i in range(kt)
+    ]
+    idx = np.arange(km, dtype=np.int64)
+    deltas = (
+        (idx * 2654435761) % B,
+        (idx * 40503) % N,
+        (idx * 7919) % (10 * NANO),
+        (idx * 104729) % (10 * NANO),
+        (idx * 1299709) % (100 * NANO),
+    )
+    req, mb = topo.route_requests(plan, takes, deltas, k, k)
+
+    def run(s, mb_, req_):
+        return step(s, mb_, req_)[0]
+
+    _log("mesh step (compile)…")
+    dt, state = _bench(run, state, mb, req, iters=5, iters_hi=35)
+    out["mesh_step_us"] = round(dt * 1e6, 1)
+    out["mesh_step_ops"] = kt + km
+    out["mesh_devices"] = n_dev
+    # Lower-bound traffic: the take-row gathers + the merge scatters (the
+    # single-replica converge is a cross-replica no-op XLA may or may not
+    # materialize as a copy; it is excluded, so `implied` is conservative).
+    blocks = plan.blocks
+    _roofline(
+        out, "mesh_step", blocks * k * (N * 2 * 8 + 96) + km * 128, dt
+    )
+    ms = {}
     try:
-        rate = Rate(freq=100, per_ns=NANO)
-        # Modest batch: the squared (k, k) tick padding makes per-tick arg
-        # transfer k-proportional, and on the axon tunnel host→device bytes
-        # dominate the smoke (real local TPUs don't care). 1024 still
-        # exercises merge+take+converge at flagship state size.
-        kt, km = 256, 1024
-        rng = np.random.default_rng(3)
-
-        def round_trip(tag: int) -> None:
-            rows = rng.integers(0, B, km)
-            eng.ingest_deltas_batch(
-                [f"m{r}" for r in rows],
-                rng.integers(0, min(8, N), km),  # slot 0 ok: own-lane join
-                rng.integers(0, 5 * NANO, km),
-                rng.integers(0, 2 * NANO, km),
-                rng.integers(0, NANO, km),
-            )
-            tickets = [
-                eng.submit_take(f"m{i * 37 + tag}", rate, 1)[0] for i in range(kt)
-            ]
-            for t in tickets:
-                t.wait()
-            eng.flush(timeout=60)
-
-        round_trip(0)  # warm/compile
-        t0 = time.perf_counter()
-        rounds = 5
-        for r in range(1, rounds + 1):
-            round_trip(r)
-        dt = (time.perf_counter() - t0) / rounds
-        out["mesh_round_ms"] = round(dt * 1e3, 2)
-        out["mesh_round_ops"] = kt + km
-        try:
-            ms = jax.local_devices()[0].memory_stats() or {}
-            out["mesh_hbm_in_use_gb"] = round(ms.get("bytes_in_use", 0) / 2**30, 2)
-            out["mesh_hbm_limit_gb"] = round(ms.get("bytes_limit", 0) / 2**30, 2)
-        except Exception:
-            pass
-        _stage_done("mesh-flagship")
-        _log(
-            f"mesh: {out['mesh_round_ms']} ms/round ({kt} takes + {km} merges), "
-            f"hbm {out.get('mesh_hbm_in_use_gb', '?')}/{out.get('mesh_hbm_limit_gb', '?')} GB"
+        ms = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        pass
+    if ms.get("bytes_in_use"):
+        out["mesh_hbm_in_use_gb"] = round(ms["bytes_in_use"] / 2**30, 2)
+        out["mesh_hbm_limit_gb"] = round(ms.get("bytes_limit", 0) / 2**30, 2)
+        out["mesh_hbm_accounting"] = "device"
+    else:
+        # The axon tunnel backend returns no memory_stats (r2:
+        # mesh_hbm_*_gb 0.0/0.0); account allocations by hand instead:
+        # live buffers at steady state are the sharded pn + elapsed planes
+        # plus the pre-routed request/delta blocks (donated state buffers
+        # alternate, so peak is ~2× pn during a step).
+        state_b = B * N * 2 * 8 + B * 8
+        batch_b = blocks * k * (8 * 8 + 5 * 8)
+        out["mesh_hbm_in_use_gb"] = round((2 * state_b + batch_b) / 2**30, 2)
+        out["mesh_hbm_limit_gb"] = round(
+            16.0 if out.get("hbm_peak_gbps_est") == 819.0 else 0.0, 2
         )
-    finally:
-        eng.stop()
+        out["mesh_hbm_accounting"] = "allocation-estimate"
+    _stage_done("mesh-step")
+    _log(
+        f"mesh: {out['mesh_step_us']} µs/step ({kt} takes + {km} merges), "
+        f"hbm {out.get('mesh_hbm_in_use_gb', '?')}/{out.get('mesh_hbm_limit_gb', '?')} GB "
+        f"({out.get('mesh_hbm_accounting')})"
+    )
 
 
 def _mk_merge_batch(K: int, B: int, N: int, as_numpy: bool = False):

@@ -61,6 +61,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="replication transport: C++ sendmmsg/recvmmsg or asyncio",
     )
     p.add_argument(
+        "--wire-mode",
+        choices=["aggregate", "compat"],
+        default="aggregate",
+        help="outgoing replication wire form: dual-payload aggregate "
+        "headers (flag-day vs pre-lane-trailer builds) or compat raw "
+        "own-lane headers for rolling upgrades (see ops/wire.py)",
+    )
+    p.add_argument(
         "--http-front",
         choices=["python", "native"],
         default="python",
@@ -132,6 +140,7 @@ def main(argv=None) -> int:
         config=LimiterConfig(buckets=args.buckets, nodes=args.node_lanes),
         log=log,
         udp_backend=args.udp_backend,
+        wire_mode=args.wire_mode,
         http_front=args.http_front,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_interval_s=parse_duration(args.checkpoint_interval) / 1e9,

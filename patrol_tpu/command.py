@@ -40,6 +40,10 @@ class Command:
     # "native" = C++ recvmmsg/sendmmsg path, "asyncio" = pure python,
     # "auto" = native when the toolchain built it, else asyncio.
     udp_backend: str = "auto"
+    # Outgoing wire form: "aggregate" (dual-payload; flag-day upgrade from
+    # pre-lane-trailer patrol_tpu builds) or "compat" (raw own-lane headers
+    # for rolling upgrades). See ops/wire.py module docs.
+    wire_mode: str = "aggregate"
     # HTTP front: "python" = asyncio server (protocol-complete: h2c,
     # pipelining); "native" = C++ epoll front (net/native_http.py, the Go
     # net/http performance class for /take; HTTP/1.1 only). Python stays
@@ -100,11 +104,13 @@ class Command:
         )
         if use_native:
             replicator = native_replication.NativeReplicator(
-                self.node_addr, self.peer_addrs, slots, log_=log
+                self.node_addr, self.peer_addrs, slots, log_=log,
+                wire_mode=self.wire_mode,
             )
         else:
             replicator = await Replicator.create(
-                self.node_addr, self.peer_addrs, slots, log=log
+                self.node_addr, self.peer_addrs, slots, log=log,
+                wire_mode=self.wire_mode,
             )
         repo = TPURepo(engine, send_incast=replicator.send_incast_request)
         replicator.repo = repo

@@ -86,7 +86,7 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pt_send_fanout.restype = ctypes.c_int
         lib.pt_decode_batch.argtypes = [
             _u8p, _i32p, ctypes.c_int, _f64p, _f64p, _u64p, _u8p, _i32p, _i32p,
-            _i64p, _i64p, _i64p, _u64p,
+            _i64p, _i64p, _i64p, _u64p, _i32p,
         ]
         lib.pt_decode_batch.restype = ctypes.c_int
         lib.pt_encode_batch.argtypes = [
@@ -227,6 +227,9 @@ class DecodeBuffers:
         self.lane_a = np.zeros(n, np.int64)
         self.lane_t = np.zeros(n, np.int64)
         self.hashes = np.zeros(n, np.uint64)
+        # 0 = plain, 1 = capability advert (base trailer, MULTI bit),
+        # 2 = valid multi-lane trailer (re-decode through ops.wire).
+        self.multi = np.zeros(n, np.int32)
 
 
 def decode_batch_raw(
@@ -246,7 +249,7 @@ def decode_batch_raw(
         np.ascontiguousarray(packets, np.uint8),
         np.ascontiguousarray(sizes, np.int32),
         n, buf.added, buf.taken, buf.elapsed, buf.names, buf.name_lens,
-        buf.slots, buf.caps, buf.lane_a, buf.lane_t, buf.hashes,
+        buf.slots, buf.caps, buf.lane_a, buf.lane_t, buf.hashes, buf.multi,
     )
     return buf, n
 

@@ -40,6 +40,7 @@ constexpr int kFixedSize = 25;
 constexpr int kTrailerSize = 6;       // base form: P2 | flags=0 | slot u16 | ck
 constexpr int kTrailerCapSize = 14;   // with-cap:  P2 | flags=1 | slot u16 | cap u64 | ck
 constexpr int kTrailerLaneSize = 30;  // lane: P2 | flags=3 | slot | cap | lane_a | lane_t | ck
+constexpr int kTrailerMultiHead = 14;  // multi: P2 | flags=5 | own_slot | cap | K (then K×18 + ck)
 constexpr int kMaxBatch = 1024;
 
 inline uint64_t load_be64(const uint8_t* p) {
@@ -217,13 +218,18 @@ int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes,
 //   name bytes copied into names at 256B stride with name_lens set,
 //   origin_slots (-1 when no valid v2 trailer), caps (sender capacity base
 //   in int64 nanotokens; -1 when absent — v1 or base-form trailer),
-//   lane_added/lane_taken (exact own-lane PN values; -1 when absent).
+//   lane_added/lane_taken (exact own-lane PN values; -1 when absent),
+//   multi_flags: 0 = none, 1 = base trailer with the capability-advert
+//   bit (incast requests from multi-capable peers), 2 = a valid
+//   multi-lane trailer — the batch path does NOT expand its lanes; the
+//   caller re-decodes those few packets (incast replies, cold-start only)
+//   through the Python codec.
 // Malformed packets get name_lens[i] = -1. Returns count of valid packets.
 int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
                     double* added, double* taken, uint64_t* elapsed,
                     uint8_t* names, int* name_lens, int* origin_slots,
                     int64_t* caps, int64_t* lane_added, int64_t* lane_taken,
-                    uint64_t* name_hashes) {
+                    uint64_t* name_hashes, int* multi_flags) {
   int ok = 0;
   for (int i = 0; i < n; i++) {
     const uint8_t* p = packets + i * kPacketSize;
@@ -232,6 +238,7 @@ int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
     caps[i] = -1;
     lane_added[i] = -1;
     lane_taken[i] = -1;
+    if (multi_flags) multi_flags[i] = 0;
     if (name_hashes) name_hashes[i] = 0;
     if (sz < kFixedSize) {
       name_lens[i] = -1;
@@ -258,6 +265,28 @@ int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
     if (tail_len >= kTrailerSize && tail[0] == 'P' && tail[1] == '2') {
       bool with_cap = (tail[2] & 0x01) != 0;
       bool with_lane = (tail[2] & 0x02) != 0;
+      bool with_multi = (tail[2] & 0x04) != 0;
+      if (with_multi && with_cap && !with_lane) {
+        // Multi-lane trailer: magic|flags|own_slot u16|cap u64|K u8|
+        // K×(slot u16, added u64, taken u64)|ck. Validate whole, flag for
+        // Python re-decode; only slot+cap surface through the flat outputs.
+        if (tail_len >= kTrailerMultiHead + 1) {
+          int K = tail[13];
+          int tsz = kTrailerMultiHead + K * 18 + 1;
+          if (tail_len >= tsz) {
+            uint8_t sum = 0;
+            for (int t = 0; t < tsz - 1; t++) sum += tail[t];
+            uint64_t cap = load_be64(tail + 5);
+            if (sum == tail[tsz - 1] && cap < (1ULL << 63)) {
+              origin_slots[i] = (tail[3] << 8) | tail[4];
+              caps[i] = static_cast<int64_t>(cap);
+              if (multi_flags) multi_flags[i] = 2;
+            }
+          }
+        }
+        ok++;
+        continue;
+      }
       int tsz = with_lane ? kTrailerLaneSize
                           : (with_cap ? kTrailerCapSize : kTrailerSize);
       if (tail_len >= tsz && (!with_lane || with_cap)) {
@@ -279,6 +308,8 @@ int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
               lane_added[i] = static_cast<int64_t>(la);
               lane_taken[i] = static_cast<int64_t>(lt);
             }
+            // Base trailer carrying the advert bit: multi-capable sender.
+            if (multi_flags && with_multi && !with_cap) multi_flags[i] = 1;
           }
         }
       }

@@ -50,12 +50,19 @@ class NativeReplicator:
         peer_addrs: Sequence[str],
         slots: SlotTable,
         log_=None,
+        wire_mode: str = "aggregate",
     ):
         host, port = parse_addr(node_addr)
         self.sock = native.NativeSocket(host, port)
         self.node_addr = node_addr
         self.slots = slots
         self.log = log_ or log
+        if wire_mode not in ("aggregate", "compat"):
+            raise ValueError(f"unknown wire_mode {wire_mode!r}")
+        # "aggregate" = dual-payload wire form (flag-day vs pre-lane-trailer
+        # builds); "compat" = raw own-lane headers + base trailers for
+        # rolling upgrades. See ops/wire.py module docs.
+        self.wire_mode = wire_mode
         peers: List[Tuple[str, int]] = [
             _resolve(p) for p in dict.fromkeys(peer_addrs) if p != node_addr
         ]
@@ -116,7 +123,11 @@ class NativeReplicator:
                 & (dbuf.taken[:n] == 0)
                 & (dbuf.elapsed[:n] == 0)
             )
-            deltas = live & ~inc
+            # Multi-lane trailers (compact incast replies): the flat batch
+            # decode surfaces only slot+cap for them — re-decode the few
+            # such packets (cold-start only) through the Python codec.
+            multi2 = live & ~inc & (dbuf.multi[:n] == 2)
+            deltas = live & ~inc & ~multi2
             # Slot resolution: a valid trailer carries the slot; otherwise
             # (v1 reference peer) resolve by sender address — per unique
             # address, peers are few. Unresolvable ⇒ dropped (slot −1).
@@ -149,6 +160,25 @@ class NativeReplicator:
                     dbuf.lane_t[:n],
                     no_trailer,
                 )
+            if multi2.any():
+                for i in np.flatnonzero(multi2):
+                    st = wire.decode(bytes(packets[i][: sizes[i]]))
+                    if st.lanes is None:
+                        self.rx_errors += 1
+                        continue
+                    lanes = [l for l in st.lanes if l[0] < self.slots.max_slots]
+                    self.rx_errors += len(st.lanes) - len(lanes)
+                    if lanes:
+                        self.repo.engine.ingest_deltas_batch(
+                            [st.name] * len(lanes),
+                            [l[0] for l in lanes],
+                            [st.added_nt] * len(lanes),
+                            [st.taken_nt] * len(lanes),
+                            [max(st.elapsed_ns, 0)] * len(lanes),
+                            [st.cap_nt] * len(lanes),
+                            [l[1] for l in lanes],
+                            [l[2] for l in lanes],
+                        )
             if inc.any():
                 incasts = [
                     (
@@ -157,31 +187,42 @@ class NativeReplicator:
                         ),
                         int(ips[i]),
                         int(ports[i]),
+                        int(dbuf.multi[i]) >= 1,  # requester's multi advert
                     )
                     for i in np.flatnonzero(inc)
                 ]
                 self._reply_incasts(incasts)
 
+    def _encode_py(self, states):
+        """Python-codec encode into the (n, 256) fan-out layout — the cold
+        path for wire forms the C++ encoder doesn't speak (multi trailers)."""
+        pkts = np.zeros((len(states), 256), np.uint8)
+        szs = np.zeros(len(states), np.int32)
+        for i, st in enumerate(states):
+            b = wire.encode(st)
+            pkts[i, : len(b)] = np.frombuffer(b, np.uint8)
+            szs[i] = len(b)
+        return pkts, szs
+
     def _reply_incasts(self, requests) -> None:
         """Serve a batch of incast requests with ONE device gather."""
-        by_name = self.repo.engine.snapshot_many([name for name, _, _ in requests])
-        for name, ip, port in requests:
+        by_name = self.repo.engine.snapshot_many([name for name, _, _, _ in requests])
+        for name, ip, port, multi_ok in requests:
             states = by_name.get(name)
             if not states:
                 continue
-            pkts, sizes = native.encode_batch(
-                [s.added for s in states],
-                [s.taken for s in states],
-                [s.elapsed_ns for s in states],
-                [s.name for s in states],
-                [s.origin_slot if s.origin_slot is not None else -1 for s in states],
-                [s.cap_nt if s.cap_nt is not None else -1 for s in states],
-                [s.lane_added_nt if s.lane_added_nt is not None else -1 for s in states],
-                [s.lane_taken_nt if s.lane_taken_nt is not None else -1 for s in states],
-            )
-            pkts, sizes = self._retry_oversize(states, pkts, sizes)
+            if multi_ok and self.wire_mode != "compat":
+                packed = wire.pack_multi(states)
+                if any(s.lanes is not None for s in packed):
+                    pkts, sizes2 = self._encode_py(packed)
+                    self.tx_packets += self.sock.send_fanout(
+                        pkts, sizes2,
+                        np.array([ip], np.uint32), np.array([port], np.uint16),
+                    )
+                    continue
+            pkts, sizes2 = self._encode_states(states)
             self.tx_packets += self.sock.send_fanout(
-                pkts, sizes, np.array([ip], np.uint32), np.array([port], np.uint16)
+                pkts, sizes2, np.array([ip], np.uint32), np.array([port], np.uint16)
             )
 
     # -- send path ----------------------------------------------------------
@@ -196,23 +237,49 @@ class NativeReplicator:
         ]
         return self._peer_ips[keep], self._peer_ports[keep]
 
+    def _encode_states(self, states: Sequence[wire.WireState]):
+        """Mode-gated C++ batch encode (see Replicator._payload_bytes for
+        the compat-form rationale)."""
+        slots = [s.origin_slot if s.origin_slot is not None else -1 for s in states]
+        if self.wire_mode == "compat":
+            compat_ok = [
+                s.cap_nt is not None
+                and s.lane_added_nt is not None
+                and s.lane_taken_nt is not None
+                for s in states
+            ]
+            pkts, sizes = native.encode_batch(
+                [
+                    s.lane_added_nt / wire.NANO if ok else s.added
+                    for s, ok in zip(states, compat_ok)
+                ],
+                [
+                    s.lane_taken_nt / wire.NANO if ok else s.taken
+                    for s, ok in zip(states, compat_ok)
+                ],
+                [s.elapsed_ns for s in states],
+                [s.name for s in states],
+                slots,
+            )
+        else:
+            pkts, sizes = native.encode_batch(
+                [s.added for s in states],
+                [s.taken for s in states],
+                [s.elapsed_ns for s in states],
+                [s.name for s in states],
+                slots,
+                [s.cap_nt if s.cap_nt is not None else -1 for s in states],
+                [s.lane_added_nt if s.lane_added_nt is not None else -1 for s in states],
+                [s.lane_taken_nt if s.lane_taken_nt is not None else -1 for s in states],
+            )
+        return self._retry_oversize(states, pkts, sizes)
+
     def broadcast_states(self, states: Sequence[wire.WireState]) -> None:
         """Full-state broadcast to every peer (repo.go:123-158); one
         sendmmsg per ≤1024-datagram chunk. Runs on the caller's thread."""
         if not len(self._peer_ips) or not states:
             return
-        slots = [s.origin_slot if s.origin_slot is not None else -1 for s in states]
-        pkts, sizes = native.encode_batch(
-            [s.added for s in states],
-            [s.taken for s in states],
-            [s.elapsed_ns for s in states],
-            [s.name for s in states],
-            slots,
-            [s.cap_nt if s.cap_nt is not None else -1 for s in states],
-            [s.lane_added_nt if s.lane_added_nt is not None else -1 for s in states],
-            [s.lane_taken_nt if s.lane_taken_nt is not None else -1 for s in states],
-        )
-        pkts, sizes = self._retry_oversize(states, pkts, sizes)
+        pkts, sizes = self._encode_states(states)
         ips, ports = self._live_peers()
         if len(ips):
             self.tx_packets += self.sock.send_fanout(pkts, sizes, ips, ports)
@@ -240,7 +307,19 @@ class NativeReplicator:
     def send_incast_request(self, name: str) -> None:
         if not len(self._peer_ips):
             return
-        pkts, sizes = native.encode_batch([0.0], [0.0], [0], [name], [-1])
+        try:
+            # Base trailer with the multi-reply capability advert (0x04) —
+            # python-encoded, the C++ encoder doesn't emit advert bits.
+            pkts, sizes = self._encode_py(
+                [
+                    wire.WireState(
+                        name=name, added=0.0, taken=0.0, elapsed_ns=0,
+                        origin_slot=self.slots.self_slot, multi_ok=True,
+                    )
+                ]
+            )
+        except wire.NameTooLargeError:
+            pkts, sizes = native.encode_batch([0.0], [0.0], [0], [name], [-1])
         ips, ports = self._live_peers()
         if sizes[0] >= 0 and len(ips):
             self.tx_packets += self.sock.send_fanout(pkts, sizes, ips, ports)

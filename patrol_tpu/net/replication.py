@@ -104,9 +104,22 @@ class SlotTable:
 
 class Replicator(asyncio.DatagramProtocol):
     """One UDP socket for send + receive, like the reference's single
-    ``net.PacketConn`` (repo.go:31). Constructed via :meth:`create`."""
+    ``net.PacketConn`` (repo.go:31). Constructed via :meth:`create`.
 
-    def __init__(self, node_addr: str, peer_addrs: Sequence[str], slots: SlotTable, log=None):
+    ``wire_mode`` gates the outgoing wire form (ops/wire.py module docs):
+    ``"aggregate"`` (default) sends the dual-payload form — flag-day
+    upgrade from pre-lane-trailer patrol_tpu builds; ``"compat"`` sends
+    raw own-lane headers + base trailers every build can parse, for
+    rolling upgrades."""
+
+    def __init__(
+        self,
+        node_addr: str,
+        peer_addrs: Sequence[str],
+        slots: SlotTable,
+        log=None,
+        wire_mode: str = "aggregate",
+    ):
         self.node_addr = node_addr
         # Self-filtering peer list (repo.go:36-41).
         self.peers: List[Addr] = [
@@ -114,6 +127,9 @@ class Replicator(asyncio.DatagramProtocol):
         ]
         self.slots = slots
         self.log = log
+        if wire_mode not in ("aggregate", "compat"):
+            raise ValueError(f"unknown wire_mode {wire_mode!r}")
+        self.wire_mode = wire_mode
         self.transport: Optional[asyncio.DatagramTransport] = None
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.repo = None  # set by the supervisor (TPURepo)
@@ -127,10 +143,15 @@ class Replicator(asyncio.DatagramProtocol):
 
     @classmethod
     async def create(
-        cls, node_addr: str, peer_addrs: Sequence[str], slots: SlotTable, log=None
+        cls,
+        node_addr: str,
+        peer_addrs: Sequence[str],
+        slots: SlotTable,
+        log=None,
+        wire_mode: str = "aggregate",
     ) -> "Replicator":
         loop = asyncio.get_running_loop()
-        self = cls(node_addr, peer_addrs, slots, log)
+        self = cls(node_addr, peer_addrs, slots, log, wire_mode=wire_mode)
         self.loop = loop
         host, port = parse_addr(node_addr)
         await loop.create_datagram_endpoint(lambda: self, local_addr=(host, port))
@@ -155,6 +176,22 @@ class Replicator(asyncio.DatagramProtocol):
         if self.repo is None:
             return
         if not state.is_zero():
+            if state.lanes is not None:
+                # Multi-lane incast reply: every non-zero PN lane of the
+                # bucket in one packet. Expand to per-lane merges.
+                for lane_slot, la, lt in state.lanes:
+                    if lane_slot >= self.slots.max_slots:
+                        self.rx_errors += 1
+                        continue
+                    self.repo.apply_delta(
+                        wire.WireState(
+                            name=state.name, added=state.added, taken=state.taken,
+                            elapsed_ns=state.elapsed_ns, origin_slot=lane_slot,
+                            cap_nt=state.cap_nt, lane_added_nt=la, lane_taken_nt=lt,
+                        ),
+                        lane_slot,
+                    )
+                return
             slot = (
                 state.origin_slot
                 if state.origin_slot is not None and state.origin_slot < self.slots.max_slots
@@ -176,17 +213,26 @@ class Replicator(asyncio.DatagramProtocol):
         else:
             # Incast request: unicast our state back if we have any
             # (repo.go:86-90). Device read happens off the event loop.
-            asyncio.ensure_future(self._reply_incast(state.name, addr))
+            asyncio.ensure_future(self._reply_incast(state.name, addr, state.multi_ok))
 
-    async def _reply_incast(self, name: str, addr: Addr) -> None:
+    async def _reply_incast(self, name: str, addr: Addr, multi_ok: bool = False) -> None:
         assert self.loop is not None
         states = await self.loop.run_in_executor(None, self.repo.snapshot, name)
-        for st in states:
-            self._send(_encode_with_fallback(st), addr)
+        payloads = states
+        if multi_ok and self.wire_mode != "compat":
+            # The requester can parse multi trailers: all lanes in one
+            # packet (repo.go:86-90 answers with exactly one) instead of a
+            # ×N reply storm against a hot bucket.
+            payloads = wire.pack_multi(states)
+        for st in payloads:
+            self._send(self._payload_bytes(st), addr)
         if states and self.log:
             self.log.debug(
                 "incast reply",
-                extra={"peer": f"{addr[0]}:{addr[1]}", "bucket": name, "lanes": len(states)},
+                extra={
+                    "peer": f"{addr[0]}:{addr[1]}", "bucket": name,
+                    "lanes": len(states), "packets": len(payloads),
+                },
             )
 
     # -- send path (repo.go:123-169) ----------------------------------------
@@ -203,22 +249,52 @@ class Replicator(asyncio.DatagramProtocol):
             for peer in self.peers:
                 self._send(data, peer)
 
+    def _payload_bytes(self, st: wire.WireState) -> bytes:
+        """Mode-gated encode: ``compat`` rewrites a dual-payload state to
+        the pre-lane-trailer form (raw own-lane header + base trailer) that
+        every patrol_tpu build can ingest without inflation."""
+        if (
+            self.wire_mode == "compat"
+            and st.cap_nt is not None
+            and st.lane_added_nt is not None
+            and st.lane_taken_nt is not None
+        ):
+            st = wire.WireState(
+                name=st.name,
+                added=st.lane_added_nt / wire.NANO,
+                taken=st.lane_taken_nt / wire.NANO,
+                elapsed_ns=st.elapsed_ns,
+                origin_slot=st.origin_slot,
+            )
+        return _encode_with_fallback(st)
+
     def broadcast_states(self, states: Sequence[wire.WireState]) -> None:
         """Thread-safe broadcast of full bucket states to every peer —
         callable from the engine thread (the reference broadcasts from the
         request goroutine, repo.go:129-158)."""
         if not self.peers:
             return
-        payloads = [_encode_with_fallback(st) for st in states]
+        payloads = [self._payload_bytes(st) for st in states]
         if self.loop is not None:
             self.loop.call_soon_threadsafe(self._broadcast_now, payloads)
 
     def send_incast_request(self, name: str) -> None:
         """Broadcast a zero-state packet: 'send me your state for this
-        bucket' (repo.go:99-103). Thread-safe."""
+        bucket' (repo.go:99-103), tagged with the multi-reply capability
+        advert (a base trailer with the 0x04 bit — transparent to v1 and
+        prior-version receivers). Thread-safe."""
         if not self.peers:
             return
-        data = wire.encode(wire.WireState(name=name, added=0.0, taken=0.0, elapsed_ns=0))
+        try:
+            data = wire.encode(
+                wire.WireState(
+                    name=name, added=0.0, taken=0.0, elapsed_ns=0,
+                    origin_slot=self.slots.self_slot, multi_ok=True,
+                )
+            )
+        except wire.NameTooLargeError:
+            # Trailer would not fit this name; plain v1 request.
+            data = wire.encode(wire.WireState(name=name, added=0.0, taken=0.0, elapsed_ns=0))
         if self.loop is not None:
             self.loop.call_soon_threadsafe(self._broadcast_now, [data])
 

@@ -20,15 +20,48 @@ This module adds a *backward-compatible* v2 extension: because the reference
 decoder reads exactly ``data[25:25+L]`` and ignores any trailing bytes, we
 may append a trailer carrying patrol_tpu metadata. Reference nodes
 interoperate unchanged; patrol_tpu nodes use it to address the sender's
-PN-counter lane. Three trailer forms (``flags`` bits select):
+PN-counter lane. Four trailer forms (``flags`` bits select):
 
 * base (6 B):     ``b"P2" | u8 flags=0 | u16 slot | u8 checksum``
 * with-cap (14B): ``b"P2" | u8 flags=1 | u16 slot | u64 cap_nt | u8 checksum``
 * lane (30 B):    ``b"P2" | u8 flags=3 | u16 slot | u64 cap_nt |``
   ``u64 lane_added_nt | u64 lane_taken_nt | u8 checksum``
+* multi (15+18K): ``b"P2" | u8 flags=5 | u16 own_slot | u64 cap_nt | u8 K |``
+  ``K × (u16 slot | u64 added_nt | u64 taken_nt) | u8 checksum``
 
 (checksum = sum of the preceding trailer bytes mod 256, a guard against a
 name that happens to end in "P2").
+
+The **multi** form carries a whole bucket's non-zero PN lanes in ONE
+packet — the compact incast reply (the reference answers an incast with
+one packet, repo.go:86-90; per-lane replies would storm a cold-starting
+node with up to N packets per hot bucket). Flag bit ``0x04`` doubles as a
+*capability advert*: an incast REQUEST whose base trailer sets it tells
+the receiver the requester can parse multi replies; receivers without the
+bit get per-lane replies. Decoders that predate the multi form read its
+flags (0x05) as the with-cap form, whose checksum byte lands on ``K`` —
+a 255/256 rejection that degrades the packet to v1 aggregate handling
+(capacity-subtracted deficit attribution: conservative, never inflating).
+
+**Rolling-upgrade gate** (``wire_mode``, ADVICE r2): senders before the
+dual-payload scheme put raw own-lane values in the float64 header with a
+base trailer; receivers of that era merge whatever the header holds into
+the sender's single lane. Sending them today's capacity-included AGGREGATE
+header with a lane trailer they cannot parse would permanently inflate
+their PN state (lanes are monotone). Both replication backends therefore
+take ``wire_mode``:
+
+* ``"aggregate"`` (default) — today's dual-payload form. Requires every
+  patrol_tpu node in the cluster to be lane-trailer-capable (any build
+  including the lane trailer): a FLAG-DAY upgrade from pre-lane-trailer
+  builds. Mixed clusters with *reference* (v1) nodes are always fine —
+  v1 nodes ignore trailers and expect exactly the aggregate header.
+* ``"compat"`` — raw own-lane headers + base trailers, parseable by every
+  patrol_tpu build ever shipped. Run the whole cluster in this mode while
+  rolling out a lane-capable build, then flip to ``aggregate``. (v1
+  reference peers see own-lane scalars in this mode — they under-count
+  other nodes' takes until the flip, which is within the reference's own
+  lossy-scalar-merge semantics.)
 
 Mixed-cluster interop hinges on the **dual payload**: the float64 header
 ``added``/``taken`` carry the sender's *aggregate scalar view* of the bucket
@@ -53,7 +86,7 @@ from __future__ import annotations
 
 import dataclasses
 import struct
-from typing import Optional
+from typing import List, Optional, Sequence, Tuple
 
 NANO = 1_000_000_000
 
@@ -66,12 +99,25 @@ _HEADER = struct.Struct(">ddQ")
 _TRAILER = struct.Struct(">2sBHB")
 _TRAILER_CAP = struct.Struct(">2sBHQB")
 _TRAILER_LANE = struct.Struct(">2sBHQQQB")
+_MULTI_HEAD = struct.Struct(">2sBHQB")  # magic|flags|own_slot|cap|K
+_MULTI_LANE = struct.Struct(">HQQ")  # per-lane: slot|added_nt|taken_nt
 _TRAILER_MAGIC = b"P2"
 _FLAG_CAP = 0x01
 _FLAG_LANE = 0x02
+_FLAG_MULTI = 0x04
 TRAILER_SIZE = _TRAILER.size
 TRAILER_CAP_SIZE = _TRAILER_CAP.size
 TRAILER_LANE_SIZE = _TRAILER_LANE.size
+
+
+def multi_trailer_size(k: int) -> int:
+    return _MULTI_HEAD.size + k * _MULTI_LANE.size + 1  # +1 checksum
+
+
+def max_multi_lanes(name_len: int) -> int:
+    """How many lanes fit in one multi packet for a given name length."""
+    room = PACKET_SIZE - FIXED_SIZE - name_len - _MULTI_HEAD.size - 1
+    return max(0, min(255, room // _MULTI_LANE.size))
 
 
 class NameTooLargeError(ValueError):
@@ -100,6 +146,11 @@ class WireState:
     # scalar (reference) merge semantics for this delta
     lane_added_nt: Optional[int] = None  # exact own-lane PN values (grants-
     lane_taken_nt: Optional[int] = None  # only, nanotokens); lane trailer
+    lanes: Optional[Tuple[Tuple[int, int, int], ...]] = None  # multi
+    # trailer: ((slot, added_nt, taken_nt), …) — a whole bucket's non-zero
+    # PN lanes in one packet (the compact incast reply)
+    multi_ok: bool = False  # sender advertised multi-reply capability
+    # (flag bit 0x04 on its trailer — set on incast requests)
 
     def is_zero(self) -> bool:
         """The incast-request marker (bucket.go:163-170, repo.go:78-90)."""
@@ -182,7 +233,12 @@ def encode(state: WireState) -> bytes:
     # non-UTF8 bytes must round-trip exactly or distinct buckets would
     # collapse into one and fork CRDT state across the cluster.
     name_bytes = state.name.encode("utf-8", errors="surrogateescape")
-    with_cap = state.origin_slot is not None and state.cap_nt is not None
+    with_multi = state.origin_slot is not None and state.cap_nt is not None and state.lanes
+    with_cap = (
+        not with_multi
+        and state.origin_slot is not None
+        and state.cap_nt is not None
+    )
     with_lane = (
         with_cap
         and state.lane_added_nt is not None
@@ -190,6 +246,8 @@ def encode(state: WireState) -> bytes:
     )
     if state.origin_slot is None:
         limit = MAX_NAME_LENGTH_V1
+    elif with_multi:
+        limit = PACKET_SIZE - FIXED_SIZE - multi_trailer_size(len(state.lanes))
     elif with_lane:
         limit = PACKET_SIZE - FIXED_SIZE - TRAILER_LANE_SIZE
     elif with_cap:
@@ -204,7 +262,19 @@ def encode(state: WireState) -> bytes:
     out.append(len(name_bytes))
     out += name_bytes
     if state.origin_slot is not None:
-        if with_lane:
+        if with_multi:
+            trailer = bytearray(
+                _MULTI_HEAD.pack(
+                    _TRAILER_MAGIC, _FLAG_CAP | _FLAG_MULTI, state.origin_slot,
+                    state.cap_nt & 0xFFFFFFFFFFFFFFFF, len(state.lanes),
+                )
+            )
+            for slot, a_nt, t_nt in state.lanes:
+                trailer += _MULTI_LANE.pack(
+                    slot, a_nt & 0xFFFFFFFFFFFFFFFF, t_nt & 0xFFFFFFFFFFFFFFFF
+                )
+            trailer.append(0)
+        elif with_lane:
             trailer = bytearray(
                 _TRAILER_LANE.pack(
                     _TRAILER_MAGIC, _FLAG_CAP | _FLAG_LANE, state.origin_slot,
@@ -221,7 +291,13 @@ def encode(state: WireState) -> bytes:
                 )
             )
         else:
-            trailer = bytearray(_TRAILER.pack(_TRAILER_MAGIC, 0, state.origin_slot, 0))
+            # The MULTI bit on a base trailer is the capability advert
+            # (incast requests): old decoders parse it as a plain base
+            # trailer (their flag check masks only CAP|LANE).
+            flags = _FLAG_MULTI if state.multi_ok else 0
+            trailer = bytearray(
+                _TRAILER.pack(_TRAILER_MAGIC, flags, state.origin_slot, 0)
+            )
         trailer[-1] = sum(trailer[:-1]) & 0xFF
         out += trailer
     assert len(out) <= PACKET_SIZE
@@ -247,6 +323,8 @@ def decode(data: bytes) -> WireState:
     cap_nt: Optional[int] = None
     lane_added_nt: Optional[int] = None
     lane_taken_nt: Optional[int] = None
+    lanes: Optional[Tuple[Tuple[int, int, int], ...]] = None
+    multi_ok = False
     tail = data[FIXED_SIZE + name_len :]
     if len(tail) >= TRAILER_SIZE and tail[:2] == _TRAILER_MAGIC:
         flags = tail[2]
@@ -257,7 +335,29 @@ def decode(data: bytes) -> WireState:
         # partially honored. A partially-honored lane trailer would merge
         # the header's AGGREGATE into the sender's single lane and
         # permanently inflate the PN sum (one crafted packet per bucket).
-        if flags & _FLAG_LANE and flags & _FLAG_CAP and len(tail) >= TRAILER_LANE_SIZE:
+        if (
+            flags & _FLAG_MULTI
+            and flags & _FLAG_CAP
+            and not flags & _FLAG_LANE
+            and len(tail) >= _MULTI_HEAD.size + 1
+        ):
+            _m, _f, slot, cap_u64, k = _MULTI_HEAD.unpack_from(tail)
+            tsz = multi_trailer_size(k)
+            if len(tail) >= tsz and tail[tsz - 1] == sum(tail[: tsz - 1]) & 0xFF:
+                vals = []
+                good = cap_u64 < 1 << 63
+                off = _MULTI_HEAD.size
+                for _ in range(k):
+                    ls, la, lt = _MULTI_LANE.unpack_from(tail, off)
+                    off += _MULTI_LANE.size
+                    good &= la < 1 << 63 and lt < 1 << 63
+                    vals.append((ls, la, lt))
+                if good:
+                    origin_slot = slot
+                    cap_nt = cap_u64
+                    lanes = tuple(vals)
+                    multi_ok = True
+        elif flags & _FLAG_LANE and flags & _FLAG_CAP and len(tail) >= TRAILER_LANE_SIZE:
             _m, _f, slot, cap_u64, la_u64, lt_u64, ck = _TRAILER_LANE.unpack_from(tail)
             if (
                 ck == sum(tail[: TRAILER_LANE_SIZE - 1]) & 0xFF
@@ -278,6 +378,7 @@ def decode(data: bytes) -> WireState:
             _magic, _flags, slot, checksum = _TRAILER.unpack_from(tail)
             if checksum == sum(tail[: TRAILER_SIZE - 1]) & 0xFF:
                 origin_slot = slot
+                multi_ok = bool(flags & _FLAG_MULTI)  # capability advert
 
     return WireState(
         name=name,
@@ -288,4 +389,41 @@ def decode(data: bytes) -> WireState:
         cap_nt=cap_nt,
         lane_added_nt=lane_added_nt,
         lane_taken_nt=lane_taken_nt,
+        lanes=lanes,
+        multi_ok=multi_ok,
     )
+
+
+def pack_multi(states: Sequence[WireState]) -> List[WireState]:
+    """Pack per-lane snapshot states of ONE bucket into as few multi
+    packets as fit (the compact incast reply, repo.go:86-90: the reference
+    answers with one packet; per-lane replies would send up to N). Falls
+    back to the input unchanged when the states lack lane/cap data or only
+    one lane exists (the 30 B lane trailer is smaller than a 33 B 1-lane
+    multi). Every packet repeats the full aggregate header — idempotent
+    under the reference's scalar max-merge, like the per-lane form."""
+    if len(states) <= 1:
+        return list(states)
+    first = states[0]
+    if first.cap_nt is None or any(
+        s.lane_added_nt is None or s.lane_taken_nt is None or s.origin_slot is None
+        for s in states
+    ):
+        return list(states)
+    per_packet = max_multi_lanes(
+        len(first.name.encode("utf-8", errors="surrogateescape"))
+    )
+    if per_packet < 2:
+        return list(states)
+    out: List[WireState] = []
+    for lo in range(0, len(states), per_packet):
+        chunk = states[lo : lo + per_packet]
+        out.append(
+            dataclasses.replace(
+                first,
+                lanes=tuple(
+                    (s.origin_slot, s.lane_added_nt, s.lane_taken_nt) for s in chunk
+                ),
+            )
+        )
+    return out

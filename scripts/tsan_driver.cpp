@@ -31,7 +31,7 @@ int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
                     double* added, double* taken, uint64_t* elapsed,
                     uint8_t* names, int* name_lens, int* origin_slots,
                     int64_t* caps, int64_t* lane_added, int64_t* lane_taken,
-                    uint64_t* name_hashes);
+                    uint64_t* name_hashes, int* multi_flags);
 int pt_encode_batch(const double* added, const double* taken,
                     const uint64_t* elapsed, const uint8_t* names,
                     const int* name_lens, const int* origin_slots,
@@ -95,11 +95,12 @@ int main() {
     int name_lens[BATCH], slots[BATCH];
     int64_t caps[BATCH], lane_a[BATCH], lane_t[BATCH];
     uint64_t hashes[BATCH];
+    int multi[BATCH];
     while (!stop.load()) {
       int n = pt_recv_batch(rx, buf, BATCH, sizes, ips, ports, 50);
       if (n <= 0) continue;
       pt_decode_batch(buf, sizes, n, added, taken, elapsed, names, name_lens,
-                      slots, caps, lane_a, lane_t, hashes);
+                      slots, caps, lane_a, lane_t, hashes, multi);
       received.fetch_add(n);
     }
   };

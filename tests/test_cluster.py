@@ -200,6 +200,59 @@ class TestReplication:
             for cl in clients:
                 cl.close()
 
+    def test_incast_reply_is_one_packet_for_multi_capable_peer(self, cluster):
+        """A multi-capable requester gets a bucket's lanes in ONE packet
+        (≙ repo.go:86-90: the reference replies with exactly one), where
+        per-lane replies would send one per non-zero lane; a requester
+        without the advert still gets the per-lane form (VERDICT r2 #7)."""
+        from patrol_tpu.ops import wire
+
+        clients = [KeepAliveClient(p) for p in cluster.api_ports]
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        probe.bind(("127.0.0.1", 0))
+        probe.settimeout(0.5)
+        try:
+            # Give the bucket ≥2 non-zero lanes: take it on two nodes.
+            clients[0].take("packed", "9:1h")
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                status, _ = clients[1].take("packed", "9:1h")
+                eng = cluster.commands[0].engine
+                pn, _ = eng.read_rows([eng.directory.lookup("packed")])
+                if (pn[0].sum(axis=1) > 0).sum() >= 2:
+                    break
+                time.sleep(0.05)
+
+            def ask(multi_ok: bool):
+                req = wire.WireState(
+                    "packed", 0.0, 0.0, 0,
+                    origin_slot=3 if multi_ok else None, multi_ok=multi_ok,
+                )
+                probe.sendto(
+                    wire.encode(req),
+                    ("127.0.0.1", int(cluster.commands[0].node_addr.rsplit(":", 1)[1])),
+                )
+                pkts = []
+                while True:
+                    try:
+                        data, _ = probe.recvfrom(512)
+                        pkts.append(wire.decode(data))
+                    except socket.timeout:
+                        return pkts
+
+            multi_reply = ask(multi_ok=True)
+            assert len(multi_reply) == 1, f"expected 1 packet, got {len(multi_reply)}"
+            assert multi_reply[0].lanes is not None
+            assert len(multi_reply[0].lanes) >= 2
+
+            lane_reply = ask(multi_ok=False)
+            assert len(lane_reply) >= 2  # per-lane fallback
+            assert all(st.lanes is None for st in lane_reply)
+        finally:
+            probe.close()
+            for cl in clients:
+                cl.close()
+
     def test_oversize_name_replicates_and_rehydrates(self, cluster):
         """Names in (lane-trailer limit 201, v1 limit 231] can't carry the
         v2 trailer: broadcasts AND incast replies must fall back to

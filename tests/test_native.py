@@ -209,3 +209,58 @@ class TestNativeSocket:
             rx1.close()
             rx2.close()
             tx.close()
+
+
+class TestMultiTrailerDecode:
+    """C++ batch decode of the multi-lane / advert wire forms: the flat
+    outputs surface slot+cap and a multi flag; lanes themselves are
+    re-decoded in Python (cold path, incast replies only)."""
+
+    def test_flags(self):
+        from patrol_tpu.ops import wire as w
+
+        multi = w.encode(
+            w.WireState(
+                "m", 9.0, 1.0, 7, origin_slot=3, cap_nt=5,
+                lanes=((0, 10, 20), (2, 30, 40)),
+            )
+        )
+        advert = w.encode(
+            w.WireState("a", 0.0, 0.0, 0, origin_slot=1, multi_ok=True)
+        )
+        plain = w.encode(w.WireState("p", 1.0, 0.0, 0, origin_slot=2))
+        lane = w.encode(
+            w.WireState(
+                "l", 2.0, 0.0, 0, origin_slot=4, cap_nt=1,
+                lane_added_nt=6, lane_taken_nt=7,
+            )
+        )
+        pkts = np.zeros((4, 256), np.uint8)
+        sizes = np.zeros(4, np.int32)
+        for i, b in enumerate([multi, advert, plain, lane]):
+            pkts[i, : len(b)] = np.frombuffer(b, np.uint8)
+            sizes[i] = len(b)
+        buf, n = native.decode_batch_raw(pkts, sizes)
+        assert list(buf.multi[:4]) == [2, 1, 0, 0]
+        assert buf.slots[0] == 3 and buf.caps[0] == 5
+        assert buf.lane_a[0] == -1  # lanes NOT expanded by the batch path
+        assert buf.slots[1] == 1 and buf.slots[2] == 2
+        assert buf.lane_a[3] == 6 and buf.lane_t[3] == 7
+
+    def test_corrupt_multi_checksum_degrades_to_v1(self):
+        from patrol_tpu.ops import wire as w
+
+        data = bytearray(
+            w.encode(
+                w.WireState(
+                    "m", 9.0, 1.0, 7, origin_slot=3, cap_nt=5,
+                    lanes=((0, 10, 20),),
+                )
+            )
+        )
+        data[-1] ^= 0xFF
+        pkts = np.zeros((1, 256), np.uint8)
+        pkts[0, : len(data)] = np.frombuffer(bytes(data), np.uint8)
+        buf, _ = native.decode_batch_raw(pkts, np.array([len(data)], np.int32))
+        assert buf.multi[0] == 0 and buf.slots[0] == -1 and buf.caps[0] == -1
+        assert buf.name_lens[0] == 1  # packet itself is still valid (v1)

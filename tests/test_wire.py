@@ -304,3 +304,117 @@ class TestTrailerForms:
         assert out.cap_nt == (1 << 63) - 1
         assert out.lane_added_nt == (1 << 63) - 1
         assert out.lane_taken_nt == (1 << 63) - 1
+
+
+class TestMultiForm:
+    """The multi-lane trailer (compact incast replies) and the capability
+    advert bit — the O(1)-reply-packet protocol (≙ repo.go:86-90: the
+    reference answers an incast with exactly one packet)."""
+
+    @given(
+        own=st.integers(0, 65535),
+        cap=st.integers(0, 1 << 62),
+        lanes=st.lists(
+            st.tuples(
+                st.integers(0, 65535),
+                st.integers(0, 1 << 62),
+                st.integers(0, 1 << 62),
+            ),
+            min_size=1,
+            max_size=11,  # max_multi_lanes(len("bkt")) == 11
+        ),
+    )
+    @settings(max_examples=100)
+    def test_roundtrip(self, own, cap, lanes):
+        s = WireState(
+            name="bkt", added=7.5, taken=2.0, elapsed_ns=9,
+            origin_slot=own, cap_nt=cap, lanes=tuple(lanes),
+        )
+        out = decode(encode(s))
+        assert out.lanes == tuple(lanes)
+        assert out.cap_nt == cap and out.origin_slot == own
+        assert out.multi_ok
+
+    def test_advert_roundtrip(self):
+        """An incast request's base trailer carries the multi-capability
+        advert; plain base trailers do not."""
+        req = WireState("b", 0.0, 0.0, 0, origin_slot=2, multi_ok=True)
+        out = decode(encode(req))
+        assert out.is_zero() and out.multi_ok and out.origin_slot == 2
+        plain = decode(encode(WireState("b", 0.0, 0.0, 0, origin_slot=2)))
+        assert not plain.multi_ok
+
+    def test_reference_view_is_aggregate(self):
+        """A reference decoder reads data[:25+L] of a multi packet and sees
+        the aggregate header, no trailer (bucket.go:71-91)."""
+        s = WireState(
+            name="agg", added=12.5, taken=3.0, elapsed_ns=77,
+            origin_slot=1, cap_nt=5, lanes=((0, 1, 2), (3, 4, 5)),
+        )
+        ref_view = decode(encode(s)[: FIXED_SIZE + 3])
+        assert ref_view.added == 12.5 and ref_view.taken == 3.0
+        assert ref_view.origin_slot is None and ref_view.lanes is None
+
+    def test_hostile_bit63_lane_voids_whole_trailer(self):
+        s = WireState(
+            name="h", added=1.0, taken=0.0, elapsed_ns=0,
+            origin_slot=0, cap_nt=1, lanes=((0, 1, 2), (1, 3, 4)),
+        )
+        data = bytearray(encode(s))
+        # Overwrite lane 1's added_nt (offset: 25+1 name, multi head 14,
+        # lane 0 is 18 bytes in) with a bit-63 value, refresh the checksum.
+        off = FIXED_SIZE + 1 + 14 + 18 + 2
+        data[off:off + 8] = (1 << 63).to_bytes(8, "big")
+        data[-1] = sum(data[FIXED_SIZE + 1 : -1]) & 0xFF
+        out = decode(bytes(data))
+        assert out.lanes is None and out.cap_nt is None
+        assert out.origin_slot is None  # degraded whole, to v1 handling
+
+    def test_bad_checksum_voids_trailer(self):
+        s = WireState(
+            name="c", added=1.0, taken=0.0, elapsed_ns=0,
+            origin_slot=0, cap_nt=1, lanes=((0, 1, 2),),
+        )
+        data = bytearray(encode(s))
+        data[-1] ^= 0xFF
+        out = decode(bytes(data))
+        assert out.lanes is None and out.origin_slot is None
+
+    def test_pack_multi_one_packet_for_few_lanes(self):
+        states = [
+            from_nanotokens(
+                "hot", 10 * wire.NANO, wire.NANO, 5, origin_slot=s,
+                cap_nt=3 * wire.NANO, lane_added_nt=s * 10, lane_taken_nt=s,
+            )
+            for s in range(6)
+        ]
+        packed = wire.pack_multi(states)
+        assert len(packed) == 1
+        assert len(packed[0].lanes) == 6
+        assert len(encode(packed[0])) <= PACKET_SIZE
+
+    def test_pack_multi_splits_when_lanes_overflow_packet(self):
+        name = "n" * 100
+        states = [
+            from_nanotokens(
+                name, 1, 0, 0, origin_slot=s, cap_nt=1,
+                lane_added_nt=s, lane_taken_nt=0,
+            )
+            for s in range(20)
+        ]
+        packed = wire.pack_multi(states)
+        assert len(packed) > 1
+        assert sum(len(p.lanes) for p in packed) == 20
+        for p in packed:
+            assert len(encode(p)) <= PACKET_SIZE
+
+    def test_pack_multi_passthrough_without_lane_data(self):
+        states = [WireState("x", 1.0, 0.0, 0, origin_slot=0)] * 3
+        assert wire.pack_multi(states) == list(states)
+        single = [
+            from_nanotokens(
+                "x", 1, 0, 0, origin_slot=0, cap_nt=1,
+                lane_added_nt=1, lane_taken_nt=0,
+            )
+        ]
+        assert wire.pack_multi(single) == single  # lane form is smaller
