@@ -41,6 +41,7 @@ import signal
 import subprocess
 import sys
 import time
+from functools import partial
 
 START = time.time()
 BUDGET_S = float(os.environ.get("PATROL_BENCH_BUDGET_S", "1500"))
@@ -108,22 +109,78 @@ def _force(tree) -> int:
     return int(jax.device_get(probe(leaves)))
 
 
-def _bench(fn, state, *args, iters=10, warmup=2, repeats=3, iters_hi=None):
-    """Differential forced-completion timing. Each window runs n kernel
-    steps then forces completion via :func:`_force`; per repeat a SHORT
-    window (``iters``) and a LONG window (``iters_hi``, default 11×) are
-    both timed and the per-step time is (T_hi − T_lo)/(n_hi − n_lo) —
-    constant per-window costs (the checksum reduction, the tunnel round
-    trip) cancel exactly, so the probe does not inflate per-step numbers.
-    Best-of-``repeats``: the tunneled chip shows multi-second throttling
-    hiccups (BENCH r2: one run recorded the scatter stage 13× slower than
-    its neighbors); min-of-windows reports the hardware's capability, not
-    the tunnel's worst moment — and every window is now a forced-complete
-    measurement, so the minimum is still a real one."""
-    n_lo = iters
-    n_hi = iters_hi if iters_hi is not None else iters * 11
-    for _ in range(warmup):
-        state = fn(state, *args)
+def _bench(
+    fn, state, *args,
+    iters=2, warmup=2, repeats=3, iters_hi=12, indexed=False, device_loop=False,
+):
+    """Differential forced-completion timing with ON-DEVICE iteration.
+
+    ``fn(state, *args) → state`` is chained n times INSIDE one jit
+    (python-unrolled), so one device execute runs n kernel steps
+    back-to-back — the honest way to measure per-step time on the axon
+    tunnel, whose ~60-80 ms per-execute round trip otherwise floors every
+    kernel at the transport's latency, not the chip's (r3 first capture:
+    dense 79 ms, take 73 ms, scatter 119 ms — all ≈ the tunnel constant).
+    Unrolling, not ``fori_loop``: a while-loop carry ping-pongs buffers,
+    so every in-loop scatter pays a full state COPY the production
+    single-dispatch path (donated, in-place) never pays — measured 25 ms
+    vs 0.8 ms for the same 4096-row scatter. An unrolled chain on a
+    donated input keeps XLA's in-place aliasing, which is exactly the
+    engine's per-tick shape. Production dispatches the same way: one
+    donated call per microbatch tick.
+
+    Each window (n_lo and n_hi steps) ends in :func:`_force` — a
+    dependent device→host checksum readback a lazily-acking transport
+    cannot fake. Window minima over ``repeats`` are taken per size, THEN
+    differenced: (min T_hi − min T_lo)/(n_hi − n_lo) cancels every
+    per-execute constant (probe, tunnel round trip) without the low bias
+    of min-of-differences, and a throttling hiccup (BENCH r2 recorded a
+    13× outlier window) can only inflate a window, never fabricate speed.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n_lo, n_hi = iters, iters_hi
+
+    if device_loop:
+        # fori_loop with a TRACED trip count: one compile, and the loop
+        # structure stops the algebraic simplifier from collapsing a
+        # chain of idempotent joins into one step. The carry ping-pong
+        # means an in-loop op pays a full output write per iteration —
+        # only correct for DENSE stages that write the whole state
+        # anyway; scatter-shaped stages must use the unrolled form.
+        @partial(jax.jit, donate_argnums=0)
+        def loop_n(s, n, *a):
+            return jax.lax.fori_loop(0, n, lambda _i, st: fn(st, *a), s)
+
+        def run_lo(s, *a):
+            return loop_n(s, jnp.int32(n_lo), *a)
+
+        def run_hi(s, *a):
+            return loop_n(s, jnp.int32(n_hi), *a)
+    else:
+        def make_run(n):
+            @partial(jax.jit, donate_argnums=0)
+            def run(s, *a):
+                # args pass through the jit boundary as operands —
+                # closing over them would bake e.g. the 4.1 GB merge
+                # operand into the program as a captured constant.
+                # ``indexed`` callers take the unroll position as a
+                # trailing int and must vary their computation with it: a
+                # chain of IDENTICAL idempotent joins gets CSE'd to ONE
+                # step by the algebraic simplifier (the CPU smoke run
+                # collapsed to 0.001 ms/sweep before this).
+                for i in range(n):
+                    s = fn(s, *a, i) if indexed else fn(s, *a)
+                return s
+
+            return run
+
+        run_lo, run_hi = make_run(n_lo), make_run(n_hi)
+
+    for _ in range(max(warmup, 1)):
+        state = run_lo(state, *args)
+    state = run_hi(state, *args)  # compile the long window too
     _force(state)
     # min() each window size over repeats SEPARATELY, then difference the
     # minima: min over per-repeat differences would jointly pick the
@@ -133,13 +190,11 @@ def _bench(fn, state, *args, iters=10, warmup=2, repeats=3, iters_hi=None):
     best_lo = best_hi = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        for _ in range(n_lo):
-            state = fn(state, *args)
+        state = run_lo(state, *args)
         _force(state)
         best_lo = min(best_lo, time.perf_counter() - t0)
         t0 = time.perf_counter()
-        for _ in range(n_hi):
-            state = fn(state, *args)
+        state = run_hi(state, *args)
         _force(state)
         best_hi = min(best_hi, time.perf_counter() - t0)
         if _left() < 30:  # budget guard: keep the first window's number
@@ -230,6 +285,9 @@ def main() -> None:
     cache_dir = os.environ.setdefault(
         "JAX_COMPILATION_CACHE_DIR", "/tmp/patrol-jax-cache"
     )
+    # Bigger merge ticks amortize per-dispatch cost (decisive on the
+    # tunneled chip); must be set before the engine module is imported.
+    os.environ.setdefault("PATROL_MAX_MERGE_ROWS", "131072")
     try:
         platform = _probe_backend()
         OUT["platform"] = platform
@@ -328,12 +386,18 @@ def _run_stages(out) -> None:
     # -- dense anti-entropy sweep (config #5, kernel half) ------------------
     if _budget_out("dense sweep"):
         return
-    dense = jax.jit(merge_dense, donate_argnums=0)
     _log("dense sweep (compile #2)…")
     # One sweep reads both pn planes and writes one (3 × B·N·2·8 bytes)
     # plus the three elapsed passes: the bandwidth-bound stage whose r2
     # number violated the roofline ~380× and triggered this rework.
-    dt_dense, state = _bench(dense, state, other, iters=2, iters_hi=12)
+    # device_loop: the fori carry structure keeps the n identical joins
+    # from being CSE'd to one, and its per-iteration output write IS the
+    # sweep's own full-state write. (An unrolled chain either collapses
+    # — idempotent max — or, with an anti-CSE data dependence, OOMs on
+    # extra 1.9 GB u32-half temps at this state size.)
+    dt_dense, state = _bench(
+        merge_dense, state, other, iters=2, iters_hi=12, device_loop=True
+    )
     out["value"] = round(B / dt_dense)
     out["vs_baseline"] = round(B / dt_dense / target, 3)
     out["dense_sweep_ms"] = round(dt_dense * 1e3, 3)
@@ -346,9 +410,17 @@ def _run_stages(out) -> None:
         return
     K = 131_072
     deltas = _mk_merge_batch(K, B, N)
-    scatter = jax.jit(merge_batch, donate_argnums=0)
+    def scatter(s, d, i):
+        # +i on the values: distinct per unrolled step (anti-CSE), and
+        # every step really contends the same (row, slot) cells.
+        return merge_batch(
+            s,
+            MergeBatch(d.rows, d.slots, d.added_nt + i, d.taken_nt + i,
+                       d.elapsed_ns + i),
+        )
+
     _log("scatter merge (compile #3)…")
-    dt_scatter, state = _bench(scatter, state, deltas, iters=10, iters_hi=110)
+    dt_scatter, state = _bench(scatter, state, deltas, iters=2, iters_hi=42, indexed=True)
     out["scatter_merges_per_s"] = round(K / dt_scatter)
     out["scatter_batch"] = K
     # Per delta: 5 int64 inputs + read/write of 2 pn lanes + 3 elapsed
@@ -374,7 +446,7 @@ def _run_stages(out) -> None:
         elapsed_ns=(idx * 9973) % (100 * NANO),
     )
     _log("hot-key merge (cached compile)…")
-    dt_hot, state = _bench(scatter, state, hot, iters=10, iters_hi=110)
+    dt_hot, state = _bench(scatter, state, hot, iters=2, iters_hi=42, indexed=True)
     out["hotkey_merges_per_s"] = round(K / dt_hot)
     _roofline(out, "hotkey", K * 128, dt_hot)
     _stage_done("hotkey")
@@ -395,9 +467,9 @@ def _run_stages(out) -> None:
         cap_base_nt=jnp.full((KT,), 100 * NANO, jnp.int64),
         created_ns=jnp.zeros((KT,), jnp.int64),
     )
-    take = jax.jit(lambda s, r: take_batch(s, r, 0)[0], donate_argnums=0)
+    take = lambda s, r: take_batch(s, r, 0)[0]  # noqa: E731
     _log("fused take (compile #4)…")
-    dt_take, state = _bench(take, state, reqs, iters=10, iters_hi=110)
+    dt_take, state = _bench(take, state, reqs, iters=2, iters_hi=42)
     out["take_requests_per_s"] = round(KT * 4 / dt_take)  # nreq=4 per row
     out["take_step_us"] = round(dt_take * 1e6, 1)
     # Dominant traffic: the [K, N, 2] row gather (+ own-lane scatter-back
@@ -466,7 +538,7 @@ def _stage_mesh_step(out, B, N) -> None:
         return step(s, mb_, req_)[0]
 
     _log("mesh step (compile)…")
-    dt, state = _bench(run, state, mb, req, iters=5, iters_hi=35)
+    dt, state = _bench(run, state, mb, req, iters=2, iters_hi=12)
     out["mesh_step_us"] = round(dt * 1e6, 1)
     out["mesh_step_ops"] = kt + km
     out["mesh_devices"] = n_dev
@@ -551,13 +623,13 @@ def _stage_pallas_compare(out, state, scatter, B, N):
         rows, slots, added, taken, elapsed = _mk_merge_batch(K, B, N, as_numpy=True)
         batch = _mk_merge_batch(K, B, N)
         _log(f"pallas-vs-xla @K={K} (compiles)…")
-        dt_xla, state = _bench(scatter, state, batch, iters=10)
+        dt_xla, state = _bench(scatter, state, batch, iters=2, iters_hi=12, indexed=True)
 
         def pal(s, *_ignored):
             return pallas_merge.merge_batch_pallas(s, rows, slots, added, taken, elapsed)
 
         try:
-            dt_pal, state = _bench(pal, state, iters=10)
+            dt_pal, state = _bench(pal, state, iters=2, iters_hi=12)
         except Exception as e:
             result[f"k{K}"] = {"xla_us": round(dt_xla * 1e6, 1), "pallas_error": str(e)[:200]}
             continue
@@ -575,13 +647,17 @@ def _stage_pallas_compare(out, state, scatter, B, N):
 
 def _stage_ingest_replay(out, B, N, on_accel) -> None:
     """Configs #3 and #5 end-to-end through the host feeder: pre-encoded
-    256B wire packets → batch decode (C++ when available) → directory
-    assign → device scatter-merge. This measures the ingest pipeline the
-    Go reference caps at one packet per loop iteration (repo.go:54-92)."""
+    256B wire packets → batch decode (C++ when available) → fused native
+    resolve+classify (pt_rx_classify) → device scatter-merge. This
+    measures the ingest pipeline the Go reference caps at one packet per
+    loop iteration (repo.go:54-92). Completion is FORCED at the end with
+    a dependent state readback, so the wall number includes real device
+    time even against a lazily-acking transport."""
     import numpy as np
 
     from patrol_tpu import native
     from patrol_tpu.models.limiter import LimiterConfig
+
     from patrol_tpu.runtime.engine import DeviceEngine
 
     n_deltas = int(
@@ -638,19 +714,10 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
                 dbuf, n_dec = native.decode_batch_raw(pkts, sizes, dbuf)
                 t_decode += time.perf_counter() - td
                 tdir = time.perf_counter()
-                engine.ingest_deltas_batch_raw(
-                    n_dec,
-                    dbuf.names,
-                    dbuf.name_lens,
-                    dbuf.hashes,
+                engine.ingest_wire_batch(
+                    dbuf, n_dec,
                     dbuf.slots[:n_dec].astype(np.int64),
-                    wire_mod.sanitize_nt_array(dbuf.added[:n_dec]),
-                    wire_mod.sanitize_nt_array(dbuf.taken[:n_dec]),
-                    np.maximum(dbuf.elapsed[:n_dec].astype(np.int64), 0),
-                    dbuf.caps[:n_dec],
-                    dbuf.lane_a[:n_dec],
-                    dbuf.lane_t[:n_dec],
-                    np.zeros(n_dec, bool),
+                    np.zeros(n_dec, np.uint8),
                 )
                 t_dir += time.perf_counter() - tdir
             else:
@@ -668,12 +735,21 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
                 )
                 t_dir += time.perf_counter() - tdir
             done += chunk
-            while engine.backlog() > 65_536 and _left() > 45:  # backpressure
+            while engine.backlog() > 524_288 and _left() > 45:  # backpressure
                 time.sleep(0.001)
-        if not engine.flush(timeout=60):
+        t_host = time.perf_counter() - t0
+        if engine.flush(timeout=120):
+            # Forced device completion: the wall clock below cannot close
+            # before every queued merge actually executed on the chip.
+            # Only after a clean flush — while the feeder still dispatches,
+            # engine.state is being donated out from under readers.
+            _force(engine.state)
+        else:
             out["truncated"] = True
             out["ingest_flush_timeout"] = True
         dt = time.perf_counter() - t0
+        out["ingest_host_deltas_per_s"] = round(done / t_host)
+        out["ingest_device_drain_ms"] = round((dt - t_host) * 1e3, 1)
         out["ingest_deltas_per_s"] = round(done / dt)
         out["ingest_deltas"] = done
         if t_half is not None and done > t_half[1]:

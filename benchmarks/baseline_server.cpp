@@ -1,0 +1,255 @@
+// baseline_server: the MEASURED baseline denominator (VERDICT r2 item 3).
+//
+// A reference-semantics, in-memory, compiled /take server — what the Go
+// reference (api.go:51-86 over repo.go:171-235 over bucket.go:186-225)
+// does, re-expressed in ~200 lines of C++ so "p99 ≤ Go baseline"
+// (BASELINE.md) can be judged against a number measured on THIS box
+// instead of a hardware-class citation. No Go toolchain exists in the
+// build image, so this compiled single-process epoll server is the
+// closest stand-in for compiled net/http + in-memory map semantics:
+// same arithmetic (float64 tokens, bucket.go:186-225 step-for-step),
+// same silent rate-parse-error behavior (api.go:61-62), same name-length
+// guard (api.go:55-58), keep-alive + pipelined HTTP/1.1.
+//
+// Rate parsing links against libpatrolhost.so's pt_parse_rate — the same
+// Go-ParseDuration-parity parser the production front uses, so baseline
+// and candidate agree on every rate string.
+//
+// Build (see benchmarks/baseline_bench.py):
+//   g++ -O2 -std=c++17 benchmarks/baseline_server.cpp \
+//       -L patrol_tpu/native -lpatrolhost -Wl,-rpath,patrol_tpu/native \
+//       -o /tmp/patrol_baseline_server
+// Run: /tmp/patrol_baseline_server <port>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" int pt_parse_rate(const char* s, int64_t* freq, int64_t* per_ns);
+
+namespace {
+
+constexpr int kMaxName = 231;  // bucket.go:43-44
+
+struct Bucket {  // bucket.go:20-32, float64 scalars like the reference
+  double added = 0, taken = 0;
+  int64_t elapsed = 0, created = 0;
+};
+
+std::unordered_map<std::string, Bucket> g_buckets;  // repo.go:171-235
+
+int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+// bucket.go:186-225, step for step.
+bool take(Bucket& b, int64_t now, int64_t freq, int64_t per, double n,
+          double* remaining) {
+  double capacity = (double)freq;  // rate.Tokens of the full interval
+  if (b.added == 0) b.added = capacity;  // lazy init, commits on failure too
+  int64_t last = b.created + b.elapsed;
+  if (now < last) last = now;  // monotonic-time guard
+  double tokens = b.added - b.taken;
+  int64_t elapsed = now - last;
+  // Refill: float64(elapsed)/float64(interval), interval = per/freq
+  // (truncating integer division, bucket.go:130-148).
+  double added = 0;
+  if (freq > 0 && per > 0) {
+    int64_t interval = per / freq;
+    if (interval > 0) added = (double)elapsed / (double)interval;
+  }
+  double missing = capacity - tokens;
+  if (added > missing) added = missing;  // may be negative: forfeits excess
+  double have = tokens + added;
+  if (n > have) {
+    *remaining = have > 0 ? have : 0;
+    return false;
+  }
+  b.elapsed += elapsed;
+  b.added += added;
+  b.taken += n;
+  double rem = b.added - b.taken;
+  *remaining = rem > 0 ? rem : 0;
+  return true;
+}
+
+struct Conn {
+  std::string rbuf, wbuf;
+  size_t woff = 0;
+};
+
+void respond(Conn& c, int status, const std::string& body) {
+  const char* st = status == 200   ? "200 OK"
+                   : status == 400 ? "400 Bad Request"
+                   : status == 429 ? "429 Too Many Requests"
+                                   : "404 Not Found";
+  c.wbuf += "HTTP/1.1 ";
+  c.wbuf += st;
+  c.wbuf += "\r\nContent-Type: text/plain; charset=utf-8\r\nContent-Length: ";
+  c.wbuf += std::to_string(body.size());
+  c.wbuf += "\r\n\r\n";
+  c.wbuf += body;
+}
+
+// POST /take/:name?rate=F:D&count=N → 200/429 + remaining (api.go:51-86).
+void handle(Conn& c, const std::string& target) {
+  if (target.compare(0, 6, "/take/") != 0) {
+    respond(c, 404, "not found\n");
+    return;
+  }
+  size_t q = target.find('?');
+  std::string name = target.substr(6, q == std::string::npos ? q : q - 6);
+  if (name.size() > kMaxName) {  // api.go:55-58
+    respond(c, 400, "name too large\n");
+    return;
+  }
+  int64_t freq = 0, per = 0;
+  double count = 1;
+  if (q != std::string::npos) {
+    size_t p = q + 1;
+    while (p < target.size()) {
+      size_t e = target.find('&', p);
+      if (e == std::string::npos) e = target.size();
+      std::string kv = target.substr(p, e - p);
+      p = e + 1;
+      size_t eq = kv.find('=');
+      if (eq == std::string::npos) continue;
+      std::string key = kv.substr(0, eq), val = kv.substr(eq + 1);
+      if (key == "rate") {
+        // Parse errors silently ignored → zero rate → always 429
+        // (api.go:61-62, api_test.go:43-49).
+        int64_t f, pn;
+        if (pt_parse_rate(val.c_str(), &f, &pn) == 0) {
+          freq = f;
+          per = pn;
+        }
+      } else if (key == "count") {
+        char* end = nullptr;
+        unsigned long v = strtoul(val.c_str(), &end, 10);
+        if (end && *end == '\0' && end != val.c_str()) count = (double)v;
+      }
+    }
+  }
+  auto it = g_buckets.find(name);
+  if (it == g_buckets.end()) {  // get-or-create stamps created (repo.go:205)
+    it = g_buckets.emplace(name, Bucket{}).first;
+    it->second.created = now_ns();
+  }
+  double remaining = 0;
+  bool ok = take(it->second, now_ns(), freq, per, count, &remaining);
+  char body[32];
+  snprintf(body, sizeof(body), "%llu", (unsigned long long)remaining);
+  respond(c, ok ? 200 : 429, body);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  uint16_t port = argc > 1 ? (uint16_t)atoi(argv[1]) : 18900;
+  int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (bind(lfd, (sockaddr*)&addr, sizeof(addr)) < 0 || listen(lfd, 512) < 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  int ep = epoll_create1(0);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.fd = lfd;
+  epoll_ctl(ep, EPOLL_CTL_ADD, lfd, &ev);
+  std::unordered_map<int, Conn> conns;
+  printf("baseline serving on 127.0.0.1:%d\n", port);
+  fflush(stdout);
+
+  epoll_event evs[64];
+  char buf[65536];
+  while (true) {
+    int n = epoll_wait(ep, evs, 64, -1);
+    for (int i = 0; i < n; i++) {
+      int fd = evs[i].data.fd;
+      if (fd == lfd) {
+        while (true) {
+          int cfd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
+          if (cfd < 0) break;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          epoll_event cev{};
+          cev.events = EPOLLIN;
+          cev.data.fd = cfd;
+          epoll_ctl(ep, EPOLL_CTL_ADD, cfd, &cev);
+          conns[cfd];
+        }
+        continue;
+      }
+      Conn& c = conns[fd];
+      bool closed = false;
+      while (true) {
+        ssize_t rd = recv(fd, buf, sizeof(buf), 0);
+        if (rd == 0) closed = true;
+        if (rd <= 0) break;
+        c.rbuf.append(buf, rd);
+      }
+      // Parse pipelined requests (headers ignored beyond the request line;
+      // the load driver sends body-less POSTs like api_test.go does).
+      while (true) {
+        size_t he = c.rbuf.find("\r\n\r\n");
+        if (he == std::string::npos) break;
+        size_t eol = c.rbuf.find("\r\n");
+        std::string line = c.rbuf.substr(0, eol);
+        c.rbuf.erase(0, he + 4);
+        size_t s1 = line.find(' ');
+        size_t s2 = line.rfind(' ');
+        if (s1 == std::string::npos || s2 == s1) {
+          respond(c, 400, "bad request\n");
+          continue;
+        }
+        std::string method = line.substr(0, s1);
+        std::string target = line.substr(s1 + 1, s2 - s1 - 1);
+        if (method != "POST") {
+          respond(c, 404, "not found\n");
+          continue;
+        }
+        handle(c, target);
+      }
+      while (c.woff < c.wbuf.size()) {
+        ssize_t wr =
+            send(fd, c.wbuf.data() + c.woff, c.wbuf.size() - c.woff,
+                 MSG_NOSIGNAL);
+        if (wr <= 0) break;
+        c.woff += (size_t)wr;
+      }
+      epoll_event cev{};
+      cev.data.fd = fd;
+      if (c.woff >= c.wbuf.size()) {
+        c.wbuf.clear();
+        c.woff = 0;
+        cev.events = EPOLLIN;
+      } else {
+        cev.events = EPOLLIN | EPOLLOUT;  // flush resumes on writability
+      }
+      epoll_ctl(ep, EPOLL_CTL_MOD, fd, &cev);
+      if (closed) {
+        epoll_ctl(ep, EPOLL_CTL_DEL, fd, nullptr);
+        close(fd);
+        conns.erase(fd);
+      }
+    }
+  }
+}

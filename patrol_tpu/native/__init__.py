@@ -132,6 +132,14 @@ def load() -> Optional[ctypes.CDLL]:
             _i64p, ctypes.c_int64,
         ]
         lib.pt_dir_resolve.restype = ctypes.c_int64
+        lib.pt_rx_classify.argtypes = [
+            ctypes.c_int, ctypes.c_int, _u64p, _u8p, _i32p,
+            _f64p, _f64p, _u64p, _i64p, ctypes.c_int64,
+            _i64p, _i64p, _i64p, _u8p,
+            _i64p, _i32p, _i64p, ctypes.c_int64,
+            _i64p, _i64p, _i64p, _i64p, _u8p,
+        ]
+        lib.pt_rx_classify.restype = ctypes.c_int64
         lib.pt_dir_destroy.argtypes = [ctypes.c_int]
         lib.pt_dir_destroy.restype = ctypes.c_int
         lib.pt_http_blast.argtypes = [

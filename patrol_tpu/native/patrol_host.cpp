@@ -20,6 +20,7 @@
 
 #include <arpa/inet.h>
 #include <cerrno>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <fcntl.h>
@@ -509,6 +510,33 @@ int pt_dir_delete(int h, uint64_t hash, int32_t row) {
   return 0;
 }
 
+namespace {
+
+// One name resolve: probe + verify. Zero-padded 256B rows on both sides,
+// so comparing ceil(len/8) u64-words is exact name equality while touching
+// ≤1 cache line for typical short names (a full 256B memcmp pulls 4 lines
+// of the 1M-row name table per packet — the dominant resolve cost).
+inline int32_t ptdir_resolve_one(const PtDir* d, uint64_t hv,
+                                 const uint8_t* name_row, int32_t len) {
+  uint64_t pos = hv & d->mask;
+  for (int p = 0; p < d->maxprobe; p++) {
+    int32_t r = d->trow[pos];
+    if (r == -1) return -1;  // definite miss
+    if (r >= 0 && d->th[pos] == hv) {
+      if (d->name_lens[r] == len &&
+          std::memcmp(d->name_bytes + (size_t)r * kPacketSize, name_row,
+                      ((size_t)len + 7) & ~(size_t)7) == 0) {
+        return r;
+      }
+      return -1;  // verify-fail ⇒ miss (collision; slow path re-resolves)
+    }
+    pos = (pos + 1) & d->mask;
+  }
+  return -1;
+}
+
+}  // namespace
+
 // Batch resolve: rows_out[i] = row or -1 (miss/malformed). On a hit, pins
 // and last_used (Python-owned numpy buffers) are updated in place.
 // Returns the hit count.
@@ -522,26 +550,161 @@ int64_t pt_dir_resolve(int h, int n, const uint64_t* hashes,
   for (int i = 0; i < n; i++) {
     rows_out[i] = -1;
     if (lens[i] < 0) continue;
-    uint64_t hv = hashes[i];
-    uint64_t pos = hv & d->mask;
-    for (int p = 0; p < d->maxprobe; p++) {
-      int32_t r = d->trow[pos];
-      if (r == -1) break;  // definite miss
-      if (r >= 0 && d->th[pos] == hv) {
-        // Hash routes, bytes confirm: zero-padded 256B rows on both
-        // sides, so one fixed-size memcmp is exact name equality.
-        if (d->name_lens[r] == lens[i] &&
-            std::memcmp(d->name_bytes + (size_t)r * kPacketSize,
-                        name_buf + (size_t)i * kPacketSize,
-                        kPacketSize) == 0) {
-          rows_out[i] = r;
-          pins[r]++;
-          last_used[r] = now;
-          hits++;
-        }
-        break;  // verify-fail ⇒ miss (collision; slow path re-resolves)
+    int32_t r =
+        ptdir_resolve_one(d, hashes[i], name_buf + (size_t)i * kPacketSize,
+                          lens[i]);
+    if (r >= 0) {
+      rows_out[i] = r;
+      pins[r]++;
+      last_used[r] = now;
+      hits++;
+    }
+  }
+  return hits;
+}
+
+namespace {
+
+// float64 wire tokens → int64 nanotokens; MUST stay bit-identical to
+// ops/wire.py sanitize_nt_array (NaN → 0, ≤0 → 0, ≥2^63 clamps to the
+// int64 edge, round-half-even like np.rint — nearbyint under the default
+// FE_TONEAREST mode). Native-rx and python-rx peers must merge the same
+// packet to the same state or replicas diverge permanently.
+inline int64_t sanitize_nt(double tokens) {
+  if (!(tokens > 0.0)) return 0;  // NaN fails the comparison, like numpy
+  double nt = tokens * 1e9;
+  if (nt >= 9223372036854775808.0) return INT64_MAX;  // +Inf / overflow
+  return (int64_t)std::nearbyint(nt);
+}
+
+}  // namespace
+
+// Fused rx fast path: resolve + sanitize + wire-semantics classification
+// in one pass over a decoded batch — the python side of this
+// (engine._classify_queue_chunk's ~20 numpy array passes) was the feed
+// bottleneck at ~500 ns/delta (BENCH r2: feed 6.76 s of a ~10 s replay).
+//
+// Two passes: (1) resolve rows (pinning hits) and adopt wire capacities,
+// so a v1 delta EARLIER in the batch than a cap-carrying delta for the
+// same row still sees the base (order parity with the batch-wide numpy
+// adopt); (2) sanitize + classify.
+//
+// rows_out[i]: ≥0 = resolved row (PINNED — ownership passes to the queued
+// chunk); -1 = miss (python binds + classifies the leftover subset);
+// -2 = invalid (negative len / slot out of range), not pinned.
+// out_scalar[i]: 0 = exact lane merge; 1 = scalar (deficit-attribution)
+// merge; 2 = v1 delta whose row capacity was 0 at classify time — python
+// re-checks after binding misses (which may adopt caps) and drops the
+// still-unknown ones. Must be called under the directory lock.
+int64_t pt_rx_classify(int h, int n, const uint64_t* hashes,
+                       const uint8_t* name_buf, const int32_t* lens,
+                       const double* added_f, const double* taken_f,
+                       const uint64_t* elapsed_u, const int64_t* slots_in,
+                       int64_t max_slots, const int64_t* caps,
+                       const int64_t* lane_a, const int64_t* lane_t,
+                       const uint8_t* no_trailer, int64_t* cap_base,
+                       int32_t* pins, int64_t* last_used, int64_t now,
+                       int64_t* rows_out, int64_t* out_added,
+                       int64_t* out_taken, int64_t* out_elapsed,
+                       uint8_t* out_scalar) {
+  PtDir* d = g_dirs[h];
+  if (!d) return -EBADF;
+  int64_t hits = 0;
+  // Block-staged resolve with software prefetch: at 1M rows every probe,
+  // name verify, and pin touch is a DRAM miss (~200 ns/packet measured
+  // serial); staging (positions → probe → verify) overlaps the misses.
+  constexpr int kBlk = 256;
+  uint64_t pos[kBlk];
+  int32_t cand[kBlk];
+  for (int b0 = 0; b0 < n; b0 += kBlk) {
+    int b1 = b0 + kBlk < n ? b0 + kBlk : n;
+    for (int i = b0; i < b1; i++) {
+      out_scalar[i] = 0;
+      // rows_out arrives as uninitialized np.empty storage — write every
+      // entry here (the later passes branch on it).
+      if (lens[i] < 0 || slots_in[i] < 0 || slots_in[i] >= max_slots) {
+        rows_out[i] = -2;
+        continue;
       }
-      pos = (pos + 1) & d->mask;
+      rows_out[i] = -1;
+      uint64_t p = hashes[i] & d->mask;
+      pos[i - b0] = p;
+      __builtin_prefetch(&d->th[p]);
+      __builtin_prefetch(&d->trow[p]);
+    }
+    // Probe: first slot whose hash matches (byte verify deferred) or -1.
+    for (int i = b0; i < b1; i++) {
+      if (rows_out[i] == -2) continue;
+      uint64_t hv = hashes[i];
+      uint64_t p = pos[i - b0];
+      int32_t c = -1;
+      for (int pr = 0; pr < d->maxprobe; pr++) {
+        int32_t r = d->trow[p];
+        if (r == -1) break;
+        if (r >= 0 && d->th[p] == hv) {
+          c = r;
+          break;
+        }
+        p = (p + 1) & d->mask;
+      }
+      cand[i - b0] = c;
+      if (c >= 0) {
+        __builtin_prefetch(d->name_bytes + (size_t)c * kPacketSize);
+        __builtin_prefetch(&d->name_lens[c]);
+        __builtin_prefetch(&pins[c], 1);
+        __builtin_prefetch(&cap_base[c], 1);
+      }
+    }
+    // Verify bytes, pin, adopt wire capacities.
+    for (int i = b0; i < b1; i++) {
+      if (rows_out[i] == -2) continue;
+      int32_t r = cand[i - b0];
+      if (r >= 0 && d->name_lens[r] == lens[i] &&
+          std::memcmp(d->name_bytes + (size_t)r * kPacketSize,
+                      name_buf + (size_t)i * kPacketSize,
+                      ((size_t)lens[i] + 7) & ~(size_t)7) == 0) {
+        rows_out[i] = r;
+        pins[r]++;
+        last_used[r] = now;
+        hits++;
+        if (caps[i] > 0 && cap_base[r] == 0) cap_base[r] = caps[i];
+      } else {
+        rows_out[i] = -1;  // miss or collision: python slow path
+      }
+    }
+  }
+  for (int i = 0; i < n; i++) {
+    int64_t r = rows_out[i];
+    if (r < 0) continue;
+    int64_t a = sanitize_nt(added_f[i]);
+    int64_t t = sanitize_nt(taken_f[i]);
+    int64_t e = (int64_t)elapsed_u[i];
+    out_elapsed[i] = e < 0 ? 0 : e;
+    if (caps[i] >= 0) {
+      if (lane_a[i] >= 0 && lane_t[i] >= 0) {
+        out_added[i] = lane_a[i];  // exact PN lane values (lane trailer)
+        out_taken[i] = lane_t[i];
+      } else {
+        a -= caps[i];  // aggregate header minus wire cap
+        out_added[i] = a < 0 ? 0 : a;
+        out_taken[i] = t;
+        out_scalar[i] = 1;
+      }
+    } else if (no_trailer[i]) {
+      int64_t base = cap_base[r];
+      if (base == 0) {
+        out_added[i] = a;  // python re-checks after miss binds adopt caps
+        out_taken[i] = t;
+        out_scalar[i] = 2;
+      } else {
+        a -= base;
+        out_added[i] = a < 0 ? 0 : a;
+        out_taken[i] = t;
+        out_scalar[i] = 1;
+      }
+    } else {
+      out_added[i] = a;  // base-trailer peer: raw own-lane header
+      out_taken[i] = t;
     }
   }
   return hits;

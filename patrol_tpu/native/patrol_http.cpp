@@ -846,12 +846,27 @@ int pt_http_stop(int h) {
 // `pipeline` requests in flight, for `duration_ms`. A C++ client is the
 // only way to measure the server on a 1-core box — a Python client costs
 // more per request than the C++ front does and dominates the machine.
+// `target` may be a single path or many paths joined by '\n'; requests
+// cycle through them round-robin (how the zipf multi-bucket workloads
+// are driven: the caller pre-samples the key distribution into paths).
 // out3 = {requests_completed, p50_ns, p99_ns} (latency per response at
 // pipeline depth, i.e. includes queueing behind the pipeline window).
 int pt_http_blast(const char* ip, uint16_t port, const char* target,
                   int conns, int pipeline, int duration_ms, uint64_t* out3) {
-  std::string req = std::string("POST ") + target +
-                    " HTTP/1.1\r\nHost: x\r\n\r\n";
+  std::vector<std::string> reqs;
+  {
+    const char* t = target;
+    while (*t) {
+      const char* e = strchr(t, '\n');
+      size_t len = e ? (size_t)(e - t) : strlen(t);
+      if (len)
+        reqs.push_back("POST " + std::string(t, len) +
+                       " HTTP/1.1\r\nHost: x\r\n\r\n");
+      t += len + (e ? 1 : 0);
+    }
+  }
+  if (reqs.empty()) return -EINVAL;
+  size_t req_rr = 0;
   struct CC {
     int fd = -1;
     std::string rbuf;
@@ -893,7 +908,7 @@ int pt_http_blast(const char* ip, uint16_t port, const char* target,
     // partial non-blocking send must never splice the NEXT request into
     // the middle of a half-written one.
     while (c.inflight < pipeline) {
-      c.wpend += req;
+      c.wpend += reqs[req_rr++ % reqs.size()];
       c.inflight++;
       c.sent.push_back(now());
     }

@@ -127,6 +127,8 @@ class API:
             index = (
                 "patrol_tpu debug index\n\n"
                 "/debug/pprof/profile?seconds=N  sampling CPU profile, pprof protobuf (&debug=1 for text)\n"
+                "/debug/pprof/mutex              lock-contention profile, pprof protobuf (&debug=1 for text)\n"
+                "/debug/pprof/block              condition-wait profile, pprof protobuf (&debug=1 for text)\n"
                 "/debug/pprof/goroutine          thread stack dump\n"
                 "/debug/pprof/heap               allocation summary\n"
                 "/debug/pprof/allocs             allocation summary\n"
@@ -148,8 +150,19 @@ class API:
             return 200, raw, "application/octet-stream"
         if path in ("/debug/pprof/goroutine", "/debug/pprof/threadcreate"):
             return 200, profiling.thread_dump().encode(), "text/plain"
-        if path in ("/debug/pprof/heap", "/debug/pprof/allocs", "/debug/pprof/block", "/debug/pprof/mutex"):
+        if path in ("/debug/pprof/heap", "/debug/pprof/allocs"):
             return 200, profiling.heap_summary().encode(), "text/plain"
+        if path in ("/debug/pprof/mutex", "/debug/pprof/block"):
+            # REAL contention profiles (≙ main.go:24's mutex fraction +
+            # api.go:29-39 routes): wait-time sampling around the engine/
+            # directory locks and condition parks, as pprof protobuf.
+            reg = profiling.REGISTRY
+            mutex = path.endswith("mutex")
+            if q.get("debug", ["0"])[0] not in ("0", ""):
+                text = reg.mutex_text() if mutex else reg.block_text()
+                return 200, text.encode(), "text/plain"
+            raw = reg.mutex_pprof() if mutex else reg.block_pprof()
+            return 200, raw, "application/octet-stream"
         if path == "/debug/jax/trace":
             seconds = float(q.get("seconds", ["2"])[0])
             out = await loop.run_in_executor(None, profiling.jax_trace, seconds)
@@ -204,6 +217,10 @@ class _HTTPProtocol(asyncio.Protocol):
         # FIFO lock: pipelined requests are handled concurrently but their
         # responses are written in request order.
         self._write_order = asyncio.Lock()
+        # In-flight HTTP/1.1 responses (scheduled, not yet written): an
+        # h2c Upgrade must be refused while any are pending, or the 101 +
+        # h2 frames would interleave with their HTTP/1.1 bytes.
+        self._h1_inflight = 0
 
     def connection_made(self, transport) -> None:
         self.transport = transport
@@ -252,6 +269,9 @@ class _HTTPProtocol(asyncio.Protocol):
                 return
             clen = 0
             keep_alive = True
+            conn_upgrade = False
+            upgrade_h2c = False
+            h2_settings = None
             for line in lines[1:]:
                 low = line.lower()
                 if low.startswith(b"content-length:"):
@@ -259,11 +279,54 @@ class _HTTPProtocol(asyncio.Protocol):
                         clen = int(line.split(b":", 1)[1])
                     except ValueError:
                         clen = 0
-                elif low.startswith(b"connection:") and b"close" in low:
-                    keep_alive = False
-            self._body_to_skip = clen
+                elif low.startswith(b"connection:"):
+                    if b"close" in low:
+                        keep_alive = False
+                    if b"upgrade" in low:
+                        conn_upgrade = True
+                elif low.startswith(b"upgrade:") and b"h2c" in low.split(b":", 1)[1]:
+                    upgrade_h2c = True
+                elif low.startswith(b"http2-settings:"):
+                    h2_settings = line.split(b":", 1)[1].strip()
             path, _, query = target.partition("?")
+            # h2c Upgrade (RFC 7540 §3.2 ≙ h2c.NewHandler's second mode,
+            # command.go:41-44): 101, then h2 with the upgrade request as
+            # stream 1 (half-closed remote). Requests with bodies keep
+            # HTTP/1.1 — /take carries its input in the URL.
+            if conn_upgrade and upgrade_h2c and clen == 0 and self._h1_inflight == 0:
+                from patrol_tpu.net import h2 as h2mod
+
+                if h2mod.available():
+                    self._upgrade_h2c(method, path, query, h2_settings)
+                    return
+            self._body_to_skip = clen
+            self._h1_inflight += 1
             asyncio.ensure_future(self._respond(method, path, query, keep_alive))
+
+    def _upgrade_h2c(self, method: str, path: str, query: str, h2_settings) -> None:
+        from patrol_tpu.net import h2 as h2mod
+
+        self.transport.write(
+            b"HTTP/1.1 101 Switching Protocols\r\n"
+            b"Connection: Upgrade\r\nUpgrade: h2c\r\n\r\n"
+        )
+        self._h2 = h2mod.H2Connection(self._on_h2_request)
+        if h2_settings:
+            import base64
+
+            try:  # §3.2.1: base64url-encoded SETTINGS payload
+                pad = b"=" * (-len(h2_settings) % 4)
+                self._h2.apply_upgrade_settings(
+                    base64.urlsafe_b64decode(h2_settings + pad)
+                )
+            except ValueError:
+                pass  # malformed settings: keep defaults
+        # Server preface SETTINGS must precede the stream-1 response (§3.2).
+        self.transport.write(self._h2.start())
+        self._on_h2_request(1, method, path, query)
+        pending, self.buf = self.buf, b""
+        if pending:
+            self._feed_h2(pending)
 
     def _feed_h2(self, data: bytes) -> None:
         try:
@@ -293,6 +356,14 @@ class _HTTPProtocol(asyncio.Protocol):
         self.transport.write(self._h2.send_response(stream_id, status, body, ctype))
 
     async def _respond(self, method: str, path: str, query: str, keep_alive: bool) -> None:
+        try:
+            await self._respond_inner(method, path, query, keep_alive)
+        finally:
+            self._h1_inflight -= 1
+
+    async def _respond_inner(
+        self, method: str, path: str, query: str, keep_alive: bool
+    ) -> None:
         async with self._write_order:
             try:
                 status, body, ctype = await self.api.handle(method, path, query)

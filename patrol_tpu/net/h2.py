@@ -236,16 +236,28 @@ class H2Connection:
 
     # -- input --------------------------------------------------------------
 
+    def start(self) -> bytes:
+        """The server connection preface (one SETTINGS frame, §3.4) —
+        emitted by the first :meth:`receive`, or eagerly by the h2c
+        Upgrade path (§3.2: the server's first h2 frame MUST be SETTINGS,
+        and it must hit the wire before the stream-1 response).
+        Advertises MAX_CONCURRENT_STREAMS explicitly: some clients
+        (curl/nghttp2) treat an absent value as "don't reuse this
+        connection" when deciding whether to multiplex."""
+        if self.sent_settings:
+            return b""
+        self.sent_settings = True
+        settings = struct.pack(">HI", 0x3, 256) + struct.pack(">HI", 0x4, 1 << 20)
+        return frame(SETTINGS, 0, 0, settings)
+
+    def apply_upgrade_settings(self, payload: bytes) -> None:
+        """Apply the decoded ``HTTP2-Settings`` header of an h2c Upgrade
+        request (§3.2.1: its payload is a SETTINGS frame body)."""
+        self._apply_settings(payload)
+
     def receive(self, data: bytes) -> bytes:
         self.buf += data
-        out = bytearray()
-        if not self.sent_settings:
-            # Advertise MAX_CONCURRENT_STREAMS explicitly: some clients
-            # (curl/nghttp2) treat an absent value as "don't reuse this
-            # connection" when deciding whether to multiplex.
-            settings = struct.pack(">HI", 0x3, 256) + struct.pack(">HI", 0x4, 1 << 20)
-            out += frame(SETTINGS, 0, 0, settings)
-            self.sent_settings = True
+        out = bytearray(self.start())
         if not self.preface_done:
             if len(self.buf) < len(PREFACE):
                 return bytes(out)

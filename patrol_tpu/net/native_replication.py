@@ -144,21 +144,10 @@ class NativeReplicator:
                     slots[sel] = -1 if resolved is None else resolved
                 unresolved = need & (slots < 0)
                 self.rx_errors += int(unresolved.sum())
-            slots[~deltas] = -1  # engine's keep-filter drops these
+            slots[~deltas] = -1  # the classify keep-filter drops these
             if deltas.any():
-                self.repo.engine.ingest_deltas_batch_raw(
-                    n,
-                    dbuf.names,
-                    dbuf.name_lens,
-                    dbuf.hashes,
-                    slots,
-                    wire.sanitize_nt_array(dbuf.added[:n]),
-                    wire.sanitize_nt_array(dbuf.taken[:n]),
-                    np.maximum(dbuf.elapsed[:n].astype(np.int64), 0),
-                    dbuf.caps[:n],
-                    dbuf.lane_a[:n],
-                    dbuf.lane_t[:n],
-                    no_trailer,
+                self.repo.engine.ingest_wire_batch(
+                    dbuf, n, slots, no_trailer.astype(np.uint8)
                 )
             if multi2.any():
                 for i in np.flatnonzero(multi2):

@@ -71,7 +71,11 @@ class BucketDirectory:
 
     def __init__(self, capacity: int):
         self.capacity = capacity
-        self._mu = threading.Lock()
+        # Profiled: feeder-vs-rx contention on this one lock is the
+        # directory's scaling risk — surfaced at /debug/pprof/mutex.
+        from patrol_tpu.utils import profiling
+
+        self._mu = profiling.ProfiledLock("directory")
         self._rows: Dict[str, int] = {}
         self._names: list = [None] * capacity
         self._next_fresh = 0  # bump allocator; recycling kicks in when spent
@@ -313,6 +317,64 @@ class BucketDirectory:
                 self.last_used_ns[fr] = now_ns
                 np.add.at(self.pins, fr, 1)
         return rows
+
+    def rx_classify(
+        self,
+        n: int,
+        hashes: np.ndarray,
+        name_buf: np.ndarray,
+        name_lens: np.ndarray,
+        added_f: np.ndarray,
+        taken_f: np.ndarray,
+        elapsed_u: np.ndarray,
+        slots: np.ndarray,
+        max_slots: int,
+        caps: np.ndarray,
+        lane_a: np.ndarray,
+        lane_t: np.ndarray,
+        no_trailer: np.ndarray,
+        now_ns: int,
+    ):
+        """Fused resolve + sanitize + wire-classify over a decoded batch
+        (pt_rx_classify): ONE native call replaces the lookup + ~20 numpy
+        array passes of the python classify path. Returns
+        ``(rows, added_nt, taken_nt, elapsed_ns, scalar_code)`` or ``None``
+        when the native table is unavailable (caller uses the numpy path).
+        Row codes: ≥0 resolved+PINNED, −1 miss, −2 invalid; scalar codes:
+        0 lane merge, 1 scalar merge, 2 v1-with-unknown-cap (caller
+        re-checks after binding misses)."""
+        # Allocations and dtype/contiguity conversions happen OUTSIDE the
+        # critical section — only the handle check and the native call
+        # touch lock-protected state, and this lock is exactly the
+        # feeder-vs-rx contention point the mutex profile watches.
+        rows = np.empty(n, np.int64)
+        out_a = np.empty(n, np.int64)
+        out_t = np.empty(n, np.int64)
+        out_e = np.empty(n, np.int64)
+        out_s = np.empty(n, np.uint8)
+        args = (
+            np.ascontiguousarray(hashes[:n], np.uint64),
+            np.ascontiguousarray(name_buf[:n], np.uint8),
+            np.ascontiguousarray(name_lens[:n], np.int32),
+            np.ascontiguousarray(added_f[:n], np.float64),
+            np.ascontiguousarray(taken_f[:n], np.float64),
+            np.ascontiguousarray(elapsed_u[:n], np.uint64),
+            np.ascontiguousarray(slots[:n], np.int64),
+            max_slots,
+            np.ascontiguousarray(caps[:n], np.int64),
+            np.ascontiguousarray(lane_a[:n], np.int64),
+            np.ascontiguousarray(lane_t[:n], np.int64),
+            np.ascontiguousarray(no_trailer[:n], np.uint8),
+        )
+        with self._mu:
+            if self._ptlib is None or self._closed:
+                return None
+            self._ptlib.pt_rx_classify(
+                self._ptdir, n, *args,
+                self.cap_base_nt, self.pins, self.last_used_ns, now_ns,
+                rows, out_a, out_t, out_e, out_s,
+            )
+        return rows, out_a, out_t, out_e, out_s
 
     def __len__(self) -> int:
         return len(self._rows)

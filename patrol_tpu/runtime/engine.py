@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from patrol_tpu.models.limiter import NANO, LimiterConfig, LimiterState, init_state
+from patrol_tpu.utils import profiling
 from patrol_tpu.ops import wire
 from patrol_tpu.ops.merge import (
     MergeBatch,
@@ -58,7 +59,12 @@ log = logging.getLogger("patrol.engine")
 # Per-tick caps: at most this many take rows / merge rows per device call;
 # the rest stays queued for the next tick (the loop runs back-to-back).
 MAX_TAKE_ROWS = 4096
-MAX_MERGE_ROWS = 8192
+# Merge rows per engine tick. Bigger ticks amortize per-dispatch cost
+# (decisive on remote-execute transports: the axon tunnel charges ~60 ms
+# per execute regardless of kernel size) at the price of one compiled
+# variant per power-of-two up to the cap; the env knob lets the replay
+# bench trade warmup variants for tick size without forking the engine.
+MAX_MERGE_ROWS = int(os.environ.get("PATROL_MAX_MERGE_ROWS", 8192))
 
 BroadcastFn = Callable[[List[wire.WireState]], None]
 
@@ -280,10 +286,13 @@ class DeviceEngine:
         self.directory = BucketDirectory(config.buckets)
         self.state: LimiterState = init_state(config, device=device)
 
-        self._cond = threading.Condition()
+        # Profiled sync primitives: contended-acquire wait time and
+        # condition park time feed the REAL /debug/pprof/mutex and /block
+        # profiles (≙ runtime.SetMutexProfileFraction(50), main.go:24).
+        self._cond = profiling.ProfiledCondition("engine.work")
         # Kernel calls donate the state buffers (zero-copy update); this lock
         # keeps introspection readers off a donated-and-deleted array.
-        self._state_mu = threading.Lock()
+        self._state_mu = profiling.ProfiledLock("engine.state")
         # Serializes evictions (pick victims → zero device rows → recycle);
         # concurrent assigners that hit a spent pool queue up behind it.
         self._evict_mu = threading.Lock()
@@ -304,7 +313,7 @@ class DeviceEngine:
         # so the overlap roughly doubles sustained tick rate. Bounded so a
         # slow completer back-pressures the feeder instead of buffering
         # unboundedly.
-        self._pcond = threading.Condition()
+        self._pcond = profiling.ProfiledCondition("engine.completion")
         self._pending: deque = deque()
         self._completing = False
         self._feeder_done = False
@@ -706,19 +715,10 @@ class DeviceEngine:
             miss = np.flatnonzero(rows < 0)
             if miss.size:
                 mi = idx[miss]
-                miss_names = [
-                    bytes(name_buf[i, : name_lens[i]]).decode(
-                        "utf-8", "surrogateescape"
-                    )
-                    for i in mi
-                ]
-                miss_rows = self._assign_many_pinned_wire(
-                    miss_names, name_buf[mi], name_lens[mi], name_hashes[mi], now
+                miss_rows = self._bind_wire_misses_pinned(
+                    name_buf, name_lens, name_hashes, mi, now
                 )
                 if miss_rows is None:
-                    log.warning(
-                        "pool spent (all pinned); %d deltas dropped", miss.size
-                    )
                     hit = rows >= 0
                     idx, rows = idx[hit], rows[hit]
                     if not idx.size:
@@ -736,6 +736,113 @@ class DeviceEngine:
                 lane_taken_nt[idx],
                 scalar[idx],
             )
+        return accepted
+
+    def _bind_wire_misses_pinned(
+        self,
+        name_buf: np.ndarray,
+        name_lens: np.ndarray,
+        hashes: np.ndarray,
+        mi: np.ndarray,
+        now: int,
+    ) -> Optional[np.ndarray]:
+        """Shared miss protocol of the wire ingest paths: materialize the
+        first-seen names (the one place the rx path creates Python
+        strings), bind + pin via the wire bind path. None ⇒ pool spent
+        (logged); callers drop those deltas."""
+        miss_names = [
+            bytes(name_buf[i, : name_lens[i]]).decode("utf-8", "surrogateescape")
+            for i in mi
+        ]
+        rows = self._assign_many_pinned_wire(
+            miss_names, name_buf[mi], name_lens[mi], hashes[mi], now
+        )
+        if rows is None:
+            log.warning("pool spent (all pinned); %d deltas dropped", mi.size)
+        return rows
+
+    def ingest_wire_batch(
+        self,
+        dbuf,
+        n: int,
+        slots: np.ndarray,
+        no_trailer: np.ndarray,
+    ) -> int:
+        """The native rx loop's fused fast path: raw decode buffers
+        (native.DecodeBuffers — float64 wire headers, zero-padded name
+        rows, FNV hashes) → classified device queue in ONE native call
+        (pt_rx_classify: resolve + sanitize + wire-semantics classify).
+        Python touches only the leftovers: directory misses (bound via the
+        wire bind path, classified by the numpy tail) and v1 deltas whose
+        row capacity was unknown at native classify time. Falls back to
+        :meth:`ingest_deltas_batch_raw` when the native table is absent.
+        BENCH r2/r3 motivation: the numpy classify tail cost ~500 ns/delta
+        and capped host ingest around 1M deltas/s (VERDICT r2 item 2)."""
+        now = self.clock()
+        slots = np.ascontiguousarray(slots[:n], np.int64)
+        res = self.directory.rx_classify(
+            n, dbuf.hashes, dbuf.names, dbuf.name_lens, dbuf.added,
+            dbuf.taken, dbuf.elapsed, slots, self.config.nodes,
+            dbuf.caps, dbuf.lane_a, dbuf.lane_t, no_trailer, now,
+        )
+        if res is None:
+            return self.ingest_deltas_batch_raw(
+                n, dbuf.names, dbuf.name_lens, dbuf.hashes, slots,
+                wire.sanitize_nt_array(dbuf.added[:n]),
+                wire.sanitize_nt_array(dbuf.taken[:n]),
+                np.maximum(dbuf.elapsed[:n].astype(np.int64), 0),
+                dbuf.caps[:n], dbuf.lane_a[:n], dbuf.lane_t[:n],
+                no_trailer[:n].astype(bool),
+            )
+        rows, out_a, out_t, out_e, out_s = res
+        accepted = 0
+        miss = rows == -1
+        if miss.any():
+            # First sight of these buckets (once per bucket lifetime):
+            # bind, then classify through the numpy tail.
+            mi = np.flatnonzero(miss)
+            miss_rows = self._bind_wire_misses_pinned(
+                dbuf.names, dbuf.name_lens, dbuf.hashes, mi, now
+            )
+            if miss_rows is not None:
+                accepted += self._classify_queue_chunk(
+                    miss_rows,
+                    slots[mi],
+                    wire.sanitize_nt_array(dbuf.added[mi]),
+                    wire.sanitize_nt_array(dbuf.taken[mi]),
+                    np.maximum(dbuf.elapsed[mi].astype(np.int64), 0),
+                    dbuf.caps[mi],
+                    dbuf.lane_a[mi],
+                    dbuf.lane_t[mi],
+                    no_trailer[mi].astype(bool),
+                )
+        live = rows >= 0
+        recheck = live & (out_s == 2)
+        if recheck.any():
+            # v1 deltas on rows whose capacity was 0 during the native
+            # pass; the miss binds above may have adopted caps since.
+            idx2 = np.flatnonzero(recheck)
+            base = self.directory.cap_base_nt[rows[idx2]]
+            known = base > 0
+            ki = idx2[known]
+            out_a[ki] = np.maximum(out_a[ki] - base[known], 0)
+            out_s[ki] = 1
+            drop = idx2[~known]
+            if drop.size:
+                self._scalar_dropped += int(drop.size)
+                self.directory.unpin_rows(rows[drop])
+                live[drop] = False
+        idx = np.flatnonzero(live)
+        for lo in range(0, len(idx), MAX_MERGE_ROWS):
+            sl = idx[lo : lo + MAX_MERGE_ROWS]
+            chunk = _DeltaChunk(
+                rows[sl], slots[sl], out_a[sl], out_t[sl], out_e[sl],
+                out_s[sl] == 1,
+            )
+            with self._cond:
+                self._deltas.append(chunk)
+                self._cond.notify()
+            accepted += chunk.n
         return accepted
 
     def read_rows(self, rows) -> tuple:

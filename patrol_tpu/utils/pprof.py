@@ -51,22 +51,16 @@ def _value_type(type_idx: int, unit_idx: int) -> bytes:
     return _field_varint(1, type_idx) + _field_varint(2, unit_idx)
 
 
-def build_profile(
-    stacks: Counter,
+def build_profile_values(
+    samples: Dict[tuple, Tuple[int, ...]],
     period_ns: int,
     duration_ns: int,
-    sample_type: Tuple[Tuple[str, str], ...] = (
-        ("samples", "count"),
-        ("cpu", "nanoseconds"),
-    ),
+    sample_type: Tuple[Tuple[str, str], ...],
+    period_type: Tuple[str, str] = ("cpu", "nanoseconds"),
 ) -> bytes:
-    """Encode sampled stacks as a gzipped pprof Profile.
-
-    Each stack's values are ``[count, count * period_ns]`` matching the
-    default ``(samples/count, cpu/nanoseconds)`` sample types — the shape
-    Go's sampled CPU profile uses, so pprof's top/graph/flame views all
-    aggregate correctly.
-    """
+    """Encode stack → value-tuple samples as a gzipped pprof Profile —
+    the general writer behind the CPU, mutex, and block profiles. Each
+    value tuple must be parallel to ``sample_type``."""
     strings: Dict[str, int] = {"": 0}
 
     def s(v: str) -> int:
@@ -101,11 +95,11 @@ def build_profile(
         return lid
 
     sample_msgs = []
-    for stack, count in stacks.most_common():
+    for stack, values in samples.items():
         loc_ids = b"".join(_varint(location_id(f)) for f in stack)
-        values = _varint(count) + _varint(count * period_ns)
+        packed = b"".join(_varint(v) for v in values)
         # location_id (field 1) and value (field 2) are packed repeated.
-        sample_msgs.append(_field_bytes(1, loc_ids) + _field_bytes(2, values))
+        sample_msgs.append(_field_bytes(1, loc_ids) + _field_bytes(2, packed))
 
     out = bytearray()
     for t, u in sample_type:
@@ -121,6 +115,33 @@ def build_profile(
         out += _field_bytes(6, v.encode("utf-8", errors="replace"))
     out += _field_varint(9, time.time_ns())
     out += _field_varint(10, duration_ns)
-    out += _field_bytes(11, _value_type(s("cpu"), s("nanoseconds")))
+    out += _field_bytes(11, _value_type(s(period_type[0]), s(period_type[1])))
     out += _field_varint(12, period_ns)
     return gzip.compress(bytes(out))
+
+
+def build_profile(
+    stacks: Counter,
+    period_ns: int,
+    duration_ns: int,
+    sample_type: Tuple[Tuple[str, str], ...] = (
+        ("samples", "count"),
+        ("cpu", "nanoseconds"),
+    ),
+) -> bytes:
+    """Encode sampled stacks as a gzipped pprof Profile.
+
+    Each stack's values are ``[count, count * period_ns]`` matching the
+    default ``(samples/count, cpu/nanoseconds)`` sample types — the shape
+    Go's sampled CPU profile uses, so pprof's top/graph/flame views all
+    aggregate correctly.
+    """
+    return build_profile_values(
+        {
+            stack: (count, count * period_ns)
+            for stack, count in stacks.most_common()
+        },
+        period_ns=period_ns,
+        duration_ns=duration_ns,
+        sample_type=sample_type,
+    )

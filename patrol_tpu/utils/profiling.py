@@ -93,6 +93,181 @@ class SamplingProfiler:
         return "\n".join(lines) + "\n"
 
 
+class ContentionRegistry:
+    """Process-wide lock/block contention accounting — the real
+    ``/debug/pprof/mutex`` and ``/block`` (VERDICT r2 item 5; reference:
+    ``runtime.SetMutexProfileFraction(50)`` at main.go:24, routes at
+    api.go:29-39). Two event classes, matching Go's split:
+
+    * **mutex** — time a thread spent WAITING to acquire a lock another
+      thread held (recorded by :class:`ProfiledLock`);
+    * **block** — time a thread spent parked in a condition wait
+      (:class:`ProfiledCondition`), Go's block-profile class.
+
+    ``fraction`` subsamples events Go-style (stack walks are the
+    expensive part); the default records every event — a contended
+    acquire already paid a wait that dwarfs the ~µs stack walk, and at
+    rate-limiter tick rates (kHz, not MHz) full recording is noise-level
+    overhead. Raise it for pathologically contended deployments."""
+
+    def __init__(self, fraction: int = 1):
+        self.fraction = max(1, fraction)
+        self._mu = threading.Lock()
+        # stack tuple -> [contentions, delay_ns]
+        self._mutex: Dict[tuple, list] = {}
+        self._block: Dict[tuple, list] = {}
+        self._mutex_events = 0
+        self._block_events = 0
+
+    @staticmethod
+    def _caller_stack(skip: int) -> tuple:
+        stack = []
+        f = sys._getframe(skip)
+        while f is not None and len(stack) < 24:
+            code = f.f_code
+            stack.append((code.co_qualname, code.co_filename, f.f_lineno))
+            f = f.f_back
+        return tuple(stack)
+
+    def _record(self, table: Dict[tuple, list], nth: int, name: str, wait_ns: int) -> None:
+        if nth % self.fraction:
+            return
+        # The lock name leads the stack so pprof's top view groups by
+        # which lock contended, then by waiter call site.
+        stack = ((name, "<lock>", 0),) + self._caller_stack(3)
+        with self._mu:
+            entry = table.get(stack)
+            if entry is None:
+                table[stack] = [1, wait_ns]
+            else:
+                entry[0] += 1
+                entry[1] += wait_ns
+
+    def record_mutex(self, name: str, wait_ns: int) -> None:
+        self._mutex_events += 1  # benign race: stat, not invariant
+        self._record(self._mutex, self._mutex_events, name, wait_ns)
+
+    def record_block(self, name: str, wait_ns: int) -> None:
+        self._block_events += 1
+        self._record(self._block, self._block_events, name, wait_ns)
+
+    def _pprof(self, table: Dict[tuple, list], kind: str) -> bytes:
+        from patrol_tpu.utils.pprof import build_profile_values
+
+        with self._mu:
+            samples = {
+                stack: (c * self.fraction, d * self.fraction)
+                for stack, (c, d) in table.items()
+            }
+        return build_profile_values(
+            samples,
+            period_ns=self.fraction,
+            duration_ns=0,
+            sample_type=(("contentions", "count"), ("delay", "nanoseconds")),
+            period_type=(kind, "count"),
+        )
+
+    def mutex_pprof(self) -> bytes:
+        return self._pprof(self._mutex, "contentions")
+
+    def block_pprof(self) -> bytes:
+        return self._pprof(self._block, "contentions")
+
+    def _text(self, table: Dict[tuple, list], title: str) -> str:
+        with self._mu:
+            rows = sorted(table.items(), key=lambda kv: -kv[1][1])
+        lines = [f"{title}: {len(rows)} contended sites (1/{self.fraction} sampled)"]
+        for stack, (c, d) in rows[:30]:
+            where = " <- ".join(f"{f[0]}" for f in stack[:4])
+            lines.append(
+                f"{c * self.fraction:8d} waits  {d * self.fraction / 1e6:10.2f} ms  {where}"
+            )
+        return "\n".join(lines) + "\n"
+
+    def mutex_text(self) -> str:
+        return self._text(self._mutex, "mutex contention")
+
+    def block_text(self) -> str:
+        return self._text(self._block, "block (condition-wait)")
+
+
+REGISTRY = ContentionRegistry()
+
+
+class ProfiledLock:
+    """``threading.Lock`` wrapper recording contended-acquire wait time
+    into :data:`REGISTRY`. The uncontended fast path is one extra
+    non-blocking try — no timing, no stack walk."""
+
+    __slots__ = ("_lock", "_name")
+
+    def __init__(self, name: str):
+        self._lock = threading.Lock()
+        self._name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if self._lock.acquire(False):
+            return True
+        if not blocking:
+            return False
+        t0 = time.perf_counter_ns()
+        ok = self._lock.acquire(True, timeout)
+        REGISTRY.record_mutex(self._name, time.perf_counter_ns() - t0)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self._lock.release()
+
+
+class ProfiledCondition:
+    """``threading.Condition`` over a :class:`ProfiledLock`, recording
+    ``wait``/``wait_for`` park time as block events (Go's block-profile
+    class) and lock contention as mutex events."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._plock = ProfiledLock(name)
+        self._cond = threading.Condition(self._plock)  # type: ignore[arg-type]
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        t0 = time.perf_counter_ns()
+        ok = self._cond.wait(timeout)
+        REGISTRY.record_block(self._name, time.perf_counter_ns() - t0)
+        return ok
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        t0 = time.perf_counter_ns()
+        ok = self._cond.wait_for(predicate, timeout)
+        REGISTRY.record_block(self._name, time.perf_counter_ns() - t0)
+        return ok
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def acquire(self, *a, **kw):
+        return self._plock.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._plock.release()
+
+    def __enter__(self):
+        return self._cond.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cond.__exit__(*exc)
+
+
 def thread_dump() -> str:
     """Stack dump of all live threads (≙ /debug/pprof/goroutine?debug=2)."""
     names: Dict[int, str] = {t.ident: t.name for t in threading.enumerate() if t.ident}

@@ -233,3 +233,97 @@ class TestFlowControl:
         out = c.send_response(1, 204, b"", "text/plain")
         data = [f for f in _parse_frames(out) if f[0] == h2.DATA]
         assert len(data) == 1 and data[0][1] & h2.FLAG_END_STREAM
+
+
+@pytest.mark.skipif(CURL is None, reason="curl unavailable")
+class TestH2cUpgrade:
+    """RFC 7540 §3.2: `Upgrade: h2c` from HTTP/1.1 — the reference's
+    h2c.NewHandler speaks BOTH prior-knowledge and the Upgrade dance
+    (command.go:41-44; VERDICT r2 item 6). curl --http2 (without
+    prior-knowledge) uses the Upgrade path on cleartext."""
+
+    def test_curl_http2_upgrade(self, srv):
+        out = subprocess.run(
+            [CURL, "-s", "--http2", "-X", "POST",
+             f"http://127.0.0.1:{srv.port}/take/h2up?rate=5:1s",
+             "-w", "\n%{http_code} %{http_version}"],
+            capture_output=True, timeout=20, text=True,
+        )
+        assert out.returncode == 0, out.stderr
+        *body, tail = out.stdout.rsplit("\n", 1)
+        code, version = tail.split(" ")
+        assert version == "2", f"stayed on http/{version}"
+        assert (int(code), body[0]) == (200, "4")
+
+    def test_upgrade_raw_socket(self, srv):
+        """The dance, frame by frame: 101 → server SETTINGS first → the
+        upgrade request answered on stream 1."""
+        import socket
+        import struct as _struct
+
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        try:
+            # HTTP2-Settings: empty SETTINGS payload (valid, §3.2.1).
+            s.sendall(
+                b"POST /take/h2raw?rate=5:1s HTTP/1.1\r\n"
+                b"Host: x\r\n"
+                b"Connection: Upgrade, HTTP2-Settings\r\n"
+                b"Upgrade: h2c\r\n"
+                b"HTTP2-Settings: \r\n\r\n"
+            )
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                buf += s.recv(65536)
+            head, _, rest = buf.partition(b"\r\n\r\n")
+            assert head.startswith(b"HTTP/1.1 101"), head
+            assert b"upgrade: h2c" in head.lower()
+            # Client h2 preface + empty SETTINGS.
+            s.sendall(h2.PREFACE + h2.frame(h2.SETTINGS, 0, 0, b""))
+            # Collect frames until stream 1's DATA arrives.
+            frames = []
+            deadline_buf = rest
+            s.settimeout(5)
+            while True:
+                while len(deadline_buf) >= 9:
+                    ln = int.from_bytes(deadline_buf[0:3], "big")
+                    if len(deadline_buf) < 9 + ln:
+                        break
+                    ftype = deadline_buf[3]
+                    sid = int.from_bytes(deadline_buf[5:9], "big") & 0x7FFFFFFF
+                    payload = deadline_buf[9 : 9 + ln]
+                    frames.append((ftype, sid, payload))
+                    deadline_buf = deadline_buf[9 + ln :]
+                if any(f[0] == h2.DATA and f[1] == 1 for f in frames):
+                    break
+                deadline_buf += s.recv(65536)
+            # First h2 frame from the server is SETTINGS (§3.2).
+            assert frames[0][0] == h2.SETTINGS
+            data = b"".join(p for t, sid, p in frames if t == h2.DATA and sid == 1)
+            assert data == b"4"  # 5-token bucket after one take
+        finally:
+            s.close()
+
+    def test_upgrade_refused_while_pipelined_responses_pending(self, srv):
+        """An Upgrade arriving behind a pipelined HTTP/1.1 request in the
+        same segment must NOT switch protocols: the earlier response is
+        still queued, and a 101 would interleave with its bytes."""
+        import socket
+
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        try:
+            s.sendall(
+                b"POST /take/h2pipe?rate=5:1s HTTP/1.1\r\nHost: x\r\n\r\n"
+                b"POST /take/h2pipe?rate=5:1s HTTP/1.1\r\n"
+                b"Host: x\r\n"
+                b"Connection: Upgrade, HTTP2-Settings\r\n"
+                b"Upgrade: h2c\r\n"
+                b"HTTP2-Settings: \r\n\r\n"
+            )
+            s.settimeout(5)
+            buf = b""
+            while buf.count(b"HTTP/1.1 ") < 2:
+                buf += s.recv(65536)
+            assert b"101" not in buf.split(b"\r\n")[0]
+            assert buf.count(b"HTTP/1.1 200") == 2  # both served as h1
+        finally:
+            s.close()

@@ -128,3 +128,107 @@ class TestBuildProfile:
         _, strings, samples = self._decode(blob)
         assert samples, "no stacks sampled"
         assert any("busy" in s for s in strings)
+
+
+class TestContentionProfiles:
+    """The real /debug/pprof/mutex and /block (VERDICT r2 item 5): wait
+    time around profiled locks/conditions surfaces as a pprof contention
+    profile with (contentions/count, delay/nanoseconds) sample types."""
+
+    def test_contended_lock_shows_up(self):
+        import threading
+        import time as _t
+
+        from patrol_tpu.utils import profiling
+
+        reg = profiling.ContentionRegistry(fraction=1)
+        old = profiling.REGISTRY
+        profiling.REGISTRY = reg
+        try:
+            lock = profiling.ProfiledLock("test.lock")
+
+            def holder():
+                with lock:
+                    _t.sleep(0.05)
+
+            t = threading.Thread(target=holder)
+            t.start()
+            _t.sleep(0.005)  # let the holder win the race
+            with lock:  # contends ~45 ms
+                pass
+            t.join()
+        finally:
+            profiling.REGISTRY = old
+
+        raw = reg.mutex_pprof()
+        prof = _parse_message(gzip.decompress(raw))
+        strings = [v.decode() for v in prof[6]]
+        assert "contentions" in strings and "delay" in strings
+        assert "test.lock" in strings
+        assert len(prof.get(2, [])) >= 1  # at least one sample
+        # Total delay across samples ≈ the 45 ms contention.
+        total_delay = 0
+        for sample in prof[2]:
+            f = _parse_message(sample)
+            vals, i = [], 0
+            data = f[2][0]
+            while i < len(data):
+                v, i = _read_varint(data, i)
+                vals.append(v)
+            total_delay += vals[1]
+        assert total_delay > 10_000_000  # >10 ms recorded
+        text = reg.mutex_text()
+        assert "test.lock" in text
+
+    def test_condition_wait_is_a_block_event(self):
+        import threading
+        import time as _t
+
+        from patrol_tpu.utils import profiling
+
+        reg = profiling.ContentionRegistry(fraction=1)
+        old = profiling.REGISTRY
+        profiling.REGISTRY = reg
+        try:
+            cond = profiling.ProfiledCondition("test.cond")
+
+            def waker():
+                _t.sleep(0.03)
+                with cond:
+                    cond.notify_all()
+
+            t = threading.Thread(target=waker)
+            t.start()
+            with cond:
+                cond.wait(timeout=5)
+            t.join()
+        finally:
+            profiling.REGISTRY = old
+
+        prof = _parse_message(gzip.decompress(reg.block_pprof()))
+        strings = [v.decode() for v in prof[6]]
+        assert "test.cond" in strings
+        assert len(prof.get(2, [])) >= 1
+
+    def test_engine_under_load_records_contention(self):
+        """Driving the engine from two threads produces a non-empty mutex
+        or block profile — the feeder-vs-caller contention signal the
+        reference gets from SetMutexProfileFraction (main.go:24)."""
+        from patrol_tpu.models.limiter import LimiterConfig
+        from patrol_tpu.ops.rate import Rate
+        from patrol_tpu.runtime.engine import DeviceEngine
+        from patrol_tpu.utils import profiling
+
+        engine = DeviceEngine(LimiterConfig(buckets=64, nodes=4), node_slot=0)
+        try:
+            rate = Rate(freq=1000, per_ns=10**9)
+            for i in range(200):
+                t, _ = engine.submit_take(f"b{i % 8}", rate, 1)
+            t.wait()
+            engine.flush()
+        finally:
+            engine.stop()
+        # The engine's own feeder/completer condition waits are block
+        # events; at fraction 1/8 a 200-take run records plenty.
+        text = profiling.REGISTRY.block_text()
+        assert "engine." in text
