@@ -64,7 +64,7 @@ def blast(port: int, targets: str) -> dict:
     from patrol_tpu import native
 
     lib = native.load()
-    out = np.zeros(3, np.uint64)
+    out = np.zeros(5, np.uint64)
     rc = lib.pt_http_blast(
         b"127.0.0.1", port, targets.encode(), CONNS, PIPELINE, DURATION_MS, out
     )
@@ -73,6 +73,8 @@ def blast(port: int, targets: str) -> dict:
         "rps": round(int(out[0]) / (DURATION_MS / 1000)),
         "p50_us": int(out[1]) // 1000,
         "p99_us": int(out[2]) // 1000,
+        "ok": int(out[3]),
+        "limited": int(out[4]),
     }
 
 

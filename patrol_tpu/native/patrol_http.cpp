@@ -849,10 +849,11 @@ int pt_http_stop(int h) {
 // `target` may be a single path or many paths joined by '\n'; requests
 // cycle through them round-robin (how the zipf multi-bucket workloads
 // are driven: the caller pre-samples the key distribution into paths).
-// out3 = {requests_completed, p50_ns, p99_ns} (latency per response at
-// pipeline depth, i.e. includes queueing behind the pipeline window).
+// out5 = {requests_completed, p50_ns, p99_ns, ok_200, limited_429}
+// (latency per response at pipeline depth, i.e. includes queueing behind
+// the pipeline window; the status split feeds admitted-vs-limit checks).
 int pt_http_blast(const char* ip, uint16_t port, const char* target,
-                  int conns, int pipeline, int duration_ms, uint64_t* out3) {
+                  int conns, int pipeline, int duration_ms, uint64_t* out5) {
   std::vector<std::string> reqs;
   {
     const char* t = target;
@@ -901,7 +902,7 @@ int pt_http_blast(const char* ip, uint16_t port, const char* target,
   auto t_end = now() + std::chrono::milliseconds(duration_ms);
   std::vector<uint64_t> lats;
   lats.reserve(1 << 20);
-  uint64_t done = 0;
+  uint64_t done = 0, ok200 = 0, lim429 = 0;
 
   auto pump_conn = [&](CC& c) {  // fill the pipeline window
     // Queue whole requests, then flush as far as the socket allows: a
@@ -945,6 +946,8 @@ int pt_http_blast(const char* ip, uint16_t port, const char* target,
         if (p != std::string::npos && p < he)
           clen = strtoul(c.rbuf.c_str() + p + 15, nullptr, 10);
         if (c.rbuf.size() < he + 4 + clen) break;
+        if (c.rbuf.size() >= 12 && c.rbuf.compare(9, 3, "200") == 0) ok200++;
+        else if (c.rbuf.size() >= 12 && c.rbuf.compare(9, 3, "429") == 0) lim429++;
         c.rbuf.erase(0, he + 4 + clen);
         c.inflight--;
         done++;
@@ -960,14 +963,16 @@ int pt_http_blast(const char* ip, uint16_t port, const char* target,
   }
   for (auto& c : cs) ::close(c.fd);
   ::close(ep);
-  out3[0] = done;
+  out5[0] = done;
   if (!lats.empty()) {
     std::sort(lats.begin(), lats.end());
-    out3[1] = lats[lats.size() / 2];
-    out3[2] = lats[(size_t)(lats.size() * 0.99)];
+    out5[1] = lats[lats.size() / 2];
+    out5[2] = lats[(size_t)(lats.size() * 0.99)];
   } else {
-    out3[1] = out3[2] = 0;
+    out5[1] = out5[2] = 0;
   }
+  out5[3] = ok200;
+  out5[4] = lim429;
   return 0;
 }
 
