@@ -340,7 +340,10 @@ class BucketDirectory:
         array passes of the python classify path. Returns
         ``(rows, added_nt, taken_nt, elapsed_ns, scalar_code)`` or ``None``
         when the native table is unavailable (caller uses the numpy path).
-        Row codes: ≥0 resolved+PINNED, −1 miss, −2 invalid; scalar codes:
+        Row codes: ≥0 resolved+PINNED, −1 miss, −2 invalid, −4 folded —
+        a same-batch duplicate of (row, slot, code) whose values were
+        max-merged into the surviving entry and whose pin was ALREADY
+        released inside the native call (skip it entirely). Scalar codes:
         0 lane merge, 1 scalar merge, 2 v1-with-unknown-cap (caller
         re-checks after binding misses)."""
         # Allocations and dtype/contiguity conversions happen OUTSIDE the

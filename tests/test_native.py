@@ -264,3 +264,74 @@ class TestMultiTrailerDecode:
         buf, _ = native.decode_batch_raw(pkts, np.array([len(data)], np.int32))
         assert buf.multi[0] == 0 and buf.slots[0] == -1 and buf.caps[0] == -1
         assert buf.name_lens[0] == 1  # packet itself is still valid (v1)
+
+
+class TestRxDedup:
+    """Per-batch (row, slot) CRDT dedup in pt_rx_classify: duplicate lane
+    deltas fold into one queued update by elementwise max — the join the
+    device would compute, minus its per-update scatter cost (the merge
+    ceiling under hot-key storms, config #4)."""
+
+    def test_duplicates_fold_to_max_and_state_converges(self):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from patrol_tpu.models.limiter import LimiterConfig
+        from patrol_tpu.ops import wire as w
+        from patrol_tpu.runtime.engine import DeviceEngine
+
+        eng = DeviceEngine(LimiterConfig(buckets=64, nodes=8), node_slot=0)
+        try:
+            # Bind the bucket first: dedup lives in the native resolve
+            # pass, which only sees directory HITS (first-contact packets
+            # ride the python miss path unfolded, once per bucket life).
+            eng.ingest_delta(
+                w.from_nanotokens("hot", 1, 0, 1, origin_slot=3,
+                                  cap_nt=5 * 10**9, lane_added_nt=1,
+                                  lane_taken_nt=0),
+                slot=3,
+            )
+            assert eng.flush(timeout=30)
+            # 32 packets for ONE bucket+lane with increasing lane values,
+            # plus one packet for a second lane.
+            states = [
+                w.from_nanotokens(
+                    "hot", 10**9 * (i + 1), 0, 100 + i, origin_slot=3,
+                    cap_nt=5 * 10**9, lane_added_nt=10**9 * (i + 1),
+                    lane_taken_nt=i,
+                )
+                for i in range(32)
+            ] + [
+                w.from_nanotokens(
+                    "hot", 7, 0, 7, origin_slot=5, cap_nt=5 * 10**9,
+                    lane_added_nt=7, lane_taken_nt=0,
+                )
+            ]
+            pkts, sizes = native.encode_batch(
+                [s.added for s in states],
+                [s.taken for s in states],
+                [s.elapsed_ns for s in states],
+                [s.name for s in states],
+                [s.origin_slot for s in states],
+                [s.cap_nt for s in states],
+                [s.lane_added_nt for s in states],
+                [s.lane_taken_nt for s in states],
+            )
+            dbuf, n = native.decode_batch_raw(pkts, sizes)
+            accepted = eng.ingest_wire_batch(
+                dbuf, n, dbuf.slots[:n].astype(np.int64),
+                np.zeros(n, np.uint8),
+            )
+            # The 32 same-lane packets fold into ONE survivor.
+            assert accepted == 2  # survivor + second lane
+            assert eng.flush(timeout=30)
+            row = eng.directory.lookup("hot")
+            pn, el = eng.read_rows([row])
+            assert int(pn[0][3, 0]) == 32 * 10**9  # max lane value won
+            assert int(pn[0][3, 1]) == 31
+            assert int(pn[0][5, 0]) == 7
+            assert int(el[0]) == 131  # max elapsed
+            # Pins balanced: nothing left in flight.
+            assert int(eng.directory.pins.sum()) == 0
+        finally:
+            eng.stop()
