@@ -27,7 +27,8 @@ def free_port() -> int:
 class Cluster:
     """N full Command stacks sharing one background event loop."""
 
-    def __init__(self, n: int = 3, udp_backend: str = "asyncio"):
+    def __init__(self, n: int = 3, udp_backend: str = "asyncio",
+                 wire_mode: str = "aggregate"):
         self.n = n
         self.api_ports = [free_port() for _ in range(n)]
         node_ports = [free_port() for _ in range(n)]
@@ -45,6 +46,7 @@ class Cluster:
                 config=LimiterConfig(buckets=128, nodes=4),
                 handle_signals=False,
                 udp_backend=udp_backend,
+                wire_mode=wire_mode,
             )
             self.commands.append(cmd)
 
@@ -142,12 +144,15 @@ def _native_available() -> bool:
     return native.load() is not None
 
 
-@pytest.fixture(
-    scope="module",
-    params=["asyncio", pytest.param("native", marks=pytest.mark.skipif(
+BACKEND_PARAMS = [
+    "asyncio",
+    pytest.param("native", marks=pytest.mark.skipif(
         not _native_available(), reason="native toolchain unavailable"
-    ))],
-)
+    )),
+]
+
+
+@pytest.fixture(scope="module", params=BACKEND_PARAMS)
 def cluster(request):
     c = Cluster(3, udp_backend=request.param)
     yield c
@@ -328,5 +333,64 @@ class TestReplication:
             assert len(set(views)) == 1, f"views diverged: {views}"
             assert views[0][1] == 6 * NANO  # 3 nodes × 2 takes, none lost
         finally:
+            for cl in clients:
+                cl.close()
+
+
+class TestWireModeCompat:
+    """--wire-mode compat (rolling-upgrade gate, ADVICE r2): the cluster
+    converges while emitting raw own-lane headers + base trailers that
+    pre-lane-trailer builds can ingest without PN inflation."""
+
+    @pytest.fixture(scope="class", params=BACKEND_PARAMS)
+    def compat_cluster(self, request):
+        # Through the real plumbing: Command(wire_mode=...) -> replicator.
+        c = Cluster(2, udp_backend=request.param, wire_mode="compat")
+        yield c
+        c.close()
+
+    def test_converges_and_wire_form_is_compat(self, compat_cluster):
+        from patrol_tpu.ops import wire
+
+        clients = [KeepAliveClient(p) for p in compat_cluster.api_ports]
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        probe.bind(("127.0.0.1", 0))
+        probe.settimeout(2)
+        try:
+            # Drain on node 0; node 1 must converge via compat packets.
+            for _ in range(3):
+                status, _ = clients[0].take("cw", "3:1h")
+                assert status == 200
+            deadline = time.time() + 5
+            ok = False
+            while time.time() < deadline and not ok:
+                status, _ = clients[1].take("cw", "3:1h")
+                ok = status == 429
+                time.sleep(0.05)
+            assert ok, "compat-mode replication did not converge"
+
+            # On-the-wire form: snapshot a broadcast by asking node 0 for
+            # its state WITHOUT the multi advert — compat replies must be
+            # raw own-lane headers + base trailers (no cap, no lanes).
+            req = wire.encode(wire.WireState("cw", 0.0, 0.0, 0))
+            probe.sendto(
+                req,
+                ("127.0.0.1",
+                 int(compat_cluster.commands[0].node_addr.rsplit(":", 1)[1])),
+            )
+            pkts = []
+            while True:
+                try:
+                    data, _ = probe.recvfrom(512)
+                    pkts.append(wire.decode(data))
+                    probe.settimeout(0.3)  # drain stragglers cheaply
+                except socket.timeout:
+                    break
+            assert pkts, "no incast reply"
+            for st in pkts:
+                assert st.cap_nt is None and st.lanes is None
+                assert st.origin_slot is not None  # base trailer only
+        finally:
+            probe.close()
             for cl in clients:
                 cl.close()
