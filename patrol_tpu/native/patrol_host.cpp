@@ -394,11 +394,22 @@ int pt_encode_batch(const double* added, const double* taken,
 
 namespace {
 
+// One probe-table entry, 16 bytes — hash, row, and the bound name's
+// length packed into ONE cache line (4 entries/line). The r2 layout kept
+// hash/row/len in three arrays, so every probe at 1M rows paid two-three
+// DRAM lines; this layout pays one (the dominant classify cost is DRAM
+// latency on a single host core — see pt_rx_classify).
+struct PtSlot {
+  uint64_t h;
+  int32_t row;  // -1 empty, -2 tombstone, ≥0 bound row
+  int32_t len;  // name length of `row` (valid when row ≥ 0)
+};
+static_assert(sizeof(PtSlot) == 16, "slot must pack to 16 bytes");
+
 struct PtDir {
   int64_t capacity = 0;
   uint64_t mask = 0;
-  std::vector<uint64_t> th;     // table: hash
-  std::vector<int32_t> trow;    // table: row (-1 empty, -2 tombstone)
+  std::vector<PtSlot> tab;      // open-addressing probe table
   std::vector<uint64_t> row_h;  // row → its hash (for delete/rebuild)
   std::vector<uint8_t> live;    // row → bound?
   const uint8_t* name_bytes = nullptr;  // [capacity, 256], Python-owned
@@ -420,7 +431,7 @@ void ptdir_insert(PtDir* d, uint64_t h, int32_t row) {
   int probes = 1;
   int64_t tomb = -1;
   while (true) {
-    int32_t r = d->trow[pos];
+    int32_t r = d->tab[pos].row;
     if (r == -1) break;
     if (r == -2 && tomb < 0) tomb = (int64_t)pos;
     pos = (pos + 1) & d->mask;
@@ -430,16 +441,20 @@ void ptdir_insert(PtDir* d, uint64_t h, int32_t row) {
     pos = (uint64_t)tomb;
     d->tombs--;
   }
-  d->th[pos] = h;
-  d->trow[pos] = row;
+  d->tab[pos].h = h;
+  d->tab[pos].row = row;
+  // The name bytes/len are already written by the Python bind path when
+  // the insert lands (directory._bind_locked order), so the length can be
+  // denormalized into the probe entry — resolve then never touches the
+  // separate name_lens array.
+  d->tab[pos].len = d->name_lens ? d->name_lens[row] : 0;
   if (probes > d->maxprobe) d->maxprobe = probes;
   d->row_h[row] = h;
   d->live[row] = 1;
 }
 
 void ptdir_rebuild(PtDir* d) {
-  std::fill(d->th.begin(), d->th.end(), 0);
-  std::fill(d->trow.begin(), d->trow.end(), -1);
+  std::fill(d->tab.begin(), d->tab.end(), PtSlot{0, -1, 0});
   d->tombs = 0;
   d->maxprobe = 1;
   for (int64_t r = 0; r < d->capacity; r++)
@@ -463,8 +478,7 @@ int pt_dir_create(int64_t capacity, const uint8_t* name_bytes,
   uint64_t m = 64;
   while ((int64_t)m < capacity * 4) m <<= 1;
   d->mask = m - 1;
-  d->th.assign(m, 0);
-  d->trow.assign(m, -1);
+  d->tab.assign(m, PtSlot{0, -1, 0});
   d->row_h.assign(capacity, 0);
   d->live.assign(capacity, 0);
   d->name_bytes = name_bytes;
@@ -495,10 +509,9 @@ int pt_dir_delete(int h, uint64_t hash, int32_t row) {
   if (!d) return -EBADF;
   uint64_t pos = hash & d->mask;
   for (int p = 0; p < d->maxprobe; p++) {
-    int32_t r = d->trow[pos];
+    int32_t r = d->tab[pos].row;
     if (r == row) {
-      d->trow[pos] = -2;
-      d->th[pos] = 0;
+      d->tab[pos] = PtSlot{0, -2, 0};
       d->tombs++;
       break;
     }
@@ -515,18 +528,20 @@ namespace {
 // One name resolve: probe + verify. Zero-padded 256B rows on both sides,
 // so comparing ceil(len/8) u64-words is exact name equality while touching
 // ≤1 cache line for typical short names (a full 256B memcmp pulls 4 lines
-// of the 1M-row name table per packet — the dominant resolve cost).
+// of the 1M-row name table per packet — the dominant resolve cost). The
+// length check rides the probe entry itself (PtSlot.len), so a resolve
+// touches exactly one probe line + one name line.
 inline int32_t ptdir_resolve_one(const PtDir* d, uint64_t hv,
                                  const uint8_t* name_row, int32_t len) {
   uint64_t pos = hv & d->mask;
   for (int p = 0; p < d->maxprobe; p++) {
-    int32_t r = d->trow[pos];
-    if (r == -1) return -1;  // definite miss
-    if (r >= 0 && d->th[pos] == hv) {
-      if (d->name_lens[r] == len &&
-          std::memcmp(d->name_bytes + (size_t)r * kPacketSize, name_row,
+    const PtSlot& s = d->tab[pos];
+    if (s.row == -1) return -1;  // definite miss
+    if (s.row >= 0 && s.h == hv) {
+      if (s.len == len &&
+          std::memcmp(d->name_bytes + (size_t)s.row * kPacketSize, name_row,
                       ((size_t)len + 7) & ~(size_t)7) == 0) {
-        return r;
+        return s.row;
       }
       return -1;  // verify-fail ⇒ miss (collision; slow path re-resolves)
     }
@@ -610,66 +625,74 @@ int64_t pt_rx_classify(int h, int n, const uint64_t* hashes,
   PtDir* d = g_dirs[h];
   if (!d) return -EBADF;
   int64_t hits = 0;
-  // Block-staged resolve with software prefetch: at 1M rows every probe,
-  // name verify, and pin touch is a DRAM miss (~200 ns/packet measured
-  // serial); staging (positions → probe → verify) overlaps the misses.
-  constexpr int kBlk = 256;
-  uint64_t pos[kBlk];
-  int32_t cand[kBlk];
-  for (int b0 = 0; b0 < n; b0 += kBlk) {
-    int b1 = b0 + kBlk < n ? b0 + kBlk : n;
-    for (int i = b0; i < b1; i++) {
+  // Pass 1 is a ROLLING 3-stage pipeline: every loop iteration i runs
+  //   A(i):      validate, compute probe position, prefetch the probe line
+  //   B(i-GAP):  probe (hash+row+len live in ONE PtSlot line), prefetch
+  //              the candidate's name line + pins/cap_base/last_used
+  //   C(i-2*GAP): byte-verify, pin, LRU stamp, adopt wire capacities
+  // GAP is sized to the core's memory-level parallelism, not to a cache
+  // block: this host sustains ~13 overlapped misses at ~200 ns DRAM
+  // latency (scripts: /tmp-style pointer-chase probe, r3), so a prefetch
+  // needs only ~10-15 iterations of other work to land. The r2 shape
+  // (three separate loops over 256-delta blocks) issued hundreds of
+  // prefetches ahead — beyond the prefetch queue, most were dropped and
+  // the pass ran at near-serial DRAM latency (~440-600 ns/delta at 1M
+  // rows). Rolling keeps ≤ ~5·GAP prefetches in flight.
+  constexpr int kGap = 12;
+  constexpr int kRing = 32;  // ≥ 2*kGap, power of two
+  static_assert(kRing >= 2 * kGap, "ring must cover the pipeline depth");
+  uint64_t pos[kRing];
+  int32_t cand[kRing];
+  for (int i = 0; i < n + 2 * kGap; i++) {
+    if (i < n) {  // -- A
       out_scalar[i] = 0;
       // rows_out arrives as uninitialized np.empty storage — write every
       // entry here (the later passes branch on it).
       if (lens[i] < 0 || slots_in[i] < 0 || slots_in[i] >= max_slots) {
         rows_out[i] = -2;
-        continue;
+      } else {
+        rows_out[i] = -1;
+        uint64_t p = hashes[i] & d->mask;
+        pos[i & (kRing - 1)] = p;
+        __builtin_prefetch(&d->tab[p]);
       }
-      rows_out[i] = -1;
-      uint64_t p = hashes[i] & d->mask;
-      pos[i - b0] = p;
-      __builtin_prefetch(&d->th[p]);
-      __builtin_prefetch(&d->trow[p]);
     }
-    // Probe: first slot whose hash matches (byte verify deferred) or -1.
-    for (int i = b0; i < b1; i++) {
-      if (rows_out[i] == -2) continue;
-      uint64_t hv = hashes[i];
-      uint64_t p = pos[i - b0];
+    int j = i - kGap;  // -- B
+    if (j >= 0 && j < n && rows_out[j] != -2) {
+      uint64_t hv = hashes[j];
+      uint64_t p = pos[j & (kRing - 1)];
       int32_t c = -1;
       for (int pr = 0; pr < d->maxprobe; pr++) {
-        int32_t r = d->trow[p];
-        if (r == -1) break;
-        if (r >= 0 && d->th[p] == hv) {
-          c = r;
+        const PtSlot& s = d->tab[p];
+        if (s.row == -1) break;
+        if (s.row >= 0 && s.h == hv && s.len == lens[j]) {
+          c = s.row;
           break;
         }
         p = (p + 1) & d->mask;
       }
-      cand[i - b0] = c;
+      cand[j & (kRing - 1)] = c;
       if (c >= 0) {
         __builtin_prefetch(d->name_bytes + (size_t)c * kPacketSize);
-        __builtin_prefetch(&d->name_lens[c]);
         __builtin_prefetch(&pins[c], 1);
         __builtin_prefetch(&cap_base[c], 1);
+        __builtin_prefetch(&last_used[c], 1);
       }
     }
-    // Verify bytes, pin, adopt wire capacities.
-    for (int i = b0; i < b1; i++) {
-      if (rows_out[i] == -2) continue;
-      int32_t r = cand[i - b0];
-      if (r >= 0 && d->name_lens[r] == lens[i] &&
+    int k = i - 2 * kGap;  // -- C
+    if (k >= 0 && rows_out[k] != -2) {
+      int32_t r = cand[k & (kRing - 1)];
+      if (r >= 0 &&
           std::memcmp(d->name_bytes + (size_t)r * kPacketSize,
-                      name_buf + (size_t)i * kPacketSize,
-                      ((size_t)lens[i] + 7) & ~(size_t)7) == 0) {
-        rows_out[i] = r;
+                      name_buf + (size_t)k * kPacketSize,
+                      ((size_t)lens[k] + 7) & ~(size_t)7) == 0) {
+        rows_out[k] = r;
         pins[r]++;
         last_used[r] = now;
         hits++;
-        if (caps[i] > 0 && cap_base[r] == 0) cap_base[r] = caps[i];
+        if (caps[k] > 0 && cap_base[r] == 0) cap_base[r] = caps[k];
       } else {
-        rows_out[i] = -1;  // miss or collision: python slow path
+        rows_out[k] = -1;  // miss or collision: python slow path
       }
     }
   }
@@ -738,7 +761,14 @@ int64_t pt_rx_classify(int h, int n, const uint64_t* hashes,
     // different-code entry must not block a same-code storm behind it.
     uint64_t key = ((uint64_t)r << 22) | ((uint64_t)slots_in[i] << 2) |
                    (uint64_t)out_scalar[i];
-    uint64_t pos = (key * 0x9E3779B97F4A7C15ULL) & dmask;
+    // Fibonacci hashing: the product's entropy lives in its HIGH bits,
+    // so fold them down before masking. Masking the raw product (the r2
+    // code) kept only bits the key's low 14 bits determine — i.e. only
+    // (slot, code) — so any batch with few distinct slots collapsed into
+    // a handful of probe chains and the dedup pass went O(n^2) (~390
+    // ns/delta measured at n=8192 with 4 slots; ~15 ns/delta fixed).
+    uint64_t prod = key * 0x9E3779B97F4A7C15ULL;
+    uint64_t pos = (prod ^ (prod >> 32)) & dmask;
     while (true) {
       int32_t j = didx[pos];
       if (j < 0) {

@@ -83,33 +83,52 @@ def _kernel(
     pn_out_ref,
     el_out_ref,
 ):
+    """Per-block body, VECTOR read-modify-writes only.
+
+    The r2 kernel did per-delta scalar VMEM stores and Mosaic (v5e)
+    rejects those ("Cannot store scalars to VMEM"). This version touches
+    VMEM exclusively through shapes Mosaic vectorizes:
+
+    * pn: per delta, one dynamic-slice row load [1, N, 2, 2], a one-hot
+      lane/plane join built from broadcast scalars, one dynamic-slice row
+      store. Non-target lanes join with (0, 0), a no-op under max on the
+      non-negative domain.
+    * elapsed: per delta, a full-tile [R, 2] one-hot max — no dynamic
+      store at all.
+
+    Consecutive deltas hitting the same row are safe: fori_loop is
+    sequential, each iteration reads the previous one's store.
+    """
     g = pl.program_id(0)
     base = block_ids_ref[g] * ROWS_PER_BLOCK
+    n = pn_out_ref.shape[1]
 
     pn_out_ref[...] = pn_in_ref[...]
     el_out_ref[...] = el_in_ref[...]
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, n, 2), 1)
+    plane = jax.lax.broadcasted_iota(jnp.int32, (1, n, 2), 2)
+    rowvec = jax.lax.broadcasted_iota(jnp.int32, (ROWS_PER_BLOCK, 1), 0)
 
     def body(j, _):
         r = rows_ref[j] - base
         s = slots_ref[j]
 
-        cur_lo = pn_out_ref[r, s, 0, 0]
-        cur_hi = pn_out_ref[r, s, 0, 1]
-        lo, hi = _pair_max(added_ref[j, 0], added_ref[j, 1], cur_lo, cur_hi)
-        pn_out_ref[r, s, 0, 0] = lo
-        pn_out_ref[r, s, 0, 1] = hi
+        cur = pn_out_ref[pl.dslice(r, 1)]  # [1, N, 2, 2]
+        val_lo = jnp.where(plane == 0, added_ref[j, 0], taken_ref[j, 0])
+        val_hi = jnp.where(plane == 0, added_ref[j, 1], taken_ref[j, 1])
+        onehot = lane == s
+        upd_lo = jnp.where(onehot, val_lo, 0)
+        upd_hi = jnp.where(onehot, val_hi, 0)
+        new_lo, new_hi = _pair_max(upd_lo, upd_hi, cur[..., 0], cur[..., 1])
+        pn_out_ref[pl.dslice(r, 1)] = jnp.stack([new_lo, new_hi], axis=-1)
 
-        cur_lo = pn_out_ref[r, s, 1, 0]
-        cur_hi = pn_out_ref[r, s, 1, 1]
-        lo, hi = _pair_max(taken_ref[j, 0], taken_ref[j, 1], cur_lo, cur_hi)
-        pn_out_ref[r, s, 1, 0] = lo
-        pn_out_ref[r, s, 1, 1] = hi
-
-        cur_lo = el_out_ref[r, 0]
-        cur_hi = el_out_ref[r, 1]
-        lo, hi = _pair_max(elapsed_ref[j, 0], elapsed_ref[j, 1], cur_lo, cur_hi)
-        el_out_ref[r, 0] = lo
-        el_out_ref[r, 1] = hi
+        el = el_out_ref[...]  # [R, 2]
+        hit = rowvec == r
+        eu_lo = jnp.where(hit, elapsed_ref[j, 0], 0)
+        eu_hi = jnp.where(hit, elapsed_ref[j, 1], 0)
+        ne_lo, ne_hi = _pair_max(eu_lo[:, 0], eu_hi[:, 0], el[:, 0], el[:, 1])
+        el_out_ref[...] = jnp.stack([ne_lo, ne_hi], axis=-1)
         return 0
 
     jax.lax.fori_loop(starts_ref[g], ends_ref[g], body, 0)

@@ -133,9 +133,12 @@ def take_batch(
     d_elapsed = jnp.where(success, delta, i64(0))
 
     # Padding rows (nreq == 0) contribute zero deltas, so duplicate indices
-    # from padding are harmless under scatter-add.
-    pn = state.pn.at[rows, node_slot, ADDED].add(d_added)
-    pn = pn.at[rows, node_slot, TAKEN].add(d_taken)
+    # from padding are harmless under scatter-add. The (added, taken) pair
+    # commits as one scatter of two-element windows: TPU scatter cost is
+    # per update, not per element (scripts/probe_scatter.py), so this
+    # halves the pn commit versus two element-granular scatters.
+    pair = jnp.stack([d_added, d_taken], axis=-1)
+    pn = state.pn.at[rows, node_slot].add(pair)
     elapsed = state.elapsed.at[rows].add(d_elapsed)
 
     result = TakeResult(
