@@ -460,33 +460,7 @@ def _run_stages(out) -> None:
     _stage_done("hotkey")
     _log(f"hotkey: {out['hotkey_merges_per_s']:.3g} merges/s")
 
-    # -- fused take step (device half of configs #1-2) ----------------------
-    if _budget_out("fused take"):
-        return
-    KT = 4096
-    it = jnp.arange(KT, dtype=jnp.int64)
-    reqs = TakeRequest(
-        rows=((it * 2654435761) % B).astype(jnp.int32),
-        now_ns=jnp.full((KT,), 1000 * NANO, jnp.int64),
-        freq=jnp.full((KT,), 100, jnp.int64),
-        per_ns=jnp.full((KT,), NANO, jnp.int64),
-        count_nt=jnp.full((KT,), NANO, jnp.int64),
-        nreq=jnp.full((KT,), 4, jnp.int64),
-        cap_base_nt=jnp.full((KT,), 100 * NANO, jnp.int64),
-        created_ns=jnp.zeros((KT,), jnp.int64),
-    )
-    take = lambda s, r: take_batch(s, r, 0)[0]  # noqa: E731
-    _log("fused take (compile #4)…")
-    dt_take, state = _bench(take, state, reqs, iters=2, iters_hi=12)
-    out["take_requests_per_s"] = round(KT * 4 / dt_take)  # nreq=4 per row
-    out["take_step_us"] = round(dt_take * 1e6, 1)
-    # Dominant traffic: the [K, N, 2] row gather (+ own-lane scatter-back
-    # and the 8 int64 request arrays).
-    _roofline(out, "take", KT * (N * 2 * 8 + 96), dt_take)
-    _stage_done("take")
-    _log(f"take: {out['take_requests_per_s']:.3g} req/s ({out['take_step_us']} µs/step)")
-
-    del state, other, deltas, hot, reqs  # free HBM before the engine stages
+    del state, other, deltas, hot  # free HBM before the engine stages
 
     # -- ingest replay: configs #3/#5 through the HOST path -----------------
     if _budget_out("ingest replay"):
@@ -497,6 +471,63 @@ def _run_stages(out) -> None:
     if _budget_out("mesh step"):
         return
     _stage_mesh_step(out, B, N)
+
+    # -- fused take step (device half of configs #1-2) ----------------------
+    # LAST on purpose: its 12-step unrolled chain is the slowest remote
+    # compile of the suite (minutes on a healthy tunnel; the r3 re-capture
+    # saw a degraded compile service where it ran >10 min), and a stage
+    # that can blow the budget must only ever truncate itself.
+    if _budget_out("fused take"):
+        return
+    _stage_take(out, mk_states, B, N)
+
+
+def _stage_take(out, mk_states, B, N) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from patrol_tpu.models.limiter import NANO
+    from patrol_tpu.ops.take import TakeRequest, take_batch
+
+    state, _other = mk_states()
+    del _other
+    KT = 16384
+    it = jnp.arange(KT, dtype=jnp.int64)
+    reqs = TakeRequest(
+        rows=((it * 2654435761) % B).astype(jnp.int32),
+        now_ns=jnp.full((KT,), 1000 * NANO, jnp.int64),
+        # Capacity far above what 12 chained steps can drain: every step
+        # must admit and COMMIT (changing state), so no two steps of the
+        # unrolled chain are ever bit-identical and the algebraic
+        # simplifier cannot CSE the tail. (With freq=100 the chain hit
+        # the drained fixpoint after step 1 — success=False commits
+        # nothing, the state returns unchanged, and the identical tail
+        # steps collapsed: an r3 capture "measured" a 0.0 µs take step
+        # that its own roofline check flagged.)
+        freq=jnp.full((KT,), 1_000_000, jnp.int64),
+        per_ns=jnp.full((KT,), NANO, jnp.int64),
+        count_nt=jnp.full((KT,), NANO, jnp.int64),
+        nreq=jnp.full((KT,), 4, jnp.int64),
+        cap_base_nt=jnp.full((KT,), 100 * NANO, jnp.int64),
+        created_ns=jnp.zeros((KT,), jnp.int64),
+    )
+    take = lambda s, r: take_batch(s, r, 0)[0]  # noqa: E731
+    _log("fused take (last: slowest compile)…")
+    # KT=16384 (not r2's 4096): the pair-window commit made the per-row
+    # cost ~2x cheaper and a 4096-row step no longer cleared the tunnel's
+    # per-execute noise floor (±20% of ~60-80 ms) over a 10-step
+    # differential. The unroll stays at 12: wider chains (22/42 steps) and
+    # an indexed now_ns+i variant all compiled for >10 min on the
+    # remote-compile tunnel.
+    dt_take, state = _bench(take, state, reqs, iters=2, iters_hi=12, repeats=4)
+    out["take_requests_per_s"] = round(KT * 4 / dt_take)  # nreq=4 per row
+    out["take_batch_rows"] = KT
+    out["take_step_us"] = round(dt_take * 1e6, 1)
+    # Dominant traffic: the [K, N, 2] row gather (+ own-lane scatter-back
+    # and the 8 int64 request arrays).
+    _roofline(out, "take", KT * (N * 2 * 8 + 96), dt_take)
+    _stage_done("take")
+    _log(f"take: {out['take_requests_per_s']:.3g} req/s ({out['take_step_us']} µs/step)")
 
 
 def _stage_mesh_step(out, B, N) -> None:
@@ -653,6 +684,63 @@ def _stage_pallas_compare(out, state, scatter, B, N):
     return state
 
 
+def _stage_host_pipeline_isolated(out, directory_keys: int) -> None:
+    """The host rx pipeline's own capability: decode + fused native
+    resolve/classify against a bound directory, NO engine threads and NO
+    device behind it. The end-to-end replay below runs with the feeder +
+    completer live on the same host core, so its decode/feed walls are
+    contention-inflated whenever the transport walls the drain (this run's
+    axon tunnel moves host→device at ~5 MB/s); this stage pins what the
+    pipeline sustains when the device isn't stealing the core — the
+    number a local-chip deployment sees (VERDICT r2 item 2's ≥5M/s bar)."""
+    import numpy as np
+
+    from patrol_tpu import native
+    from patrol_tpu.runtime.directory import BucketDirectory
+
+    chunk = 8_192
+    n_windows = max(1, min(directory_keys, 131_072) // chunk)
+    d = BucketDirectory(n_windows * chunk * 2)
+    windows = []
+    for w in range(n_windows):
+        names = [f"k{w * chunk + j}" for j in range(chunk)]
+        pkts, sizes = native.encode_batch(
+            [1.5 + (i % 97) * 0.25 for i in range(chunk)],
+            [0.5 + (i % 89) * 0.125 for i in range(chunk)],
+            [10_000_000 + i for i in range(chunk)],
+            names,
+            [int(i % 4) for i in range(chunk)],
+        )
+        windows.append((pkts, sizes))
+        for nm in names:
+            d.assign(nm, 1)
+    dbuf = None
+    done = 0
+    t_work = 0.0
+    nt = np.zeros(chunk, np.uint8)
+    t_end = time.perf_counter() + 3.0
+    while time.perf_counter() < t_end and _left() > 60:
+        for pkts, sizes in windows:
+            t0 = time.perf_counter()
+            dbuf, n = native.decode_batch_raw(pkts, sizes, dbuf)
+            res = d.rx_classify(
+                n, dbuf.hashes, dbuf.names, dbuf.name_lens, dbuf.added,
+                dbuf.taken, dbuf.elapsed, dbuf.slots[:n].astype(np.int64),
+                4, dbuf.caps, dbuf.lane_a, dbuf.lane_t, nt, 123,
+            )
+            t_work += time.perf_counter() - t0
+            rows = res[0]
+            d.unpin_rows(rows[rows >= 0])
+            done += n
+    d.close()
+    out["ingest_host_isolated_deltas_per_s"] = round(done / t_work) if t_work else 0
+    out["ingest_host_isolated_keys"] = n_windows * chunk
+    _log(
+        f"host pipeline isolated: {out['ingest_host_isolated_deltas_per_s']:.3g}"
+        f" deltas/s over {n_windows * chunk} keys"
+    )
+
+
 def _stage_ingest_replay(out, B, N, on_accel) -> None:
     """Configs #3 and #5 end-to-end through the host feeder: pre-encoded
     256B wire packets → batch decode (C++ when available) → fused native
@@ -683,6 +771,8 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
     cfg = LimiterConfig(buckets=B, nodes=N)
     engine = DeviceEngine(cfg, node_slot=0)
     try:
+        if use_native:
+            _stage_host_pipeline_isolated(out, directory_keys)
         chunk = 8_192
         # Pre-encode SEVERAL chunks of packets over a rotating key window so
         # the directory sees every one of directory_keys names; replay then

@@ -29,12 +29,26 @@ Safety notes baked into the host-side preparation (:func:`prepare`):
 
 Verified against the XLA scatter path in interpret mode (tests) and usable
 on CPU the same way; selected on TPU via PATROL_MERGE_KERNEL=auto|pallas
-— behind a compile probe (:func:`native_available`), because current
-Mosaic rejects the per-delta scalar VMEM stores ("Cannot store scalars to
-VMEM", v5e, BENCH_r02) and the measured XLA scatter already lands K=131072
-in ~20-40µs (≤ one engine tick), making it the data-picked TPU default.
-The kernel is kept as the block-sparse design point for backends that
-accept it; the probe auto-enables it there.
+— behind a compile probe (:func:`native_available`). On the current
+jax 0.9.0 / v5e Mosaic the probe fails and the engine stays on the XLA
+scatter, which r3 measured honestly at ~130-215 ns per scatter *update*
+regardless of window size (scripts/probe_scatter.py). The full r3 kernel
+exploration, so the next Mosaic bump can be retried with data:
+
+* This kernel's per-delta VMEM read-modify-writes lower only as vector
+  dynamic slices, and Mosaic requires a dynamic dim-0 slice index it can
+  statically prove tile-aligned ("cannot statically prove that index in
+  dimension 0 is a multiple of 128") — arbitrary per-row RMW inside one
+  VMEM block is not expressible today.
+* A DMA-based variant (state in HBM via ``memory_space=ANY``, per-row
+  ``make_async_copy`` RMW, D=8 double-buffered pipeline) DOES compile and
+  run (scripts/probe_dma_scatter.py): raw row traffic streams at ~3 ns/row
+  pipelined. But the CRDT join itself — a lexicographic (hi, lo) int64 max
+  on (lo, hi)-interleaved int32 lanes — costs ~190-260 ns/delta in-kernel
+  (lane rolls or masked reductions), landing the total at or above the
+  XLA scatter's per-update cost. The kernel only wins if state moves to a
+  de-interleaved (split lo/hi plane) layout, which would put the whole
+  int64 emulation burden on every other op; measured and declined.
 """
 
 from __future__ import annotations
@@ -98,6 +112,19 @@ def _kernel(
 
     Consecutive deltas hitting the same row are safe: fori_loop is
     sequential, each iteration reads the previous one's store.
+
+    Lowering-hazard rules obeyed throughout (each bisected to a concrete
+    failure on jax 0.9.0 / v5e Mosaic, scripts/probe_pallas.py notes):
+
+    * no ``jnp.where`` whose condition compares an iota against a TRACED
+      scalar — select lowering recurses in ``_convert_helper``; use the
+      ``(cmp).astype(int32) * value`` mask-multiply form instead;
+    * no ``//`` or ``%`` on traced scalars (same recursion) — shift/mask;
+    * no bare python literals where promotion would insert a scalar
+      convert (same recursion) — spell ``jnp.int32(0)``;
+    * int32 ``fori_loop`` bounds, or the induction variable arrives as
+      int64 under x64 and every mixed index add fails MLIR verification
+      ("'arith.addi' op requires the same type for all operands").
     """
     g = pl.program_id(0)
     base = block_ids_ref[g] * ROWS_PER_BLOCK
@@ -107,7 +134,10 @@ def _kernel(
     el_out_ref[...] = el_in_ref[...]
 
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, n, 2), 1)
-    plane = jax.lax.broadcasted_iota(jnp.int32, (1, n, 2), 2)
+    plane_is_added = (
+        jax.lax.broadcasted_iota(jnp.int32, (1, n, 2), 2) == 0
+    ).astype(jnp.int32)
+    plane_is_taken = jnp.int32(1) - plane_is_added
     rowvec = jax.lax.broadcasted_iota(jnp.int32, (ROWS_PER_BLOCK, 1), 0)
 
     def body(j, _):
@@ -115,23 +145,28 @@ def _kernel(
         s = slots_ref[j]
 
         cur = pn_out_ref[pl.dslice(r, 1)]  # [1, N, 2, 2]
-        val_lo = jnp.where(plane == 0, added_ref[j, 0], taken_ref[j, 0])
-        val_hi = jnp.where(plane == 0, added_ref[j, 1], taken_ref[j, 1])
-        onehot = lane == s
-        upd_lo = jnp.where(onehot, val_lo, 0)
-        upd_hi = jnp.where(onehot, val_hi, 0)
+        # Mask-multiply select (see hazard rules above): the target lane
+        # carries (added, taken) pairs, every other lane carries (0, 0) —
+        # the identity of max on the non-negative CRDT domain.
+        onehot = (lane == s).astype(jnp.int32)
+        val_lo = plane_is_added * added_ref[j, 0] + plane_is_taken * taken_ref[j, 0]
+        val_hi = plane_is_added * added_ref[j, 1] + plane_is_taken * taken_ref[j, 1]
+        upd_lo = onehot * val_lo
+        upd_hi = onehot * val_hi
         new_lo, new_hi = _pair_max(upd_lo, upd_hi, cur[..., 0], cur[..., 1])
         pn_out_ref[pl.dslice(r, 1)] = jnp.stack([new_lo, new_hi], axis=-1)
 
         el = el_out_ref[...]  # [R, 2]
-        hit = rowvec == r
-        eu_lo = jnp.where(hit, elapsed_ref[j, 0], 0)
-        eu_hi = jnp.where(hit, elapsed_ref[j, 1], 0)
+        hit = (rowvec == r).astype(jnp.int32)
+        eu_lo = hit * elapsed_ref[j, 0]
+        eu_hi = hit * elapsed_ref[j, 1]
         ne_lo, ne_hi = _pair_max(eu_lo[:, 0], eu_hi[:, 0], el[:, 0], el[:, 1])
         el_out_ref[...] = jnp.stack([ne_lo, ne_hi], axis=-1)
         return 0
 
-    jax.lax.fori_loop(starts_ref[g], ends_ref[g], body, 0)
+    jax.lax.fori_loop(
+        starts_ref[g].astype(jnp.int32), ends_ref[g].astype(jnp.int32), body, 0
+    )
 
 
 try:  # pallas is TPU/CPU-interpret capable; degrade gracefully elsewhere

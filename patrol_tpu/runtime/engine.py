@@ -41,6 +41,7 @@ import numpy as np
 
 from patrol_tpu.models.limiter import NANO, LimiterConfig, LimiterState, init_state
 from patrol_tpu.utils import profiling
+from patrol_tpu.ops import merge as merge_mod
 from patrol_tpu.ops import wire
 from patrol_tpu.ops.merge import (
     MergeBatch,
@@ -244,6 +245,25 @@ def _jit_merge_packed():
             elapsed_ns=packed[4],
         )
         return merge_batch(state, batch)
+
+    return jax.jit(step, donate_argnums=0)
+
+
+@lru_cache(maxsize=8)
+def _jit_merge_packed_folded():
+    """Scatter-max with unique/sorted flags asserted — only valid for
+    batches prepared by :meth:`DeviceEngine._fold_lane_merges`."""
+
+    def step(state, packed):
+        batch = merge_mod.FoldedMergeBatch(
+            rows=packed[0].astype(jnp.int32),
+            slots=packed[1].astype(jnp.int32),
+            added_nt=packed[2],
+            taken_nt=packed[3],
+            erows=packed[4].astype(jnp.int32),
+            elapsed_ns=packed[5],
+        )
+        return merge_mod.merge_batch_folded(state, batch)
 
     return jax.jit(step, donate_argnums=0)
 
@@ -1334,6 +1354,22 @@ class DeviceEngine:
                     )
                 self._ticks += 1
                 return
+        # Tick-level fold default: ON for accelerator backends, where the
+        # scatter serializes per update and asserted-unique/sorted indices
+        # measured +28% (scripts/probe_scatter.py); OFF for CPU, where the
+        # scatter is already cheap and the fold's host work + extra jit
+        # variants measured as a straight loss on the 1-vCPU cluster bench
+        # (2,999 rps / p99 60 ms unfolded vs 2,675 rps / p99 337 ms
+        # folded, benchmarks/cluster_bench.py, r3).
+        fold_default = "0" if jax.default_backend() == "cpu" else "1"
+        if os.environ.get("PATROL_TICK_FOLD", fold_default) != "0":
+            packed = self._fold_lane_merges(deltas)
+            with self._state_mu:
+                self.state = _jit_merge_packed_folded()(
+                    self.state, jnp.asarray(packed)
+                )
+            self._ticks += 1
+            return
         n = len(deltas)
         k = _pad_size(n)
         packed = np.zeros((5, k), dtype=np.int64)
@@ -1345,6 +1381,55 @@ class DeviceEngine:
         with self._state_mu:
             self.state = _jit_merge_packed()(self.state, jnp.asarray(packed))
         self._ticks += 1
+
+    @staticmethod
+    def _fold_lane_merges(deltas: DeltaArrays) -> np.ndarray:
+        """Tick-level CRDT fold: sort by (row, slot), max-join duplicate
+        keys, and fold the elapsed updates per ROW — the preparation that
+        lets the device scatter assert unique+sorted indices (measured
+        +28% on v5e, where scatter serializes per update; and a hot-key
+        tick shrinks to its unique-key count before padding). Folding is
+        exactly the join the kernel computes, so order never matters.
+
+        Padding repeats the FIRST entry verbatim — identical key+values
+        are safe under asserted-unique scatters no matter which duplicate
+        the compiler lets win, and a repeated smallest-key entry keeps the
+        arrays sorted. Returns the packed int64[6, k] tick matrix:
+        rows, slots, added, taken, erows, elapsed."""
+        order = np.lexsort((deltas.slots, deltas.rows))
+        r = deltas.rows[order]
+        s = deltas.slots[order]
+        new_key = np.empty(len(r), bool)
+        new_key[0] = True
+        np.logical_or(r[1:] != r[:-1], s[1:] != s[:-1], out=new_key[1:])
+        starts = np.flatnonzero(new_key)
+        a = np.maximum.reduceat(deltas.added_nt[order], starts)
+        t = np.maximum.reduceat(deltas.taken_nt[order], starts)
+        el_sorted = deltas.elapsed_ns[order]
+        new_row = np.empty(len(r), bool)
+        new_row[0] = True
+        np.not_equal(r[1:], r[:-1], out=new_row[1:])
+        row_starts = np.flatnonzero(new_row)
+        er = r[row_starts]
+        e = np.maximum.reduceat(el_sorted, row_starts)
+        n = len(starts)
+        ne = len(row_starts)
+        k = _pad_size(n)
+        packed = np.empty((6, k), dtype=np.int64)
+        # Pad-first with the smallest key so sortedness survives padding.
+        packed[0, : k - n] = r[starts[0]]
+        packed[1, : k - n] = s[starts[0]]
+        packed[2, : k - n] = a[0]
+        packed[3, : k - n] = t[0]
+        packed[0, k - n :] = r[starts]
+        packed[1, k - n :] = s[starts]
+        packed[2, k - n :] = a
+        packed[3, k - n :] = t
+        packed[4, : k - ne] = er[0]
+        packed[5, : k - ne] = e[0]
+        packed[4, k - ne :] = er
+        packed[5, k - ne :] = e
+        return packed
 
     def _apply_scalar_merges(self, deltas: DeltaArrays) -> None:
         """Deficit-attribution merge of reference-peer deltas (interop)."""

@@ -1,0 +1,130 @@
+"""On-chip probe: DMA-pipeline scatter-merge (the r3 kernel exploration).
+
+Keeps the state in HBM (memory_space=ANY) and does per-row read-modify-
+write through make_async_copy with a D-deep double-buffered pipeline —
+the embedding-update pattern, and the only dynamic-row-RMW shape the
+current Mosaic accepts (vector dynamic slices need statically provable
+tile alignment; scalar VMEM stores are rejected outright).
+
+Measured r3 (v5e, 1M x 256-lane state, K=8192 unique rows):
+  inner=bcast   (plain max, 1 op)            ~3 ns/row   -> DMA pipeline is free
+  inner=pairmax (interleaved lexicographic)  ~190 ns/row -> the join dominates
+The lexicographic (hi, lo) max over (lo, hi)-interleaved int32 lanes needs
+lane rolls (or masked reductions, measured slower still at ~260 ns) and
+that cost, not the DMA, decides the kernel: at ~190 ns/delta it cannot
+beat the XLA scatter's measured ~130-215 ns/update. A de-interleaved
+split-plane state layout would fix the join (~7 half-tile ops, no rolls)
+but taxes every other int64 op in the framework; declined with data.
+
+Usage: python scripts/probe_dma_scatter.py
+"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+jax.config.update("jax_enable_x64", True)
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import numpy as np
+from functools import partial
+
+B, S, L = 1_000_000, 8, 128
+K = 8192
+D = 8
+
+def pair_max_ilv(cur, upd, lane_par):
+    # lexicographic int64 max on (lo,hi)-interleaved int32 tiles.
+    # even lanes = lo, odd = hi; values non-negative (hi < 2^31).
+    u_hi = jnp.roll(upd, -1, axis=-1)
+    c_hi = jnp.roll(cur, -1, axis=-1)
+    sign = jnp.int32(-0x80000000)
+    lo_gt = (upd ^ sign) > (cur ^ sign)         # valid at even lanes
+    gt = (u_hi > c_hi) | ((u_hi == c_hi) & lo_gt)
+    g = gt.astype(jnp.int32) * lane_par          # keep even lanes only
+    g_pair = g | jnp.roll(g, 1, axis=-1)
+    return jnp.where(g_pair == 1, upd, cur)
+
+def mk_kern(inner):
+    def kern(rows_ref, w0_ref, lo_ref, hi_ref, state_ref, out_ref, rbuf, wbuf, rsem, wsem):
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, S, L), 2)
+        sub = jax.lax.broadcasted_iota(jnp.int32, (1, S, L), 1)
+        lane_par = (1 - (lane & 1))  # 1 at even lanes
+        def start_read(j, d):
+            pltpu.make_async_copy(state_ref.at[pl.ds(rows_ref[j], 1)], rbuf.at[d], rsem.at[d]).start()
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(D), lambda d, _: (start_read(d, d), 0)[1], 0)
+        def body(j, _):
+            d = jax.lax.rem(j, jnp.int32(D))
+            pltpu.make_async_copy(state_ref.at[pl.ds(rows_ref[j], 1)], rbuf.at[d], rsem.at[d]).wait()
+            @pl.when(j >= D)
+            def _():
+                pltpu.make_async_copy(wbuf.at[d], out_ref.at[pl.ds(rows_ref[j - D], 1)], wsem.at[d]).wait()
+            if inner == "bcast":
+                wbuf[d] = jnp.maximum(rbuf[d], lo_ref[j])
+            else:
+                w0 = w0_ref[j]
+                su = w0 >> 7
+                l0 = w0 & 127
+                m_lo = ((sub == su) & (lane == l0)).astype(jnp.int32)
+                m_hi = ((sub == su) & (lane == l0 + 1)).astype(jnp.int32)
+                upd = m_lo * lo_ref[j] + m_hi * hi_ref[j]
+                wbuf[d] = pair_max_ilv(rbuf[d], upd, lane_par)
+            pltpu.make_async_copy(wbuf.at[d], out_ref.at[pl.ds(rows_ref[j], 1)], wsem.at[d]).start()
+            @pl.when(j + D < K)
+            def _():
+                start_read(j + D, d)
+            return 0
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(K), body, 0)
+        def epi(d, _):
+            j = jnp.int32(K) - jnp.int32(D) + d
+            dd = jax.lax.rem(j, jnp.int32(D))
+            pltpu.make_async_copy(wbuf.at[dd], out_ref.at[pl.ds(rows_ref[j], 1)], wsem.at[dd]).wait()
+            return 0
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(D), epi, 0)
+    return kern
+
+def build(inner):
+    return pl.pallas_call(
+        mk_kern(inner),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 4 + [pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct((B, S, L), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((D, 1, S, L), jnp.int32),
+                        pltpu.VMEM((D, 1, S, L), jnp.int32),
+                        pltpu.SemaphoreType.DMA((D,)),
+                        pltpu.SemaphoreType.DMA((D,))],
+        input_output_aliases={4: 0},
+    )
+
+rng = np.random.default_rng(3)
+rows = jnp.asarray(rng.choice(B - 8, K, replace=False).astype(np.int32))
+w0 = jnp.asarray((rng.integers(0, 256, K) * 4).astype(np.int32))
+lo = jnp.asarray(rng.integers(1, 1 << 30, K).astype(np.int32))
+hi = jnp.asarray(rng.integers(0, 1 << 20, K).astype(np.int32))
+
+probe = jax.jit(lambda s: jnp.sum(s[:64]).astype(jnp.int64))
+def force(s): return int(jax.device_get(probe(s)))
+
+for inner in ("bcast", "pairmax"):
+    try:
+        call = build(inner)
+        @partial(jax.jit, donate_argnums=4, static_argnums=5)
+        def chain(r, w, l, h, state, n):
+            for i in range(n):
+                state = call(r, w, l + i, h, state)
+            return state
+        state = jnp.zeros((B, S, L), jnp.int32)
+        state = chain(rows, w0, lo, hi, state, 4); force(state)
+        best = {4: 1e9, 24: 1e9}
+        for _ in range(3):
+            for n in (4, 24):
+                t0 = time.perf_counter()
+                state = chain(rows, w0, lo, hi, state, n)
+                force(state)
+                best[n] = min(best[n], time.perf_counter() - t0)
+        per_call = (best[24] - best[4]) / 20
+        print(f"{inner:8s} per-row {per_call/K*1e9:5.0f} ns  rate {K/per_call/1e6:6.2f} M-rows/s")
+        del state
+    except Exception as e:
+        msg = str(e).replace("\n", " | ")
+        import re
+        mm = re.findall(r"(Mosaic failed[^|]{0,160}|Error details[^|]{0,160}|Unsupported[^|]{0,160})", msg)
+        print(f"{inner}: FAILED", mm[:2] if mm else msg[:200])

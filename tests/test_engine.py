@@ -556,3 +556,99 @@ class TestTPURepo:
 
         remaining, ok = asyncio.run(go())
         assert ok and remaining == 8
+
+
+class TestTickFold:
+    """The tick-level merge fold (engine._fold_lane_merges): sorts by
+    (row, slot), max-joins duplicate keys, folds elapsed per row, and pads
+    by repeating a live entry — the preparation that lets the device
+    scatter assert unique+sorted indices. CPU CI never takes this path by
+    default (the fold is gated to accelerator backends), so these tests
+    force it."""
+
+    def test_fold_matches_unfolded_join(self):
+        import numpy as np
+
+        from patrol_tpu.models.limiter import init_state
+        from patrol_tpu.ops.merge import (
+            FoldedMergeBatch,
+            MergeBatch,
+            merge_batch,
+            merge_batch_folded,
+        )
+        from patrol_tpu.runtime.engine import DeviceEngine, DeltaArrays
+
+        rng = np.random.default_rng(42)
+        n = 257  # odd, > one pow2 boundary
+        rows = rng.integers(0, 64, n)
+        slots = rng.integers(0, 8, n)
+        deltas = DeltaArrays(
+            rows=rows,
+            slots=slots,
+            added_nt=rng.integers(0, 1 << 50, n),
+            taken_nt=rng.integers(0, 1 << 50, n),
+            elapsed_ns=rng.integers(0, 1 << 50, n),
+            scalar=np.zeros(n, bool),
+        )
+        packed = DeviceEngine._fold_lane_merges(deltas)
+        cfg = LimiterConfig(buckets=64, nodes=8)
+
+        import jax.numpy as jnp
+
+        ref = merge_batch(
+            init_state(cfg),
+            MergeBatch(
+                rows=jnp.asarray(rows, jnp.int32),
+                slots=jnp.asarray(slots, jnp.int32),
+                added_nt=jnp.asarray(deltas.added_nt),
+                taken_nt=jnp.asarray(deltas.taken_nt),
+                elapsed_ns=jnp.asarray(deltas.elapsed_ns),
+            ),
+        )
+        got = merge_batch_folded(
+            init_state(cfg),
+            FoldedMergeBatch(
+                rows=jnp.asarray(packed[0], jnp.int32),
+                slots=jnp.asarray(packed[1], jnp.int32),
+                added_nt=jnp.asarray(packed[2]),
+                taken_nt=jnp.asarray(packed[3]),
+                erows=jnp.asarray(packed[4], jnp.int32),
+                elapsed_ns=jnp.asarray(packed[5]),
+            ),
+        )
+        assert np.array_equal(np.asarray(ref.pn), np.asarray(got.pn))
+        assert np.array_equal(np.asarray(ref.elapsed), np.asarray(got.elapsed))
+        # Fold invariants the scatter flags rely on.
+        key = packed[0] * 1000 + packed[1]
+        assert (np.diff(key) >= 0).all(), "(row, slot) keys not sorted"
+        live = np.unique(key)
+        assert len(live) == len(np.unique(np.stack([rows, slots]), axis=1).T)
+        assert (np.diff(packed[4]) >= 0).all(), "elapsed rows not sorted"
+
+    def test_engine_forced_fold_end_to_end(self, monkeypatch):
+        import numpy as np
+
+        from patrol_tpu.runtime.engine import DeviceEngine
+
+        monkeypatch.setenv("PATROL_TICK_FOLD", "1")
+        eng = DeviceEngine(LimiterConfig(buckets=32, nodes=4), node_slot=0)
+        try:
+            # Duplicate (row, slot) deltas across separate ingests land in
+            # one tick often enough; either way the folded kernel applies.
+            for v in (3, 7, 5):
+                eng.ingest_delta(
+                    wire.from_nanotokens(
+                        "k", v * NANO, NANO, v, origin_slot=2,
+                        cap_nt=10 * NANO, lane_added_nt=v * NANO,
+                        lane_taken_nt=NANO,
+                    ),
+                    slot=2,
+                )
+            assert eng.flush(timeout=30)
+            row = eng.directory.lookup("k")
+            pn, el = eng.read_rows([row])
+            assert int(pn[0][2, 0]) == 7 * NANO
+            assert int(pn[0][2, 1]) == NANO
+            assert int(el[0]) == 7
+        finally:
+            eng.stop()

@@ -335,3 +335,50 @@ class TestRxDedup:
             assert int(eng.directory.pins.sum()) == 0
         finally:
             eng.stop()
+
+    def test_many_rows_few_slots_dedup_table_stays_linear(self):
+        """Regression (r3): the dedup table's probe position came from the
+        LOW bits of a Fibonacci-hash product, which only (slot, code)
+        determine — a batch of DISTINCT rows over a handful of slots
+        collapsed into ~n_slots probe chains and the pass went O(n²)
+        (~390 ns/delta at n=8192). The fix folds the product's high bits
+        into the position. This pins the shape (4096 distinct rows, 4
+        slots, all folding correctly) and a wall-clock ceiling loose
+        enough for any non-quadratic implementation: the quadratic form
+        took ~1.9 s for the 2048-delta batch on the r3 host, the fixed
+        one ~65 µs."""
+        import time
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from patrol_tpu.models.limiter import LimiterConfig
+        from patrol_tpu.runtime.engine import DeviceEngine
+
+        n = 4096
+        eng = DeviceEngine(LimiterConfig(buckets=2 * n, nodes=4), node_slot=0)
+        try:
+            names = [f"b{i}" for i in range(n)]
+            pkts, sizes = native.encode_batch(
+                [2.0] * n, [1.0] * n, [10] * n, names,
+                [i % 4 for i in range(n)],
+            )
+            dbuf, nd = native.decode_batch_raw(pkts, sizes)
+            # First pass binds every name (python miss path).
+            eng.ingest_wire_batch(
+                dbuf, nd, dbuf.slots[:nd].astype(np.int64), np.zeros(nd, np.uint8)
+            )
+            assert eng.flush(timeout=60)
+            # Second pass: all hits → the native dedup table sees 4096
+            # distinct (row, slot) keys across only 4 slots.
+            t0 = time.perf_counter()
+            accepted = eng.ingest_wire_batch(
+                dbuf, nd, dbuf.slots[:nd].astype(np.int64), np.zeros(nd, np.uint8)
+            )
+            dt = time.perf_counter() - t0
+            assert accepted == n  # distinct rows: nothing folds away
+            assert dt < 0.5, f"classify took {dt:.3f}s — dedup probing degenerated"
+            assert eng.flush(timeout=60)
+            assert int(eng.directory.pins.sum()) == 0
+        finally:
+            eng.stop()
