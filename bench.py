@@ -558,8 +558,12 @@ def _stage_mesh_step(out, B, N) -> None:
 
     kt, km = 256, 1024
     k = 1024  # square padding, as the engine compiles it (mesh_engine.py)
+    # freq far above what the chained steps can drain: every unrolled step
+    # must admit and COMMIT, so the take subtree never reaches the drained
+    # fixpoint whose bit-identical tail steps XLA CSEs away (the same
+    # artifact the single-device take stage hit — see _stage_take).
     takes = [
-        (int((i * 2654435761) % B), 1000 * NANO, 100, NANO, NANO, 4,
+        (int((i * 2654435761) % B), 1000 * NANO, 1_000_000, NANO, NANO, 4,
          100 * NANO, 0)
         for i in range(kt)
     ]
@@ -573,11 +577,20 @@ def _stage_mesh_step(out, B, N) -> None:
     )
     req, mb = topo.route_requests(plan, takes, deltas, k, k)
 
-    def run(s, mb_, req_):
-        return step(s, mb_, req_)[0]
+    def run(s, mb_, req_, i):
+        # +i on the merge values: a chain of IDENTICAL idempotent joins
+        # would otherwise collapse to one step under CSE (same guard as
+        # the scatter stage; the take side is guarded by the capacity
+        # choice above).
+        mb_i = mb_._replace(
+            added_nt=mb_.added_nt + i,
+            taken_nt=mb_.taken_nt + i,
+            elapsed_ns=mb_.elapsed_ns + i,
+        )
+        return step(s, mb_i, req_)[0]
 
     _log("mesh step (compile)…")
-    dt, state = _bench(run, state, mb, req, iters=2, iters_hi=12)
+    dt, state = _bench(run, state, mb, req, iters=2, iters_hi=12, indexed=True)
     out["mesh_step_us"] = round(dt * 1e6, 1)
     out["mesh_step_ops"] = kt + km
     out["mesh_devices"] = n_dev
@@ -684,7 +697,30 @@ def _stage_pallas_compare(out, state, scatter, B, N):
     return state
 
 
-def _stage_host_pipeline_isolated(out, directory_keys: int) -> None:
+def _encode_windows(n_windows: int, chunk: int, slot_mod: int):
+    """Pre-encode ``n_windows`` chunks of wire packets over a rotating
+    k{N} key window — one definition shared by the isolated host stage
+    and the end-to-end replay so both ingest the same packet mix over
+    the same key population."""
+    from patrol_tpu import native
+
+    windows = []
+    names_all = []
+    for w in range(n_windows):
+        names = [f"k{w * chunk + j}" for j in range(chunk)]
+        pkts, sizes = native.encode_batch(
+            [1.5 + (i % 97) * 0.25 for i in range(chunk)],
+            [0.5 + (i % 89) * 0.125 for i in range(chunk)],
+            [10_000_000 + i for i in range(chunk)],
+            names,
+            [int(i % slot_mod) for i in range(chunk)],
+        )
+        windows.append((pkts, sizes))
+        names_all.append(names)
+    return windows, names_all
+
+
+def _stage_host_pipeline_isolated(out, directory_keys: int, slot_mod: int) -> None:
     """The host rx pipeline's own capability: decode + fused native
     resolve/classify against a bound directory, NO engine threads and NO
     device behind it. The end-to-end replay below runs with the feeder +
@@ -699,21 +735,15 @@ def _stage_host_pipeline_isolated(out, directory_keys: int) -> None:
     from patrol_tpu.runtime.directory import BucketDirectory
 
     chunk = 8_192
-    n_windows = max(1, min(directory_keys, 131_072) // chunk)
+    # The FULL replay key count, not a cache-friendlier subset: this rate
+    # substitutes for the replay's host term in the projected-local
+    # metric, so it must pay the same directory/dedup DRAM footprint the
+    # replay pays.
+    n_windows = max(1, directory_keys // chunk)
     d = BucketDirectory(n_windows * chunk * 2)
-    windows = []
-    for w in range(n_windows):
-        names = [f"k{w * chunk + j}" for j in range(chunk)]
-        pkts, sizes = native.encode_batch(
-            [1.5 + (i % 97) * 0.25 for i in range(chunk)],
-            [0.5 + (i % 89) * 0.125 for i in range(chunk)],
-            [10_000_000 + i for i in range(chunk)],
-            names,
-            [int(i % 4) for i in range(chunk)],
-        )
-        windows.append((pkts, sizes))
-        for nm in names:
-            d.assign(nm, 1)
+    windows, names_all = _encode_windows(n_windows, chunk, slot_mod)
+    for names in names_all:
+        d.assign_many(names, 1)
     dbuf = None
     done = 0
     t_work = 0.0
@@ -721,12 +751,14 @@ def _stage_host_pipeline_isolated(out, directory_keys: int) -> None:
     t_end = time.perf_counter() + 3.0
     while time.perf_counter() < t_end and _left() > 60:
         for pkts, sizes in windows:
+            if time.perf_counter() >= t_end:
+                break  # cap the stage even when one full cycle is slow
             t0 = time.perf_counter()
             dbuf, n = native.decode_batch_raw(pkts, sizes, dbuf)
             res = d.rx_classify(
                 n, dbuf.hashes, dbuf.names, dbuf.name_lens, dbuf.added,
                 dbuf.taken, dbuf.elapsed, dbuf.slots[:n].astype(np.int64),
-                4, dbuf.caps, dbuf.lane_a, dbuf.lane_t, nt, 123,
+                slot_mod, dbuf.caps, dbuf.lane_a, dbuf.lane_t, nt, 123,
             )
             t_work += time.perf_counter() - t0
             rows = res[0]
@@ -772,7 +804,7 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
     engine = DeviceEngine(cfg, node_slot=0)
     try:
         if use_native:
-            _stage_host_pipeline_isolated(out, directory_keys)
+            _stage_host_pipeline_isolated(out, directory_keys, N)
         chunk = 8_192
         # Pre-encode SEVERAL chunks of packets over a rotating key window so
         # the directory sees every one of directory_keys names; replay then
@@ -786,17 +818,7 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
         key_off = 0
         windows = []
         if use_native:
-            for w in range(n_windows):
-                base = w * chunk
-                names = [f"k{base + j}" for j in range(chunk)]
-                pkts, sizes = native.encode_batch(
-                    [1.5 + (i % 97) * 0.25 for i in range(chunk)],
-                    [0.5 + (i % 89) * 0.125 for i in range(chunk)],
-                    [10_000_000 + i for i in range(chunk)],
-                    names,
-                    [int(i % N) for i in range(chunk)],
-                )
-                windows.append((pkts, sizes))
+            windows, _names = _encode_windows(n_windows, chunk, N)
             dbuf = None
         else:
             name_pool = [f"k{j}" for j in range(directory_keys)]
@@ -860,11 +882,20 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
         out["ingest_device_drain_ms"] = round((dt - t_host) * 1e3, 1)
         # What the same pipeline sustains with a LOCAL device (no tunnel
         # between host and HBM): the slower of the host pipeline and the
-        # device scatter-merge ceiling measured by the scatter stage.
+        # device scatter-merge ceiling measured by the scatter stage. The
+        # host term prefers the ISOLATED stage's rate — the in-replay
+        # decode/feed walls are contention-inflated by the drain threads
+        # sharing this 1-vCPU host whenever the transport walls the drain.
         dev_rate = out.get("scatter_merges_per_s")
-        if dev_rate and t_work:
+        # `or`, not a .get default: the isolated stage records 0 when the
+        # budget ran out before its first window, and a recorded 0 must
+        # fall back to the in-replay rate rather than erase the metric.
+        host_rate = out.get("ingest_host_isolated_deltas_per_s") or (
+            round(done / t_work) if t_work else 0
+        )
+        if dev_rate and host_rate:
             out["ingest_projected_local_deltas_per_s"] = round(
-                min(done / t_work, dev_rate)
+                min(host_rate, dev_rate)
             )
         out["ingest_deltas_per_s"] = round(done / dt)
         out["ingest_deltas"] = done

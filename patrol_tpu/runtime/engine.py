@@ -1360,7 +1360,11 @@ class DeviceEngine:
         # scatter is already cheap and the fold's host work + extra jit
         # variants measured as a straight loss on the 1-vCPU cluster bench
         # (2,999 rps / p99 60 ms unfolded vs 2,675 rps / p99 337 ms
-        # folded, benchmarks/cluster_bench.py, r3).
+        # folded, benchmarks/cluster_bench.py, r3). Scope: this is the
+        # single-device engine's merge tick only — MeshEngine overrides
+        # _apply with a fused shard_map step whose per-block routing
+        # (topology.route_requests) does not fold, so PATROL_TICK_FOLD has
+        # no effect there.
         fold_default = "0" if jax.default_backend() == "cpu" else "1"
         if os.environ.get("PATROL_TICK_FOLD", fold_default) != "0":
             packed = self._fold_lane_merges(deltas)

@@ -18,8 +18,9 @@ but taxes every other int64 op in the framework; declined with data.
 
 Usage: python scripts/probe_dma_scatter.py
 """
+import os
 import sys, time
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax, jax.numpy as jnp
 jax.config.update("jax_enable_x64", True)
 from jax.experimental import pallas as pl

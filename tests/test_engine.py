@@ -652,3 +652,44 @@ class TestTickFold:
             assert int(el[0]) == 7
         finally:
             eng.stop()
+
+    def test_folded_and_unfolded_engines_reach_identical_state(self, monkeypatch):
+        """Same delta stream through a fold-forced engine and a fold-off
+        engine must produce bit-identical device state: the fold is pure
+        batch preparation, never semantics."""
+        import numpy as np
+
+        from patrol_tpu.runtime.engine import DeviceEngine
+
+        rng = np.random.default_rng(9)
+        streams = []
+        for _ in range(6):  # several ingest batches → several ticks
+            n = int(rng.integers(3, 40))
+            streams.append(
+                (
+                    [f"b{int(rng.integers(0, 12))}" for _ in range(n)],
+                    rng.integers(0, 4, n),
+                    rng.integers(0, 1 << 40, n),
+                    rng.integers(0, 1 << 40, n),
+                    rng.integers(0, 1 << 40, n),
+                )
+            )
+
+        states = {}
+        for fold in ("0", "1"):
+            monkeypatch.setenv("PATROL_TICK_FOLD", fold)
+            eng = DeviceEngine(LimiterConfig(buckets=32, nodes=4), node_slot=0)
+            try:
+                for names, slots, a, t, e in streams:
+                    eng.ingest_deltas_batch(
+                        names, slots.astype(np.int64), a.copy(), t.copy(), e.copy()
+                    )
+                assert eng.flush(timeout=30)
+                rows = [eng.directory.lookup(f"b{i}") for i in range(12)]
+                live = [r for r in rows if r is not None]
+                pn, el = eng.read_rows(live)
+                states[fold] = (pn.copy(), el.copy())
+            finally:
+                eng.stop()
+        assert np.array_equal(states["0"][0], states["1"][0])
+        assert np.array_equal(states["0"][1], states["1"][1])
