@@ -240,6 +240,22 @@ def _roofline(out, stage: str, bytes_touched: int, dt: float) -> None:
         )
 
 
+def _record_dense(out, dt: float, B: int, N: int, target: float) -> None:
+    """Publish the dense-sweep headline metrics for a measured per-sweep
+    time — ONE definition shared by the first dense stage and the late
+    re-measure, so the value/vs_baseline/projection/roofline math can
+    never drift between them. BASELINE.json states the ≥50M/s target for
+    v5e-4; this harness has ONE chip. The sweep is bucket-sharded with
+    zero cross-chip traffic (parallel/topology.py shards the B axis), so
+    4 chips scale it ×4 — reported as an explicit projection, never
+    folded into vs_baseline."""
+    out["value"] = round(B / dt)
+    out["vs_baseline"] = round(B / dt / target, 3)
+    out["vs_baseline_v5e4_projected"] = round(4 * B / dt / target, 3)
+    out["dense_sweep_ms"] = round(dt * 1e3, 3)
+    _roofline(out, "dense", 3 * (B * N * 2 * 8 + B * 8), dt)
+
+
 def _probe_backend() -> str:
     """Decide the platform WITHOUT importing jax in this process: a child
     process tries the default (TPU) backend under a timeout; on failure it
@@ -401,15 +417,7 @@ def _run_stages(out) -> None:
         merge_dense, state, other,
         iters=2, iters_hi=22, repeats=4, device_loop=True,
     )
-    out["value"] = round(B / dt_dense)
-    out["vs_baseline"] = round(B / dt_dense / target, 3)
-    # BASELINE.json states the ≥50M/s target for v5e-4; this harness has
-    # ONE chip. The sweep is bucket-sharded with zero cross-chip traffic
-    # (parallel/topology.py shards the B axis), so 4 chips scale it ×4 —
-    # reported as an explicit projection, never folded into vs_baseline.
-    out["vs_baseline_v5e4_projected"] = round(4 * B / dt_dense / target, 3)
-    out["dense_sweep_ms"] = round(dt_dense * 1e3, 3)
-    _roofline(out, "dense", 3 * (B * N * 2 * 8 + B * 8), dt_dense)
+    _record_dense(out, dt_dense, B, N, target)
     _stage_done("dense")
     _log(f"dense: {out['value']:.3g} merges/s ({out['dense_sweep_ms']} ms/sweep)")
 
@@ -528,6 +536,27 @@ def _stage_take(out, mk_states, B, N) -> None:
     _roofline(out, "take", KT * (N * 2 * 8 + 96), dt_take)
     _stage_done("take")
     _log(f"take: {out['take_requests_per_s']:.3g} req/s ({out['take_step_us']} µs/step)")
+
+    # Late dense re-measure: the headline stage ran first, and tunnel
+    # throttle episodes last long enough that its 4 consecutive repeats
+    # can all land inside one (r3 captures ranged 18.9-22.6 ms/sweep).
+    # A second differential minutes later is the same min-over-windows
+    # estimator with a time-decorrelated sample; keep the smaller dt
+    # (min of a larger sample) and record both.
+    if _left() > 120 and "dense_sweep_ms" in out:
+        from patrol_tpu.ops.merge import merge_dense
+
+        del reqs  # the take batch is done; keep only the two dense states
+        _discard, other = mk_states()
+        del _discard
+        dt2, state = _bench(
+            merge_dense, state, other,
+            iters=2, iters_hi=22, repeats=3, device_loop=True,
+        )
+        out["dense_sweep_ms_recheck"] = round(dt2 * 1e3, 3)
+        if dt2 * 1e3 < out["dense_sweep_ms"]:
+            _record_dense(out, dt2, B, N, 50e6)
+        _log(f"dense recheck: {out['dense_sweep_ms_recheck']} ms/sweep")
 
 
 def _stage_mesh_step(out, B, N) -> None:

@@ -61,30 +61,42 @@ class API:
             if method != "POST":
                 return 405, b"method not allowed\n", "text/plain"
             return await self._take(path[len("/take/") :], query)
+        if path.startswith("/tokens/"):
+            if method != "GET":
+                return 405, b"method not allowed\n", "text/plain"
+            return await self._tokens(path[len("/tokens/") :])
         if path.startswith("/debug/") or path == "/metrics":
             return await self._debug(method, path, query)
         return 404, b"not found\n", "text/plain"
 
     # -- the hot route (api.go:51-86) ---------------------------------------
 
-    async def _take(self, raw_name: str, query: str) -> Tuple[int, bytes, str]:
-        # surrogateescape: reference names are raw bytes (bucket.go:64-88);
-        # %FF must stay byte 0xFF end-to-end — through this handler, the
-        # directory, and the wire codec — and both HTTP fronts must agree
-        # (the C++ front decodes to raw bytes natively). The default
-        # 'replace' would collapse distinct non-UTF8 names into U+FFFD.
+    @staticmethod
+    def _decode_name(raw_name: str):
+        """→ (name, error_response|None). surrogateescape: reference names
+        are raw bytes (bucket.go:64-88); %FF must stay byte 0xFF
+        end-to-end — through the handlers, the directory, and the wire
+        codec — and both HTTP fronts must agree (the C++ front decodes to
+        raw bytes natively). The default 'replace' would collapse distinct
+        non-UTF8 names into U+FFFD. Over-long names → the api.go:55-58
+        400."""
         name = unquote(raw_name, errors="surrogateescape")
         try:
             name_bytes_len = len(name.encode("utf-8", "surrogateescape"))
         except UnicodeEncodeError:  # lone surrogates not from the escape range
             name_bytes_len = len(name.encode("utf-8", "surrogatepass"))
         if name_bytes_len > MAX_NAME_LENGTH_V1:
-            # api.go:55-58 → 400 with the error text.
-            return (
+            return name, (
                 400,
                 f"bucket name larger than {MAX_NAME_LENGTH_V1}".encode(),
                 "text/plain",
             )
+        return name, None
+
+    async def _take(self, raw_name: str, query: str) -> Tuple[int, bytes, str]:
+        name, err = self._decode_name(raw_name)
+        if err is not None:
+            return err
 
         q = parse_qs(query, keep_blank_values=True)
         try:
@@ -108,6 +120,23 @@ class API:
                 extra={"code": status, "count": count, "rate": str(rate), "bucket": name},
             )
         return status, str(remaining).encode(), "text/plain"
+
+    async def _tokens(self, raw_name: str) -> Tuple[int, bytes, str]:
+        """Read-only balance introspection — ``GET /tokens/:name`` returns
+        the bucket's current whole-token balance WITHOUT taking (and
+        without a refill projection, which would need the request's rate:
+        balance = cap + Σadded − Σtaken, bucket.go:156's Tokens()). The
+        reference exposes no such route; operators debugging a limit had
+        to consume a token to see the balance. Unknown bucket → 404."""
+        name, err = self._decode_name(raw_name)
+        if err is not None:
+            return err
+        loop = asyncio.get_running_loop()
+        # tokens_if_known gathers device state — off the event loop.
+        tok = await loop.run_in_executor(None, self.repo.tokens_if_known, name)
+        if tok is None:
+            return 404, b"unknown bucket\n", "text/plain"
+        return 200, str(tok).encode(), "text/plain"
 
     # -- debug / observability (≙ api.go:29-39) -----------------------------
 

@@ -156,3 +156,12 @@ class TPURepo:
 
     def tokens(self, name: str) -> int:
         return self.engine.tokens(name)
+
+    def tokens_if_known(self, name: str) -> Optional[int]:
+        """Balance introspection with existence: ``None`` for a bucket this
+        node has never seen (the HTTP /tokens route's 404), else the whole-
+        token balance. Keeps API handlers on the repo facade rather than
+        reaching into engine internals."""
+        if self.engine.directory.lookup(name) is None:
+            return None
+        return self.engine.tokens(name)

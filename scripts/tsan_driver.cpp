@@ -15,6 +15,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -38,13 +39,118 @@ int pt_encode_batch(const double* added, const double* taken,
                     const int64_t* caps, const int64_t* lane_added,
                     const int64_t* lane_taken, int n,
                     uint8_t* out, int* out_sizes);
+int pt_dir_create(int64_t capacity, const uint8_t* name_bytes,
+                  const int32_t* name_lens);
+int pt_dir_insert(int h, uint64_t hash, int32_t row);
+int pt_dir_delete(int h, uint64_t hash, int32_t row);
+int pt_dir_destroy(int h);
+int64_t pt_rx_classify(int h, int n, const uint64_t* hashes,
+                       const uint8_t* name_buf, const int32_t* lens,
+                       const double* added_f, const double* taken_f,
+                       const uint64_t* elapsed_u, const int64_t* slots_in,
+                       int64_t max_slots, const int64_t* caps,
+                       const int64_t* lane_a, const int64_t* lane_t,
+                       const uint8_t* no_trailer, int64_t* cap_base,
+                       int32_t* pins, int64_t* last_used, int64_t now,
+                       int64_t* rows_out, int64_t* out_added,
+                       int64_t* out_taken, int64_t* out_elapsed,
+                       uint8_t* out_scalar);
 }
 
 static constexpr int PACKET = 256;
 static constexpr int BATCH = 64;
 static constexpr int ROUNDS = 200;
 
+static uint64_t fnv1a(const uint8_t* b, int len) {
+  uint64_t h = 0xcbf29ce484222325ULL;
+  for (int i = 0; i < len; i++) {
+    h ^= b[i];
+    h *= 0x100000001b3ULL;
+  }
+  return h;
+}
+
+// Directory concurrency scenario: the production contract is that every
+// pt_dir_* / pt_rx_classify call runs under ONE python-side mutex per
+// directory (patrol_host.cpp "Thread safety" note), with the rx thread
+// classifying while the engine thread binds/unbinds. Reproduce that shape
+// — two threads alternating classify / insert+delete under a shared
+// mutex — so TSan proves the lock is sufficient (and would catch any
+// racy global the registry or the rolling classify pipeline introduced).
+static void dir_scenario() {
+  constexpr int CAP = 512;
+  std::vector<uint8_t> name_bytes(static_cast<size_t>(CAP) * PACKET, 0);
+  std::vector<int32_t> name_lens_v(CAP, 0);
+  int h = pt_dir_create(CAP, name_bytes.data(), name_lens_v.data());
+
+  std::vector<uint8_t> pkt_names(static_cast<size_t>(BATCH) * PACKET, 0);
+  std::vector<int32_t> lens(BATCH);
+  std::vector<uint64_t> hashes(BATCH);
+  for (int i = 0; i < BATCH; i++) {
+    char buf[32];
+    int n = snprintf(buf, sizeof buf, "dir-%d", i);
+    memcpy(&pkt_names[static_cast<size_t>(i) * PACKET], buf, n);
+    memcpy(&name_bytes[static_cast<size_t>(i) * PACKET], buf, n);
+    name_lens_v[i] = n;
+    lens[i] = n;
+    hashes[i] = fnv1a(reinterpret_cast<const uint8_t*>(buf), n);
+  }
+  std::mutex mu;  // ≙ BucketDirectory._mu
+  {
+    std::lock_guard<std::mutex> g(mu);
+    for (int i = 0; i < BATCH; i++) pt_dir_insert(h, hashes[i], i);
+  }
+
+  std::atomic<bool> stop{false};
+  auto classifier = [&]() {
+    std::vector<double> added(BATCH, 1.5), taken(BATCH, 0.5);
+    std::vector<uint64_t> elapsed(BATCH, 1000);
+    std::vector<int64_t> slots(BATCH), caps(BATCH, -1), la(BATCH, -1),
+        lt(BATCH, -1);
+    for (int i = 0; i < BATCH; i++) slots[i] = i % 4;
+    std::vector<uint8_t> no_tr(BATCH, 0);
+    std::vector<int64_t> cap_base(CAP, 1000000000);
+    std::vector<int32_t> pins(CAP, 0);
+    std::vector<int64_t> last_used(CAP, 0);
+    std::vector<int64_t> rows(BATCH), oa(BATCH), ot(BATCH), oe(BATCH);
+    std::vector<uint8_t> os_(BATCH);
+    for (int r = 0; r < ROUNDS; r++) {
+      std::lock_guard<std::mutex> g(mu);
+      pt_rx_classify(h, BATCH, hashes.data(), pkt_names.data(), lens.data(),
+                     added.data(), taken.data(), elapsed.data(), slots.data(),
+                     4, caps.data(), la.data(), lt.data(), no_tr.data(),
+                     cap_base.data(), pins.data(), last_used.data(), r,
+                     rows.data(), oa.data(), ot.data(), oe.data(), os_.data());
+      for (int i = 0; i < BATCH; i++)
+        if (rows[i] >= 0) pins[rows[i]]--;  // ≙ unpin after queueing
+    }
+    stop.store(true);
+  };
+  auto binder = [&]() {
+    // Churn a disjoint row range: bind/unbind like eviction + re-assign.
+    int row = BATCH;
+    while (!stop.load()) {
+      char buf[32];
+      int n = snprintf(buf, sizeof buf, "churn-%d", row);
+      uint64_t hv = fnv1a(reinterpret_cast<const uint8_t*>(buf), n);
+      {
+        std::lock_guard<std::mutex> g(mu);
+        memcpy(&name_bytes[static_cast<size_t>(row) * PACKET], buf, n);
+        name_lens_v[row] = n;
+        pt_dir_insert(h, hv, row);
+        pt_dir_delete(h, hv, row);
+      }
+      row = BATCH + (row - BATCH + 1) % (CAP - BATCH);
+    }
+  };
+  std::thread t1(classifier), t2(binder);
+  t1.join();
+  t2.join();
+  pt_dir_destroy(h);
+}
+
 int main() {
+  dir_scenario();
   int tx = pt_udp_open("127.0.0.1", 0);
   int rx = pt_udp_open("127.0.0.1", 0);
   if (tx < 0 || rx < 0) {

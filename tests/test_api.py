@@ -236,3 +236,28 @@ class TestKeepAlive:
                 while len(rest) < clen:
                     rest += s.recv(65536)
                 assert head.startswith(b"HTTP/1.1 200")
+
+
+class TestTokensRoute:
+    """Read-only balance introspection (beyond the reference: operators
+    previously had to consume a token to see a balance)."""
+
+    def test_unknown_bucket_404(self, srv):
+        status, _ = srv.request("GET", "/tokens/nobody-home")
+        assert status == 404
+
+    def test_balance_after_takes(self, srv):
+        for _ in range(3):
+            s, _ = srv.request("POST", "/take/tok-bal?rate=10:1s&count=1")
+            assert s == 200
+        status, body = srv.request("GET", "/tokens/tok-bal")
+        assert status == 200
+        assert body == "7"
+
+    def test_post_method_rejected(self, srv):
+        status, _ = srv.request("POST", "/tokens/x")
+        assert status == 405
+
+    def test_name_too_long_400(self, srv):
+        status, _ = srv.request("GET", "/tokens/" + "n" * 232)
+        assert status == 400
