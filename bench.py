@@ -45,6 +45,9 @@ from functools import partial
 
 START = time.time()
 BUDGET_S = float(os.environ.get("PATROL_BENCH_BUDGET_S", "1500"))
+# BASELINE.json: ≥50M bucket-merges/sec on v5e-4 — the single definition
+# both the dense stage and its late re-measure publish against.
+DENSE_TARGET = 50e6
 PROBE_TIMEOUT_S = float(os.environ.get("PATROL_BENCH_PROBE_TIMEOUT_S", "420"))
 
 OUT = {
@@ -375,7 +378,7 @@ def _run_stages(out) -> None:
     out["node_lanes"] = N
     out["forced_completion"] = True  # every window ends in a dependent readback
     out["hbm_peak_gbps_est"] = _hbm_peak_gbps()
-    target = 50e6  # BASELINE.json: ≥50M bucket-merges/sec on v5e-4
+    target = DENSE_TARGET
 
     # Deterministic non-trivial state, built from cheap iota patterns (one
     # tiny compile) instead of int64 PRNG kernels: on the TPU tunnel every
@@ -480,6 +483,9 @@ def _run_stages(out) -> None:
         return
     _stage_mesh_step(out, B, N)
 
+    # -- dense re-measure (time-decorrelated second sample) -----------------
+    _stage_dense_recheck(out, mk_states, B, N)
+
     # -- fused take step (device half of configs #1-2) ----------------------
     # LAST on purpose: its 12-step unrolled chain is the slowest remote
     # compile of the suite (minutes on a healthy tunnel; the r3 re-capture
@@ -488,6 +494,41 @@ def _run_stages(out) -> None:
     if _budget_out("fused take"):
         return
     _stage_take(out, mk_states, B, N)
+
+
+def _stage_dense_recheck(out, mk_states, B, N) -> None:
+    """Second dense differential, minutes after the first: tunnel throttle
+    episodes outlast one stage's consecutive repeats (r3 captures ranged
+    18.9-22.6 ms/sweep), so a time-decorrelated sample under the same
+    min-over-windows estimator decides the headline; the smaller dt wins.
+    Runs between the engine stages and the take stage — at that point no
+    other flagship-size buffers are live, which the recheck needs: with
+    the take state resident, two fresh states + the fori carry exceeded
+    the 16 GB chip twice in r3. Best-effort either way: any failure is
+    recorded, never allowed to truncate the run."""
+    if _left() < 150 or "dense_sweep_ms" not in out:
+        return
+    import gc
+
+    from patrol_tpu.ops.merge import merge_dense
+
+    gc.collect()  # drop the engine stages' device buffers first
+    try:
+        state, other = mk_states()
+        dt2, state = _bench(
+            merge_dense, state, other,
+            iters=2, iters_hi=22, repeats=3, device_loop=True,
+        )
+        out["dense_sweep_ms_recheck"] = round(dt2 * 1e3, 3)
+        if dt2 * 1e3 < out["dense_sweep_ms"]:
+            out["dense_sweep_ms_first"] = out["dense_sweep_ms"]
+            _record_dense(out, dt2, B, N, DENSE_TARGET)
+        _log(f"dense recheck: {out['dense_sweep_ms_recheck']} ms/sweep")
+        del state, other
+        gc.collect()
+    except Exception as e:  # noqa: BLE001
+        out["dense_recheck_error"] = str(e)[:160]
+        _log(f"dense recheck skipped: {e}")
 
 
 def _stage_take(out, mk_states, B, N) -> None:
@@ -536,27 +577,6 @@ def _stage_take(out, mk_states, B, N) -> None:
     _roofline(out, "take", KT * (N * 2 * 8 + 96), dt_take)
     _stage_done("take")
     _log(f"take: {out['take_requests_per_s']:.3g} req/s ({out['take_step_us']} µs/step)")
-
-    # Late dense re-measure: the headline stage ran first, and tunnel
-    # throttle episodes last long enough that its 4 consecutive repeats
-    # can all land inside one (r3 captures ranged 18.9-22.6 ms/sweep).
-    # A second differential minutes later is the same min-over-windows
-    # estimator with a time-decorrelated sample; keep the smaller dt
-    # (min of a larger sample) and record both.
-    if _left() > 120 and "dense_sweep_ms" in out:
-        from patrol_tpu.ops.merge import merge_dense
-
-        del reqs  # the take batch is done; keep only the two dense states
-        _discard, other = mk_states()
-        del _discard
-        dt2, state = _bench(
-            merge_dense, state, other,
-            iters=2, iters_hi=22, repeats=3, device_loop=True,
-        )
-        out["dense_sweep_ms_recheck"] = round(dt2 * 1e3, 3)
-        if dt2 * 1e3 < out["dense_sweep_ms"]:
-            _record_dense(out, dt2, B, N, 50e6)
-        _log(f"dense recheck: {out['dense_sweep_ms_recheck']} ms/sweep")
 
 
 def _stage_mesh_step(out, B, N) -> None:
@@ -620,6 +640,12 @@ def _stage_mesh_step(out, B, N) -> None:
 
     _log("mesh step (compile)…")
     dt, state = _bench(run, state, mb, req, iters=2, iters_hi=12, indexed=True)
+    # Honesty annotation: the fused step is ~0.5-5 ms, so even this
+    # 10-step differential signal sits at the tunnel's ±15 ms noise floor
+    # (r3 captures ranged 0.0-4.8 ms/step; a 32-step window did not help
+    # and compiled for ~8 min). Treat the number as an upper-bound class,
+    # not a resolved per-step time.
+    out["mesh_step_note"] = "differential at tunnel noise floor; upper-bound class"
     out["mesh_step_us"] = round(dt * 1e6, 1)
     out["mesh_step_ops"] = kt + km
     out["mesh_devices"] = n_dev

@@ -981,10 +981,20 @@ class DeviceEngine:
 
     def tokens(self, name: str) -> int:
         """Whole tokens currently in a bucket (introspection; bucket.go:156)."""
+        return self.tokens_if_known(name) or 0
+
+    def tokens_if_known(self, name: str) -> Optional[int]:
+        """Balance with existence: ``None`` for an unknown bucket, else the
+        whole-token balance. The post-read re-lookup closes the eviction
+        race (same pattern as :meth:`snapshot`): without it, a concurrent
+        evict-and-rebind between lookup and the device gather could
+        return another bucket's balance under this name."""
         row = self.directory.lookup(name)
         if row is None:
-            return 0
+            return None
         pn_rows, _ = self.read_rows([row])
+        if self.directory.lookup(name) != row:
+            return None  # evicted (and possibly rebound) mid-read
         pn = pn_rows[0]
         base = int(self.directory.cap_base_nt[row])
         nt = base + int(pn[:, 0].sum()) - int(pn[:, 1].sum())

@@ -161,7 +161,6 @@ class TPURepo:
         """Balance introspection with existence: ``None`` for a bucket this
         node has never seen (the HTTP /tokens route's 404), else the whole-
         token balance. Keeps API handlers on the repo facade rather than
-        reaching into engine internals."""
-        if self.engine.directory.lookup(name) is None:
-            return None
-        return self.engine.tokens(name)
+        reaching into engine internals; the engine closes the
+        eviction/rebind race with a post-read re-lookup."""
+        return self.engine.tokens_if_known(name)
