@@ -19,7 +19,7 @@ from patrol_tpu.runtime.directory import BucketDirectory
 from patrol_tpu.runtime.engine import DeviceEngine
 from patrol_tpu.runtime import checkpoint as ckpt
 
-from test_cluster import Cluster, KeepAliveClient
+from test_cluster import BACKEND_PARAMS, Cluster, KeepAliveClient
 
 CFG = LimiterConfig(buckets=64, nodes=4)
 RATE = Rate(freq=10, per_ns=NANO)
@@ -77,9 +77,13 @@ class TestCheckpoint:
             other.stop()
 
 
-@pytest.fixture(scope="module")
-def cluster():
-    c = Cluster(3, udp_backend="asyncio")
+@pytest.fixture(scope="module", params=BACKEND_PARAMS)
+def cluster(request):
+    """Partition/heal and loss tolerance must hold over BOTH replication
+    backends: the asyncio path and the C++ recvmmsg path expose the same
+    ``drop_addr`` fault-injection hook (rx-side on each node, so a
+    symmetric filter partitions both directions)."""
+    c = Cluster(3, udp_backend=request.param)
     yield c
     c.close()
 

@@ -625,6 +625,64 @@ class TestTickFold:
         assert len(live) == len(np.unique(np.stack([rows, slots]), axis=1).T)
         assert (np.diff(packed[4]) >= 0).all(), "elapsed rows not sorted"
 
+    def test_fold_equivalence_randomized(self):
+        """Multi-seed law check: for ANY batch (duplicates, hot keys,
+        single-entry, pow2-straddling sizes), folded-prep + flagged kernel
+        == plain scatter-max join."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from patrol_tpu.models.limiter import init_state
+        from patrol_tpu.ops.merge import (
+            FoldedMergeBatch,
+            MergeBatch,
+            merge_batch,
+            merge_batch_folded,
+        )
+        from patrol_tpu.runtime.engine import DeviceEngine, DeltaArrays
+
+        cfg = LimiterConfig(buckets=16, nodes=4)
+        for seed in range(8):
+            rng = np.random.default_rng(seed)
+            n = int(rng.integers(1, 70))
+            rows = rng.integers(0, 4 if seed % 2 else 16, n)  # hot vs spread
+            slots = rng.integers(0, 4, n)
+            deltas = DeltaArrays(
+                rows=rows,
+                slots=slots,
+                added_nt=rng.integers(0, 1 << 40, n),
+                taken_nt=rng.integers(0, 1 << 40, n),
+                elapsed_ns=rng.integers(0, 1 << 40, n),
+                scalar=np.zeros(n, bool),
+            )
+            packed = DeviceEngine._fold_lane_merges(deltas)
+            ref = merge_batch(
+                init_state(cfg),
+                MergeBatch(
+                    rows=jnp.asarray(rows, jnp.int32),
+                    slots=jnp.asarray(slots, jnp.int32),
+                    added_nt=jnp.asarray(deltas.added_nt),
+                    taken_nt=jnp.asarray(deltas.taken_nt),
+                    elapsed_ns=jnp.asarray(deltas.elapsed_ns),
+                ),
+            )
+            got = merge_batch_folded(
+                init_state(cfg),
+                FoldedMergeBatch(
+                    rows=jnp.asarray(packed[0], jnp.int32),
+                    slots=jnp.asarray(packed[1], jnp.int32),
+                    added_nt=jnp.asarray(packed[2]),
+                    taken_nt=jnp.asarray(packed[3]),
+                    erows=jnp.asarray(packed[4], jnp.int32),
+                    elapsed_ns=jnp.asarray(packed[5]),
+                ),
+            )
+            assert np.array_equal(np.asarray(ref.pn), np.asarray(got.pn)), seed
+            assert np.array_equal(
+                np.asarray(ref.elapsed), np.asarray(got.elapsed)
+            ), seed
+
     def test_engine_forced_fold_end_to_end(self, monkeypatch):
         import numpy as np
 
