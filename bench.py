@@ -954,6 +954,17 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
             )
         out["ingest_deltas_per_s"] = round(done / dt)
         out["ingest_deltas"] = done
+        # Context for the artifact reader, only when the drain ACTUALLY
+        # walled the run (remote-execute transports like the axon tunnel
+        # move host->device at ~5 MB/s): if device drain dominated the
+        # productive host time, the end-to-end rate is the transport's,
+        # not the pipeline's.
+        if dt - t_host > 2 * t_work and "ingest_host_isolated_deltas_per_s" in out:
+            out["ingest_note"] = (
+                "end-to-end rate is transport-walled (device drain dominates; "
+                "see ingest_device_drain_ms); the pipeline's own capability "
+                "is ingest_host_isolated_deltas_per_s"
+            )
         if t_half is not None and done > t_half[1]:
             # Second half = every name already bound: the production
             # steady state (first-sight binds are once per bucket lifetime).
