@@ -533,17 +533,22 @@ namespace {
 // touches exactly one probe line + one name line.
 inline int32_t ptdir_resolve_one(const PtDir* d, uint64_t hv,
                                  const uint8_t* name_row, int32_t len) {
+  // Collision discipline (shared with pt_rx_classify pass-1 so both
+  // resolvers answer identically for the same name): keep probing past an
+  // entry whose hash matches but length differs — distinct same-hash
+  // names coexist in the table, so a len mismatch is not this name — and
+  // stop at the first (hash, len) match, where a byte-verify failure is
+  // reported as a miss (the python slow path re-resolves).
   uint64_t pos = hv & d->mask;
   for (int p = 0; p < d->maxprobe; p++) {
     const PtSlot& s = d->tab[pos];
     if (s.row == -1) return -1;  // definite miss
-    if (s.row >= 0 && s.h == hv) {
-      if (s.len == len &&
-          std::memcmp(d->name_bytes + (size_t)s.row * kPacketSize, name_row,
+    if (s.row >= 0 && s.h == hv && s.len == len) {
+      if (std::memcmp(d->name_bytes + (size_t)s.row * kPacketSize, name_row,
                       ((size_t)len + 7) & ~(size_t)7) == 0) {
         return s.row;
       }
-      return -1;  // verify-fail ⇒ miss (collision; slow path re-resolves)
+      return -1;  // byte-verify fail ⇒ miss (slow path re-resolves)
     }
     pos = (pos + 1) & d->mask;
   }

@@ -73,10 +73,12 @@ class FoldedMergeBatch(NamedTuple):
     update. ``erows``/``elapsed_nt`` are the per-ROW fold of the elapsed
     updates (a row appears once even when several lanes updated it).
 
-    Padding entries REPEAT a live entry verbatim (same key, same values):
-    a duplicate that carries identical values is safe under any
-    conflict-resolution the compiler picks, unlike a zero-value duplicate
-    whose loss could drop a real update."""
+    Padding entries carry genuinely-unique OUT-OF-BOUNDS keys (sentinel
+    row above every live row, distinct slot per entry, appended after the
+    live span so sortedness holds) which ``mode="drop"`` discards — the
+    asserted flags are literally true for every index the kernel sees, so
+    no behavior is borrowed from XLA's unspecified duplicate-index
+    handling (see engine._fold_lane_merges)."""
 
     rows: jax.Array  # int32[K] sorted
     slots: jax.Array  # int32[K]
@@ -91,10 +93,10 @@ def merge_batch_folded(state: LimiterState, batch: FoldedMergeBatch) -> LimiterS
     (see :class:`FoldedMergeBatch` for why that is sound)."""
     pair = jnp.stack([batch.added_nt, batch.taken_nt], axis=-1)
     pn = state.pn.at[batch.rows, batch.slots].max(
-        pair, unique_indices=True, indices_are_sorted=True
+        pair, unique_indices=True, indices_are_sorted=True, mode="drop"
     )
     elapsed = state.elapsed.at[batch.erows].max(
-        batch.elapsed_ns, unique_indices=True, indices_are_sorted=True
+        batch.elapsed_ns, unique_indices=True, indices_are_sorted=True, mode="drop"
     )
     return LimiterState(pn=pn, elapsed=elapsed)
 

@@ -191,6 +191,12 @@ class DeltaArrays(NamedTuple):
         return len(self.rows)
 
 
+# Sentinel row for fold-padding: far above any bucket row (pools are
+# ≤ ~2^24 rows) yet int32-safe after the +arange(k) uniquifier. Scatters
+# drop it via mode="drop" (ops/merge.py merge_batch_folded).
+_FOLD_PAD_ROW = 1 << 30
+
+
 def _pad_size(n: int, lo: int = 8, hi: int = MAX_MERGE_ROWS) -> int:
     """Next power of two ≥ n, bounded — keeps the jit-variant count ~log."""
     size = lo
@@ -1339,6 +1345,8 @@ class DeviceEngine:
             self._apply_scalar_merges(scalar_subset)
 
     def _apply_lane_merges(self, deltas: DeltaArrays) -> None:
+        if not len(deltas):  # a zero-length chunk is a no-op tick
+            return
         # Merge-kernel selection: "scatter" (XLA, default), "pallas" (the
         # block-sparse TPU kernel whenever it can run natively), or "auto"
         # (per-batch heuristic: pallas iff the batch is block-sparse,
@@ -1405,11 +1413,21 @@ class DeviceEngine:
         tick shrinks to its unique-key count before padding). Folding is
         exactly the join the kernel computes, so order never matters.
 
-        Padding repeats the FIRST entry verbatim — identical key+values
-        are safe under asserted-unique scatters no matter which duplicate
-        the compiler lets win, and a repeated smallest-key entry keeps the
-        arrays sorted. Returns the packed int64[6, k] tick matrix:
+        Padding appends out-of-bounds SENTINEL keys (row ``_FOLD_PAD_ROW``
+        far above any bucket row, distinct slot/row per entry) that the
+        scatter's ``mode="drop"`` discards — every index the kernel sees
+        is genuinely unique and sorted, so the asserted scatter flags are
+        literally true rather than resting on duplicate-index behavior.
+        A zero-length tick folds to an all-sentinel (no-op) matrix.
+        Returns the packed int64[6, k] tick matrix:
         rows, slots, added, taken, erows, elapsed."""
+        if not len(deltas):
+            k = _pad_size(0)
+            packed = np.zeros((6, k), dtype=np.int64)
+            packed[0] = _FOLD_PAD_ROW
+            packed[1] = np.arange(k)
+            packed[4] = _FOLD_PAD_ROW + np.arange(k)
+            return packed
         order = np.lexsort((deltas.slots, deltas.rows))
         r = deltas.rows[order]
         s = deltas.slots[order]
@@ -1430,19 +1448,20 @@ class DeviceEngine:
         ne = len(row_starts)
         k = _pad_size(n)
         packed = np.empty((6, k), dtype=np.int64)
-        # Pad-first with the smallest key so sortedness survives padding.
-        packed[0, : k - n] = r[starts[0]]
-        packed[1, : k - n] = s[starts[0]]
-        packed[2, : k - n] = a[0]
-        packed[3, : k - n] = t[0]
-        packed[0, k - n :] = r[starts]
-        packed[1, k - n :] = s[starts]
-        packed[2, k - n :] = a
-        packed[3, k - n :] = t
-        packed[4, : k - ne] = er[0]
-        packed[5, : k - ne] = e[0]
-        packed[4, k - ne :] = er
-        packed[5, k - ne :] = e
+        packed[0, :n] = r[starts]
+        packed[1, :n] = s[starts]
+        packed[2, :n] = a
+        packed[3, :n] = t
+        # Sentinel tail: rows above every live row keep the keys sorted;
+        # distinct slots keep them unique; mode="drop" discards them.
+        packed[0, n:] = _FOLD_PAD_ROW
+        packed[1, n:] = np.arange(k - n)
+        packed[2, n:] = 0
+        packed[3, n:] = 0
+        packed[4, :ne] = er
+        packed[5, :ne] = e
+        packed[4, ne:] = _FOLD_PAD_ROW + np.arange(k - ne)
+        packed[5, ne:] = 0
         return packed
 
     def _apply_scalar_merges(self, deltas: DeltaArrays) -> None:

@@ -561,10 +561,10 @@ class TestTPURepo:
 class TestTickFold:
     """The tick-level merge fold (engine._fold_lane_merges): sorts by
     (row, slot), max-joins duplicate keys, folds elapsed per row, and pads
-    by repeating a live entry — the preparation that lets the device
-    scatter assert unique+sorted indices. CPU CI never takes this path by
-    default (the fold is gated to accelerator backends), so these tests
-    force it."""
+    with unique out-of-bounds sentinel keys the scatter drops — the
+    preparation that lets the device scatter assert unique+sorted indices
+    truthfully. CPU CI never takes this path by default (the fold is
+    gated to accelerator backends), so these tests force it."""
 
     def test_fold_matches_unfolded_join(self):
         import numpy as np
@@ -618,12 +618,20 @@ class TestTickFold:
         )
         assert np.array_equal(np.asarray(ref.pn), np.asarray(got.pn))
         assert np.array_equal(np.asarray(ref.elapsed), np.asarray(got.elapsed))
-        # Fold invariants the scatter flags rely on.
-        key = packed[0] * 1000 + packed[1]
-        assert (np.diff(key) >= 0).all(), "(row, slot) keys not sorted"
-        live = np.unique(key)
-        assert len(live) == len(np.unique(np.stack([rows, slots]), axis=1).T)
-        assert (np.diff(packed[4]) >= 0).all(), "elapsed rows not sorted"
+        # Fold invariants the scatter flags rely on: keys strictly unique
+        # and sorted ACROSS the whole matrix (padding included), with the
+        # padding out of bounds so mode="drop" discards it.
+        from patrol_tpu.runtime.engine import _FOLD_PAD_ROW
+
+        key = packed[0] * 100000 + packed[1]
+        assert (np.diff(key) > 0).all(), "(row, slot) keys not strictly sorted"
+        live = packed[0] < _FOLD_PAD_ROW
+        assert live.sum() == len(np.unique(np.stack([rows, slots]), axis=1).T)
+        assert (packed[0][~live] >= 64).all(), "padding keys must be OOB"
+        assert (np.diff(packed[4]) > 0).all(), "elapsed rows not strictly sorted"
+        elive = packed[4] < _FOLD_PAD_ROW
+        assert elive.sum() == len(np.unique(rows))
+        assert (packed[4][~elive] >= 64).all()
 
     def test_fold_equivalence_randomized(self):
         """Multi-seed law check: for ANY batch (duplicates, hot keys,
@@ -682,6 +690,52 @@ class TestTickFold:
             assert np.array_equal(
                 np.asarray(ref.elapsed), np.asarray(got.elapsed)
             ), seed
+
+    def test_fold_empty_batch_is_noop(self):
+        """A zero-length tick folds to an all-sentinel matrix whose merge
+        leaves state untouched (ADVICE r3: the unfolded path handled n=0;
+        the folded path must too — an IndexError here silently drops the
+        whole tick via the tick loop's catch-all)."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from patrol_tpu.models.limiter import init_state
+        from patrol_tpu.ops.merge import FoldedMergeBatch, merge_batch_folded
+        from patrol_tpu.runtime.engine import (
+            _FOLD_PAD_ROW,
+            DeltaArrays,
+            DeviceEngine,
+        )
+
+        empty = DeltaArrays(
+            rows=np.empty(0, np.int64),
+            slots=np.empty(0, np.int64),
+            added_nt=np.empty(0, np.int64),
+            taken_nt=np.empty(0, np.int64),
+            elapsed_ns=np.empty(0, np.int64),
+            scalar=np.empty(0, bool),
+        )
+        packed = DeviceEngine._fold_lane_merges(empty)
+        assert (packed[0] >= _FOLD_PAD_ROW).all()
+        assert (packed[4] >= _FOLD_PAD_ROW).all()
+        cfg = LimiterConfig(buckets=16, nodes=4)
+        before = init_state(cfg)
+        after = merge_batch_folded(
+            before,
+            FoldedMergeBatch(
+                rows=jnp.asarray(packed[0], jnp.int32),
+                slots=jnp.asarray(packed[1], jnp.int32),
+                added_nt=jnp.asarray(packed[2]),
+                taken_nt=jnp.asarray(packed[3]),
+                erows=jnp.asarray(packed[4], jnp.int32),
+                elapsed_ns=jnp.asarray(packed[5]),
+            ),
+        )
+        assert np.array_equal(np.asarray(before.pn), np.asarray(after.pn))
+        assert np.array_equal(
+            np.asarray(before.elapsed), np.asarray(after.elapsed)
+        )
 
     def test_engine_forced_fold_end_to_end(self, monkeypatch):
         import numpy as np

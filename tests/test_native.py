@@ -273,9 +273,8 @@ class TestRxDedup:
     ceiling under hot-key storms, config #4)."""
 
     def test_duplicates_fold_to_max_and_state_converges(self):
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        # The suite-wide CPU pin lives in conftest.py (set before any
+        # backend initializes); no per-test global config mutation here.
         from patrol_tpu.models.limiter import LimiterConfig
         from patrol_tpu.ops import wire as w
         from patrol_tpu.runtime.engine import DeviceEngine
@@ -349,9 +348,6 @@ class TestRxDedup:
         one ~65 µs."""
         import time
 
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
         from patrol_tpu.models.limiter import LimiterConfig
         from patrol_tpu.runtime.engine import DeviceEngine
 
