@@ -1418,7 +1418,9 @@ class DeviceEngine:
         scatter's ``mode="drop"`` discards — every index the kernel sees
         is genuinely unique and sorted, so the asserted scatter flags are
         literally true rather than resting on duplicate-index behavior.
-        A zero-length tick folds to an all-sentinel (no-op) matrix.
+        A zero-length tick folds to an all-sentinel (no-op) matrix —
+        reachable only by direct callers (tests): the engine's tick loop
+        early-returns on empty chunks before folding.
         Returns the packed int64[6, k] tick matrix:
         rows, slots, added, taken, erows, elapsed."""
         if not len(deltas):
@@ -1465,18 +1467,25 @@ class DeviceEngine:
         return packed
 
     def _apply_scalar_merges(self, deltas: DeltaArrays) -> None:
-        """Deficit-attribution merge of reference-peer deltas (interop)."""
-        n = len(deltas)
-        k = _pad_size(n)
-        packed = np.zeros((5, k), dtype=np.int64)
-        packed[0, :n] = deltas.rows
-        packed[1, :n] = deltas.slots
-        packed[2, :n] = deltas.added_nt
-        packed[3, :n] = deltas.taken_nt
-        packed[4, :n] = deltas.elapsed_ns
-        with self._state_mu:
-            self.state = _jit_merge_scalar_packed()(self.state, jnp.asarray(packed))
-        self._ticks += 1
+        """Deficit-attribution merge of reference-peer deltas (interop).
+        Chunks batches past the padded-shape cap — _pad_size clamps at
+        MAX_MERGE_ROWS, so a bigger batch would otherwise overflow its
+        packed matrix and fail the whole tick."""
+        for lo in range(0, len(deltas), MAX_MERGE_ROWS):
+            chunk = DeltaArrays(*(a[lo : lo + MAX_MERGE_ROWS] for a in deltas))
+            n = len(chunk)
+            k = _pad_size(n)
+            packed = np.zeros((5, k), dtype=np.int64)
+            packed[0, :n] = chunk.rows
+            packed[1, :n] = chunk.slots
+            packed[2, :n] = chunk.added_nt
+            packed[3, :n] = chunk.taken_nt
+            packed[4, :n] = chunk.elapsed_ns
+            with self._state_mu:
+                self.state = _jit_merge_scalar_packed()(
+                    self.state, jnp.asarray(packed)
+                )
+            self._ticks += 1
 
     def _apply_takes(self, tickets: Sequence[TakeTicket]) -> None:
         keys, groups = self._group_tickets(tickets)

@@ -15,6 +15,7 @@ the host router places every take in its row's home (replica, shard) block
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -30,6 +31,21 @@ from patrol_tpu.runtime.engine import (
     TakeTicket,
     _pad_size,
 )
+
+log = logging.getLogger("patrol.mesh")
+
+
+# The largest (diagonal) block size warmup() pre-compiles AND the hard cap
+# on any runtime tick's padded block size. _apply splits a bigger tick into
+# sequential ≤MESH_WARM_MAX sub-ticks instead of padding past the warmed
+# set — merges are idempotent CRDT joins and each take key rides exactly
+# one sub-tick, so the split is semantically just several smaller ticks,
+# and no reachable FUSED-step tick shape can JIT a fresh variant mid-serve
+# (a multi-second p99 spike on a remote-compile TPU). Scope: this covers
+# the fused merge+take+converge step only — the rare scalar-interop kernel
+# (_jit_merge_scalar_packed) still compiles lazily on its first
+# reference-peer batch per pad size.
+MESH_WARM_MAX = 1 << 12
 
 
 class MeshEngine(DeviceEngine):
@@ -73,6 +89,58 @@ class MeshEngine(DeviceEngine):
 
         keys, groups = self._group_tickets(tickets) if tickets else ([], {})
 
+        # Split a tick that could pad past the warmed shape set into
+        # sequential sub-ticks: a chunk of ≤MESH_WARM_MAX total keys or
+        # deltas can't fill any (replica, shard) block past MESH_WARM_MAX.
+        W = MESH_WARM_MAX
+        nd = len(deltas) if deltas is not None else 0
+        n_sub = max(
+            -(-len(keys) // W) if keys else 1, -(-nd // W) if nd else 1
+        )
+        if n_sub > 1:
+            for i in range(n_sub):
+                kchunk = keys[i * W : (i + 1) * W]
+                dchunk = (
+                    DeltaArrays(*(a[i * W : (i + 1) * W] for a in deltas))
+                    if nd > i * W
+                    else None
+                )
+                try:
+                    self._apply_block(
+                        dchunk,
+                        kchunk,
+                        {k: groups[k] for k in kchunk},
+                    )
+                except Exception:
+                    # Partial-failure discipline: earlier sub-ticks already
+                    # admitted takes and debited tokens on device — their
+                    # queued completions must stand. Fail ONLY the tickets
+                    # of this and later sub-ticks, and swallow (re-raising
+                    # would make the tick loop's catch-all race those live
+                    # completions with blanket failures). Scalar deltas are
+                    # independent of the fused step; break to apply them.
+                    log.exception(
+                        "mesh sub-tick %d/%d failed; failing undispatched "
+                        "takes only",
+                        i + 1,
+                        n_sub,
+                    )
+                    self._fail_tickets(
+                        [t for k in keys[i * W :] for t in groups[k]]
+                    )
+                    break
+        else:
+            self._apply_block(deltas if nd else None, keys, groups)
+        if scalar_subset is not None:
+            self._apply_scalar_merges(scalar_subset)
+
+    def _apply_block(
+        self,
+        deltas: Optional[DeltaArrays],
+        keys: List,
+        groups: Dict,
+    ) -> None:
+        """One fused sub-tick whose per-block fill is ≤ MESH_WARM_MAX."""
         plan = self.plan
         B = plan.blocks
 
@@ -86,7 +154,7 @@ class MeshEngine(DeviceEngine):
             blk = plan.block_index(replica, shard)
             placed.append((blk, fill_t[blk]))
             fill_t[blk] += 1
-        k_take = _pad_size(max(fill_t) if fill_t else 1, lo=8, hi=1 << 14)
+        k_take = _pad_size(max(fill_t) if fill_t else 1, lo=8, hi=MESH_WARM_MAX)
 
         if deltas is not None and len(deltas):
             d_rows = np.asarray(deltas.rows, dtype=np.int64)
@@ -96,7 +164,7 @@ class MeshEngine(DeviceEngine):
             max_fill = int(np.bincount(blk, minlength=B).max(initial=0))
         else:
             max_fill = 0
-        k_merge = _pad_size(max(max_fill, 1), lo=8, hi=1 << 14)
+        k_merge = _pad_size(max(max_fill, 1), lo=8, hi=MESH_WARM_MAX)
         # Square the paddings: only DIAGONAL (k, k) shapes ever compile, so
         # warmup's size sweep covers every runtime tick — an off-diagonal
         # (k_take, k_merge) pair would JIT a fresh variant mid-serve (a
@@ -136,8 +204,6 @@ class MeshEngine(DeviceEngine):
         with self._state_mu:
             self.state, res = self._step(self.state, mb, req)
         self._ticks += 1
-        if scalar_subset is not None:
-            self._apply_scalar_merges(scalar_subset)
 
         if not keys:
             jax.block_until_ready(self.state.pn)
@@ -168,9 +234,13 @@ class MeshEngine(DeviceEngine):
         self._enqueue_completion(complete, keys, groups)
 
     def warmup(self) -> None:
-        """Pre-compile the fused step at each padded block size."""
+        """Pre-compile the fused step at each padded block size — the full
+        diagonal through MESH_WARM_MAX, which _apply never exceeds (bigger
+        ticks split into sub-ticks), so the fused serve path never
+        compiles mid-serve (scalar-interop batches still compile lazily;
+        see MESH_WARM_MAX note)."""
         size = 8
-        while size <= 1 << 12:
+        while size <= MESH_WARM_MAX:
             req, mb = topo.route_requests(self.plan, [], [], size, size)
             with self._state_mu:
                 self.state, _ = self._step(self.state, mb, req)

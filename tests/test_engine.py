@@ -805,3 +805,35 @@ class TestTickFold:
                 eng.stop()
         assert np.array_equal(states["0"][0], states["1"][0])
         assert np.array_equal(states["0"][1], states["1"][1])
+
+
+class TestScalarMergeChunking:
+    def test_scalar_batch_past_pad_cap_chunks_instead_of_failing(self):
+        """_pad_size clamps at MAX_MERGE_ROWS; a scalar (reference-peer)
+        batch past it used to overflow its packed matrix (ValueError) and
+        fail the whole tick. It must chunk — sequential application is
+        exactly the reference's receive-loop semantics."""
+        import numpy as np
+
+        from patrol_tpu.runtime.engine import (
+            MAX_MERGE_ROWS,
+            DeltaArrays,
+            DeviceEngine,
+        )
+
+        eng = DeviceEngine(LimiterConfig(buckets=16, nodes=4), node_slot=0)
+        try:
+            n = MAX_MERGE_ROWS + 123
+            deltas = DeltaArrays(
+                rows=np.arange(n, dtype=np.int64) % 16,
+                slots=np.full(n, 1, np.int64),
+                added_nt=np.full(n, NANO, np.int64),
+                taken_nt=np.zeros(n, np.int64),
+                elapsed_ns=np.full(n, NANO, np.int64),
+                scalar=np.ones(n, bool),
+            )
+            eng._apply_scalar_merges(deltas)
+            pn = np.asarray(eng.state.pn)
+            assert (pn[:, 1, 0] > 0).all()  # every row's lane-1 got credit
+        finally:
+            eng.stop()

@@ -378,3 +378,62 @@ class TestRxDedup:
             assert int(eng.directory.pins.sum()) == 0
         finally:
             eng.stop()
+
+
+class TestResolverCollisionDiscipline:
+    """ptdir_resolve_one and pt_rx_classify pass-1 must answer identically
+    under 64-bit hash collision (ADVICE r3): both probe PAST an entry whose
+    hash matches but length differs (distinct same-hash names coexist in
+    the table), stop at the first (hash, len) match, and report a
+    byte-verify failure as a miss."""
+
+    def test_resolve_probes_past_same_hash_different_len(self):
+        import numpy as np
+
+        from patrol_tpu import native
+
+        lib = native.load()
+        if lib is None:
+            import pytest
+
+            pytest.skip("native library unavailable")
+
+        cap = 8
+        name_bytes = np.zeros((cap, native.PACKET), np.uint8)
+        name_len = np.zeros(cap, np.int32)
+        name_bytes[0, :2] = np.frombuffer(b"aa", np.uint8)
+        name_len[0] = 2
+        name_bytes[1, :3] = np.frombuffer(b"bbb", np.uint8)
+        name_len[1] = 3
+        name_bytes[2, :3] = np.frombuffer(b"ccc", np.uint8)
+        name_len[2] = 3
+        h = lib.pt_dir_create(cap, name_bytes, name_len)
+        assert h >= 0
+        try:
+            H = 0x12345678ABCDEF01  # forged: all three collide
+            for row in (0, 1, 2):
+                lib.pt_dir_insert(h, H, row)
+
+            def resolve(name: bytes):
+                buf = np.zeros((1, native.PACKET), np.uint8)
+                buf[0, : len(name)] = np.frombuffer(name, np.uint8)
+                rows = np.full(1, -1, np.int64)
+                pins = np.zeros(cap, np.int32)
+                last = np.zeros(cap, np.int64)
+                lib.pt_dir_resolve(
+                    h, 1, np.array([H], np.uint64), buf,
+                    np.array([len(name)], np.int32), rows, pins, last, 7,
+                )
+                return int(rows[0])
+
+            # len-mismatch entries are skipped, not treated as misses:
+            assert resolve(b"bbb") == 1
+            assert resolve(b"aa") == 0
+            # (hash, len) match with wrong bytes = miss (slow path), even
+            # though another same-hash same-len entry sits further on —
+            # the SAME residual pt_rx_classify pass-1 has, by design.
+            assert resolve(b"zzz") == -1
+            # unknown length: probes every same-hash entry, then misses.
+            assert resolve(b"dddd") == -1
+        finally:
+            lib.pt_dir_destroy(h)
