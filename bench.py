@@ -152,9 +152,23 @@ def _bench(
         # means an in-loop op pays a full output write per iteration —
         # only correct for DENSE stages that write the whole state
         # anyway; scatter-shaped stages must use the unrolled form.
-        @partial(jax.jit, donate_argnums=0)
-        def loop_n(s, n, *a):
-            return jax.lax.fori_loop(0, n, lambda _i, st: fn(st, *a), s)
+        # ``indexed``: fn also receives the int64 induction var and must
+        # vary its VALUES with it — a loop whose operands are all
+        # loop-invariant lets LICM hoist them and an idempotent body
+        # reach a fixpoint, both of which have fabricated results on this
+        # harness (a 73 PB/s "sweep" in r4's first probe).
+        if indexed:
+
+            @partial(jax.jit, donate_argnums=0)
+            def loop_n(s, n, *a):
+                return jax.lax.fori_loop(
+                    0, n, lambda i, st: fn(st, *a, i.astype(jnp.int64)), s
+                )
+        else:
+
+            @partial(jax.jit, donate_argnums=0)
+            def loop_n(s, n, *a):
+                return jax.lax.fori_loop(0, n, lambda _i, st: fn(st, *a), s)
 
         def run_lo(s, *a):
             return loop_n(s, jnp.int32(n_lo), *a)
@@ -416,9 +430,21 @@ def _run_stages(out) -> None:
     # extra 1.9 GB u32-half temps at this state size.)
     # Wider window + extra repeat: the number sits near the 50M/s target
     # and tunnel throttling variance (±20% run-to-run) must not decide it.
+    # The +i bias (induction var) makes every iteration VALUE-distinct:
+    # without it the idempotent max chain hits its fixpoint after one
+    # step and the plain-carry loop measures ~15% slow (20.7 vs 17.9 ms,
+    # r4 probe matrix) — a loop-carry artifact, not the kernel's cost. A
+    # loop-invariant zero operand is NOT a fix (LICM hoists it back to
+    # the plain form). The add is fused compute on the streamed operand
+    # (no extra HBM traffic — the pn-only variant measured 777 GB/s of
+    # 819), so the reported per-sweep time UPPER-bounds the production
+    # single-dispatch merge_dense: conservative, never flattering.
+    def _dense_step(st, o, i):
+        return merge_dense(st, LimiterState(pn=o.pn + i, elapsed=o.elapsed + i))
+
     dt_dense, state = _bench(
-        merge_dense, state, other,
-        iters=2, iters_hi=22, repeats=4, device_loop=True,
+        _dense_step, state, other,
+        iters=2, iters_hi=22, repeats=4, device_loop=True, indexed=True,
     )
     _record_dense(out, dt_dense, B, N, target)
     _stage_done("dense")
@@ -510,14 +536,21 @@ def _stage_dense_recheck(out, mk_states, B, N) -> None:
         return
     import gc
 
+    from patrol_tpu.models.limiter import LimiterState as _LS
     from patrol_tpu.ops.merge import merge_dense
 
     gc.collect()  # drop the engine stages' device buffers first
     try:
         state, other = mk_states()
+
+        # Same value-distinct (+i) guard as the first dense stage — see
+        # the comment there for why plain or zero-biased loops mismeasure.
+        def _dense_step(st, o, i):
+            return merge_dense(st, _LS(pn=o.pn + i, elapsed=o.elapsed + i))
+
         dt2, state = _bench(
-            merge_dense, state, other,
-            iters=2, iters_hi=22, repeats=3, device_loop=True,
+            _dense_step, state, other,
+            iters=2, iters_hi=22, repeats=3, device_loop=True, indexed=True,
         )
         out["dense_sweep_ms_recheck"] = round(dt2 * 1e3, 3)
         if dt2 * 1e3 < out["dense_sweep_ms"]:

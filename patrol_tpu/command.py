@@ -144,6 +144,9 @@ class Command:
                 "engine_evictions": engine.evictions,
                 "engine_scalar_dropped": engine.scalar_dropped,
                 "engine_pending_completions": engine.pending_completions,
+                "engine_hosted_buckets": engine.hosted_buckets,
+                "engine_host_takes": engine.host_takes,
+                "engine_promotions": engine.promotions,
                 "buckets": len(engine.directory),
                 "node_slot": slots.self_slot,
                 **replicator.stats(),

@@ -157,6 +157,15 @@ def merge_dense(state: LimiterState, other: LimiterState) -> LimiterState:
 
 
 merge_dense_jit = partial(jax.jit, donate_argnums=0)(merge_dense)
+# Benchmarking note (r4): timing merge_dense inside a fori carry loop
+# UNDERSTATES it by ~15% (20.7 vs 17.9 ms per 1M×256×2 sweep) unless each
+# iteration is made value-distinct with the induction var — the idempotent
+# max chain reaches its fixpoint after one step and the plain-carry loop
+# compiles/executes pessimally. A loop-invariant zero bias is NOT a guard
+# (LICM hoists it). Bit-reinterpreting the s64 stream to u32 pairs with a
+# lexicographic compare is 4-5× WORSE (stride-2 lane access defeats
+# vectorization). Measured via the forced-completion differential harness;
+# scripts/probe_dense_u32.py is the repro.
 
 
 def zero_rows(state: LimiterState, rows: jax.Array) -> LimiterState:

@@ -33,9 +33,11 @@ def save(directory: str, engine) -> str:
     """
     os.makedirs(directory, exist_ok=True)
     engine.flush()
-    with engine._state_mu:
-        pn = np.asarray(engine.state.pn)
-        elapsed = np.asarray(engine.state.elapsed)
+    # Atomic copy-and-join view: host-resident lanes are max-joined into
+    # the snapshot under the host lock (no promotion can slip between the
+    # device copy and the join), and residency is untouched — a periodic
+    # checkpoint must not erode the host fast path bucket by bucket.
+    pn, elapsed = engine.snapshot_planes()
 
     d = engine.directory
     rows = dict(d._rows)  # name -> row
@@ -85,6 +87,12 @@ def restore(directory: str, engine) -> int:
             f"ckpt ({meta['buckets']}×{meta['nodes']}) vs "
             f"engine ({engine.config.buckets}×{engine.config.nodes})"
         )
+
+    # Any live host-resident rows move device-side before the join: a
+    # restored name could collide with a hosted row, and the max-join
+    # below only sees device planes.
+    engine.flush_hosted()
+    engine.flush()
 
     data = np.load(os.path.join(directory, "state.npz"))
     import jax.numpy as jnp

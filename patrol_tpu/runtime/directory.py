@@ -408,8 +408,9 @@ class BucketDirectory:
             return row, created
 
     def _assign_many_common(
-        self, names: Sequence[str], now_ns: int, pin: bool, bind_fresh
-    ) -> np.ndarray:
+        self, names: Sequence[str], now_ns: int, pin: bool, bind_fresh,
+        with_fresh: bool = False,
+    ):
         """Shared scaffolding of the batch get-or-create variants: one lock
         acquisition, C-speed dict lookups, and the atomicity contract — if
         the pool cannot absorb every missing name, DirectoryFullError is
@@ -435,6 +436,15 @@ class BucketDirectory:
             self.last_used_ns[arr] = now_ns
             if pin:
                 np.add.at(self.pins, arr, 1)
+            if with_fresh:
+                # True for every occurrence of a name BOUND by this call —
+                # the host fast path's residency-eligibility signal (a
+                # cap==0 proxy would mis-host rows that already carry
+                # replicated device lanes).
+                fresh_mask = np.zeros(len(names), dtype=bool)
+                if missing:
+                    fresh_mask[np.asarray(missing)] = True
+                return arr, fresh_mask
             return arr
 
     def assign_many(
@@ -443,10 +453,13 @@ class BucketDirectory:
         now_ns: int,
         pin: bool = False,
         hashes: Optional[Sequence[int]] = None,
-    ) -> np.ndarray:
+        with_fresh: bool = False,
+    ):
         """Vectorized get-or-create for a delta chunk (string names).
         ``hashes`` (parallel to ``names``) passes pre-computed FNV values
-        through so the wire miss path never re-hashes in Python."""
+        through so the wire miss path never re-hashes in Python.
+        ``with_fresh=True`` additionally returns a bool mask of the
+        entries bound fresh by this call."""
 
         def bind_fresh(rows, missing, fresh):
             pend_rows: List[int] = []
@@ -469,7 +482,9 @@ class BucketDirectory:
                     self._ptdir, self.name_hash[pr], pr, len(pr)
                 )
 
-        return self._assign_many_common(names, now_ns, pin, bind_fresh)
+        return self._assign_many_common(
+            names, now_ns, pin, bind_fresh, with_fresh=with_fresh
+        )
 
     def assign_many_wire(
         self,

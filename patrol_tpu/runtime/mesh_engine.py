@@ -29,6 +29,7 @@ from patrol_tpu.runtime.engine import (
     DeltaArrays,
     DeviceEngine,
     TakeTicket,
+    _jit_merge_packed,
     _pad_size,
 )
 
@@ -244,6 +245,24 @@ class MeshEngine(DeviceEngine):
             req, mb = topo.route_requests(self.plan, [], [], size, size)
             with self._state_mu:
                 self.state, _ = self._step(self.state, mb, req)
+            size <<= 1
+        # The host-fast-path promotion drain (engine._drain_promotions)
+        # batches ALL pending rows' lanes into _jit_merge_packed chunks of
+        # up to MAX_MERGE_ROWS entries; a mass promotion (rx storm,
+        # checkpoint-restore flush_hosted) can reach any power-of-two pad
+        # size, and a first GSPMD compile mid-serve is the multi-second
+        # stall this warmup exists to prevent — warm the full diagonal.
+        import jax.numpy as jnp
+
+        from patrol_tpu.runtime.engine import MAX_MERGE_ROWS
+
+        size = 8
+        hi = _pad_size(MAX_MERGE_ROWS)
+        while size <= hi:
+            with self._state_mu:
+                self.state = _jit_merge_packed()(
+                    self.state, jnp.zeros((5, size), jnp.int64)
+                )
             size <<= 1
         size = 1
         while size <= 1024:

@@ -128,15 +128,14 @@ class TPURepo:
             # the introspection surface too.
             row, _ = self.engine.assign_row(name, self.engine.clock())
             self._maybe_incast(name)
-        pn_rows, elapsed_rows = self.engine.read_rows([row])
-        pn = pn_rows[0]
+        pn, elapsed = self.engine.row_view(row)  # host- or device-resident
         base = int(self.engine.directory.cap_base_nt[row])
         return (
             Bucket(
                 name=name,
                 added_nt=base + int(pn[:, 0].sum()),
                 taken_nt=int(pn[:, 1].sum()),
-                elapsed_ns=int(elapsed_rows[0]),
+                elapsed_ns=int(elapsed),
                 created_ns=int(self.engine.directory.created_ns[row]),
             ),
             existed,

@@ -1,0 +1,294 @@
+"""Host fast path (VERDICT r3 item 1 / SURVEY §7 hard-part #1): cold and
+low-QPS buckets are served by an in-process scalar-lane model (µs-class, no
+device hop) and promoted to the device path when hot or when replication
+touches them. These tests pin the path's THE invariant: a bucket's
+observable behavior is identical whether served on host or device, and a
+promotion is an exact CRDT join, never an approximation."""
+
+import numpy as np
+import pytest
+
+from patrol_tpu.models.limiter import NANO, LimiterConfig
+from patrol_tpu.ops import wire
+from patrol_tpu.ops.rate import Rate
+from patrol_tpu.runtime import engine as engine_mod
+from patrol_tpu.runtime.engine import DeviceEngine
+
+CFG = LimiterConfig(buckets=64, nodes=4)
+RATE = Rate(freq=10, per_ns=NANO)
+
+
+class FakeClock:
+    def __init__(self, start_ns: int = 0):
+        self.now = start_ns
+
+    def __call__(self) -> int:
+        return self.now
+
+    def advance(self, ns: int) -> None:
+        self.now += ns
+
+
+@pytest.fixture
+def engine():
+    eng = DeviceEngine(CFG, node_slot=0, clock=FakeClock())
+    yield eng
+    eng.stop()
+
+
+class TestResidency:
+    def test_cold_bucket_serves_from_host(self, engine):
+        for i in range(10):
+            remaining, ok, _ = engine.take("cold", RATE, 1)
+            assert ok and remaining == 9 - i
+        remaining, ok, _ = engine.take("cold", RATE, 1)
+        assert not ok and remaining == 0
+        assert engine.hosted_buckets == 1
+        assert engine.host_takes == 11
+        assert engine.promotions == 0
+        # Refill behaves identically on the host model.
+        engine.clock.advance(NANO)
+        remaining, ok, _ = engine.take("cold", RATE, 10)
+        assert ok and remaining == 0
+
+    def test_qps_threshold_promotes_exactly_once(self, engine):
+        n = engine_mod.HOST_PROMOTE_TAKES + 40
+        admitted = sum(
+            engine.take("hot", Rate(freq=n * 2, per_ns=NANO), 1)[1]
+            for _ in range(n)
+        )
+        assert admitted == n  # capacity 2n: every take admits, either path
+        engine.flush()  # promotion is deferred to the feeder's next tick
+        assert engine.promotions == 1
+        assert engine.hosted_buckets == 0
+        # The promotion join moved the host-era lanes to the device intact:
+        # total taken across residencies is n tokens.
+        pn, _ = engine.read_rows([engine.directory.lookup("hot")])
+        assert int(pn[0][:, 1].sum()) == n * NANO
+        assert int(pn[0][:, 0].sum()) == 0  # no refill commits at t=0
+
+    def test_rx_lane_delta_absorbs_into_host_lanes(self, engine):
+        """Exact lane deltas max-join INTO the host lanes (no promotion):
+        in a cluster every first take's state is echoed back within one
+        RTT (broadcast + incast reply, repo.go:86-90), and promoting on
+        any rx would end every hosted bucket after one take."""
+        engine.take("b", RATE, 3)  # hosted: lane 0 takes 3
+        assert engine.hosted_buckets == 1
+        engine.ingest_delta(
+            wire.from_nanotokens("b", 0, 5 * NANO, 0, origin_slot=2), slot=2
+        )
+        assert engine.hosted_buckets == 1 and engine.promotions == 0
+        assert engine.tokens_if_known("b") == 2  # 10 - 3 - 5, host view
+        states = {s.origin_slot: s for s in engine.snapshot("b")}
+        assert states[0].lane_taken_nt == 3 * NANO
+        assert states[2].lane_taken_nt == 5 * NANO
+        # The bucket keeps serving host-side with the merged picture.
+        remaining, ok, _ = engine.take("b", RATE, 2)
+        assert ok and remaining == 0
+        assert not engine.take("b", RATE, 1)[1]
+        assert engine.hosted_buckets == 1
+
+    def test_scalar_rx_delta_promotes(self, engine):
+        """v1 (reference-peer) scalar deltas need the deficit-attribution
+        kernel — the row moves to the device path, host lanes joined in
+        first (queue order)."""
+        engine.take("v", RATE, 3)
+        assert engine.hosted_buckets == 1
+        engine.ingest_delta(
+            wire.from_nanotokens("v", 12 * NANO, 2 * NANO, 7), slot=1,
+            scalar=True,
+        )
+        engine.flush()
+        assert engine.hosted_buckets == 0 and engine.promotions == 1
+        row = engine.directory.lookup("v")
+        pn, _ = engine.read_rows([row])
+        assert int(pn[0][0, 1]) == 3 * NANO  # host-era lane survived
+        # Deficit attribution ran AFTER the join (peer aggregate taken 2
+        # ≤ our sum 3 ⇒ no deficit to credit) — order parity with the
+        # device-only path, where the same sequence also yields 0.
+        assert int(pn[0][1, 1]) == 0
+
+    def test_rx_pressure_promotes(self, engine):
+        engine.take("p", RATE, 1)
+        assert engine.hosted_buckets == 1
+        n = engine_mod.HOST_PROMOTE_TAKES + 5
+        engine.ingest_deltas_batch(
+            ["p"] * n,
+            [2] * n,
+            list(range(NANO, NANO + n)),
+            [0] * n,
+            [0] * n,
+        )
+        engine.flush()
+        assert engine.hosted_buckets == 0 and engine.promotions == 1
+
+    def test_incast_snapshot_and_tokens_read_host_lanes(self, engine):
+        engine.take("s", RATE, 4)
+        assert engine.hosted_buckets == 1
+        states = engine.snapshot("s")  # no device read for hosted rows
+        assert len(states) == 1 and states[0].origin_slot == 0
+        assert states[0].lane_taken_nt == 4 * NANO
+        assert states[0].cap_nt == 10 * NANO
+        assert states[0].added_nt == 10 * NANO  # cap + Σ lane grants (0)
+        assert states[0].taken_nt == 4 * NANO
+        assert engine.tokens_if_known("s") == 6
+        assert engine.tokens_if_known("nope") is None
+        many = engine.snapshot_many(["s", "nope"])
+        assert set(many) == {"s"}
+        assert many["s"][0].lane_taken_nt == 4 * NANO
+
+    def test_release_drops_host_state(self, engine):
+        engine.take("old", RATE, 7)
+        assert engine.hosted_buckets == 1
+        assert engine.release_bucket("old")
+        assert engine.hosted_buckets == 0
+        remaining, ok, _ = engine.take("old", RATE, 1)
+        assert ok and remaining == 9  # fresh bucket, no leaked lanes
+
+    def test_checkpoint_save_includes_hosted(self, engine, tmp_path):
+        from patrol_tpu.runtime import checkpoint
+
+        engine.take("ck", RATE, 6)
+        assert engine.hosted_buckets == 1
+        checkpoint.save(str(tmp_path), engine)
+        eng2 = DeviceEngine(CFG, node_slot=0, clock=FakeClock())
+        try:
+            assert checkpoint.restore(str(tmp_path), eng2) == 1
+            assert eng2.tokens_if_known("ck") == 4
+        finally:
+            eng2.stop()
+
+
+class TestHostDeviceDifferential:
+    """The law: with the fast path forced OFF, an identical op sequence
+    must produce identical per-take results AND an identical final device
+    state (after flushing residency). Randomized over rates, counts, clock
+    advances, rx deltas (which promote), and mid-sequence promotions."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_randomized_sequences_match(self, seed, monkeypatch):
+        rng = np.random.default_rng(seed)
+        ops = []
+        names = [f"k{j}" for j in range(6)]
+        t = 0
+        for _ in range(120):
+            t += int(rng.integers(0, NANO // 3))
+            kind = rng.integers(0, 10)
+            name = names[int(rng.integers(0, len(names)))]
+            if kind < 7:
+                rate = Rate(freq=int(rng.integers(1, 20)), per_ns=NANO)
+                ops.append(("take", name, rate, int(rng.integers(1, 4)), t))
+            else:
+                ops.append(
+                    (
+                        "delta",
+                        name,
+                        int(rng.integers(0, 5)) * NANO,
+                        int(rng.integers(0, 5)) * NANO,
+                        t,
+                        int(rng.integers(1, 4)),
+                        bool(rng.integers(0, 3) == 0),  # scalar (v1) mix
+                    )
+                )
+
+        def run(fastpath: bool):
+            monkeypatch.setattr(engine_mod, "HOST_FASTPATH", fastpath)
+            clock = FakeClock()
+            eng = DeviceEngine(CFG, node_slot=0, clock=clock)
+            results = []
+            try:
+                for op in ops:
+                    if op[0] == "take":
+                        _, name, rate, count, now = op
+                        clock.now = now
+                        results.append(eng.take(name, rate, count))
+                    else:
+                        _, name, a, tk, now, slot, scalar = op
+                        clock.now = now
+                        eng.ingest_delta(
+                            wire.from_nanotokens(name, a, tk, now // 2),
+                            slot=slot,
+                            scalar=scalar,
+                        )
+                        if scalar:
+                            eng.flush()  # scalar order vs takes must match
+                eng.flush_hosted()
+                eng.flush()
+                rows = [eng.directory.lookup(n) for n in names]
+                pn, el = eng.read_rows([r for r in rows if r is not None])
+                state = {
+                    n: (pn[i].tolist(), int(el[i]))
+                    for i, n in enumerate(
+                        [n for n, r in zip(names, rows) if r is not None]
+                    )
+                }
+                return results, state
+            finally:
+                eng.stop()
+
+        res_fast, state_fast = run(True)
+        res_dev, state_dev = run(False)
+        assert res_fast == res_dev, f"seed {seed}: per-take results diverge"
+        assert state_fast == state_dev, f"seed {seed}: final states diverge"
+
+
+class TestReviewRegressions:
+    """r4 review findings: residency-eligibility and bookkeeping edges."""
+
+    def test_capless_lane_delta_rows_never_host(self, engine):
+        """A row created by a cap-less raw-lane delta carries replicated
+        device lanes with cap_base still 0 — the first local BATCHED take
+        must not host it (host lanes would shadow the device state and
+        over-admit)."""
+        engine.ingest_deltas_batch(
+            ["shadow"], [2], [0], [6 * NANO], [0]
+        )  # caps omitted: raw lane values, cap stays 0
+        engine.flush()
+        assert engine.hosted_buckets == 0
+        res = engine.submit_takes_batch(["shadow"], [RATE], [1])
+        res[0][0].wait()
+        assert engine.hosted_buckets == 0  # not bind-fresh: stayed device
+        # 10 (lazy cap) - 6 (peer lane) - 1 = 3
+        assert res[0][0].ok and res[0][0].remaining == 3
+
+    def test_checkpoint_save_keeps_residency(self, engine, tmp_path):
+        from patrol_tpu.runtime import checkpoint
+
+        engine.take("stay", RATE, 2)
+        assert engine.hosted_buckets == 1
+        checkpoint.save(str(tmp_path), engine)
+        assert engine.hosted_buckets == 1  # save is read-only on residency
+        assert engine.tokens_if_known("stay") == 8
+        eng2 = DeviceEngine(CFG, node_slot=0, clock=FakeClock())
+        try:
+            checkpoint.restore(str(tmp_path), eng2)
+            assert eng2.tokens_if_known("stay") == 8  # lanes still saved
+        finally:
+            eng2.stop()
+
+    def test_slow_takes_with_echoes_stay_hosted(self, engine):
+        """win_rx must roll over with the window: a 1-take-per-window
+        bucket whose every take is echoed back by a peer stays hosted
+        forever (the echo count per window never crosses the threshold)."""
+        clock = engine.clock
+        for i in range(engine_mod.HOST_PROMOTE_TAKES + 30):
+            engine.take("slow", Rate(freq=10**6, per_ns=NANO), 1)
+            st = engine.snapshot("slow")[0]  # what a peer would echo
+            engine.ingest_delta(st, slot=0)
+            clock.advance(2 * engine_mod.HOST_PROMOTE_WINDOW_NS)
+        assert engine.hosted_buckets == 1
+        assert engine.promotions == 0
+
+    def test_promotion_deltas_hold_pins(self, engine):
+        """r4 review: promotion deltas queue outside the assign path, but
+        the tick unconditionally unpins drained delta rows — they must
+        carry a pin each or the count underflows and eviction can yank a
+        row with takes still queued."""
+        n = engine_mod.HOST_PROMOTE_TAKES + 5
+        for _ in range(n):
+            engine.take("pin", Rate(freq=2 * n, per_ns=NANO), 1)
+        engine.flush()
+        assert engine.promotions == 1
+        row = engine.directory.lookup("pin")
+        assert int(engine.directory.pins[row]) == 0  # balanced, not -k
+        assert int(engine.directory.pins.min()) >= 0
