@@ -25,13 +25,98 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import time
-from typing import Callable, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 from urllib.parse import parse_qs, unquote
 
 from patrol_tpu.ops.rate import Rate, parse_rate
 from patrol_tpu.ops.wire import MAX_NAME_LENGTH_V1
 from patrol_tpu.runtime.repo import TPURepo
+
+# Python-front take batching (VERDICT r3 item 7): /take requests that
+# arrive within one event-loop iteration coalesce into ONE
+# repo.submit_takes_batch call — one directory pass, one queue append +
+# wake-up — instead of per-request submit_take lock/notify churn. The
+# reference's goroutine-per-request front has no per-request global lock;
+# this removes ours.
+PYFRONT_BATCH = os.environ.get("PATROL_PYFRONT_BATCH", "1") != "0"
+
+
+class _TakeBatcher:
+    """Leader-immediate event-loop micro-batcher. The FIRST /take of each
+    loop iteration dispatches immediately through the scalar path (zero
+    added latency — a plain call_soon deferral measured a 40% rps LOSS at
+    8 closed-loop workers because every response waited one scheduling
+    round); requests parsed later in the SAME iteration (other readable
+    sockets in this select cycle) accumulate and flush as ONE
+    submit_takes_batch at iteration end. Low concurrency ⇒ everyone is a
+    leader ⇒ identical to the per-request path; high concurrency ⇒ one
+    leader + (k−1) batched ⇒ one directory pass and one engine wake-up
+    for the bulk. Single-threaded by construction: every method runs on
+    the event loop."""
+
+    def __init__(self, repo: TPURepo):
+        self.repo = repo
+        self._pending: List[tuple] = []
+        self._in_iter = False
+
+    @staticmethod
+    def _wire(ticket, fut, loop) -> None:
+        def _done(t=ticket, f=fut):
+            loop.call_soon_threadsafe(
+                lambda: f.done() or f.set_result((t.remaining, t.ok))
+            )
+
+        ticket.add_done_callback(_done)
+
+    def submit(self, name: str, rate: Rate, count: int) -> asyncio.Future:
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        if not self._in_iter:
+            self._in_iter = True
+            loop.call_soon(self._iter_end, loop)
+            try:
+                self._wire(self.repo.submit_take(name, rate, count), fut, loop)
+            except Exception as exc:  # e.g. DirectoryFullError
+                fut.set_exception(exc)  # handler 500s, like take_async did
+            return fut
+        self._pending.append((name, rate, count, fut))
+        return fut
+
+    def _iter_end(self, loop) -> None:
+        self._in_iter = False
+        batch, self._pending = self._pending, []
+        if not batch:
+            return
+        try:
+            self._dispatch(batch, loop)
+        except Exception as exc:
+            # A swallowed exception here (call_soon context) would leave
+            # every queued future unresolved — requests hanging forever.
+            # Surface it per-request instead, like the per-request path.
+            for *_, fut in batch:
+                if not fut.done():
+                    fut.set_exception(exc)
+
+    def _dispatch(self, batch: List[tuple], loop) -> None:
+        if len(batch) == 1:
+            name, rate, count, fut = batch[0]
+            self._wire(self.repo.submit_take(name, rate, count), fut, loop)
+            return
+        res = self.repo.submit_takes_batch(
+            [b[0] for b in batch], [b[1] for b in batch], [b[2] for b in batch]
+        )
+        if res is None:
+            # Pool spent with every row pinned: same per-request outcome
+            # the engine's single path reports (DirectoryFullError class)
+            # — fail the batch as 429/0 rather than 500ing the front.
+            for *_, fut in batch:
+                if not fut.done():
+                    fut.set_result((0, False))
+            return
+        for (_, _, _, fut), (ticket, _created) in zip(batch, res):
+            self._wire(ticket, fut, loop)
 
 _STATUS_TEXT = {
     200: "OK",
@@ -52,6 +137,11 @@ class API:
         self.log = log
         self.stats = stats or (lambda: {})
         self.started_at = time.time()
+        self._batcher = (
+            _TakeBatcher(repo)
+            if PYFRONT_BATCH and hasattr(repo, "submit_takes_batch")
+            else None
+        )
 
     async def handle(
         self, method: str, path: str, query: str
@@ -112,7 +202,10 @@ class API:
         if count == 0:
             count = 1  # api.go:63-65
 
-        remaining, ok = await self.repo.take_async(name, rate, count)
+        if self._batcher is not None:
+            remaining, ok = await self._batcher.submit(name, rate, count)
+        else:
+            remaining, ok = await self.repo.take_async(name, rate, count)
         status = 200 if ok else 429
         if self.log is not None:
             self.log.debug(

@@ -75,9 +75,17 @@ BroadcastFn = Callable[[List[wire.WireState]], None]
 # The reference answers /take in-process in ~µs (api.go:51-86); a device
 # round-trip floors a cold bucket's p99 well above that on any hardware.
 HOST_FASTPATH = os.environ.get("PATROL_HOST_FASTPATH", "1") != "0"
-# Promote when a bucket sees more than this many host takes inside one
-# sliding window — past that, batching beats per-request python.
-HOST_PROMOTE_TAKES = int(os.environ.get("PATROL_HOST_PROMOTE_TAKES", 64))
+# Promote when a bucket sees more than this many host takes (or absorbed
+# rx deltas) inside one window. The default approximates the crossover
+# where device batching beats per-request host python: a host take costs
+# ~10-20 µs single-threaded (≈50-100k/s ceiling), so below ~40k/s per
+# bucket the in-process path is strictly faster than ANY device
+# round-trip; above it, coalescing thousands of requests into one kernel
+# row wins. Measured on this box (BASELINE_MEASURED r3): the device path
+# capped config #1 at 16.6k rps / 7.3 ms p99 while the host path holds
+# it sub-ms — a low threshold demoted exactly the buckets the fast path
+# exists for. Env-tunable for hosts with different single-core budgets.
+HOST_PROMOTE_TAKES = int(os.environ.get("PATROL_HOST_PROMOTE_TAKES", 4096))
 HOST_PROMOTE_WINDOW_NS = int(
     float(os.environ.get("PATROL_HOST_PROMOTE_WINDOW_MS", 100)) * 1e6
 )
@@ -684,10 +692,11 @@ class DeviceEngine:
     def _drain_promotions(self) -> None:
         """Complete pending host→device promotions: pop lanes + flip flags
         under ``_host_mu`` (brief), then apply ONE padded merge per
-        MAX_MERGE_ROWS chunk under ``_state_mu``. Runs on the feeder at
+        MAX_MERGE_ROWS chunk under ``_state_mu``. Callers: the FEEDER at
         tick start (before _apply, so same-tick device work sees the
-        joined planes) and from :meth:`flush_hosted`; concurrent drains
-        pop disjoint rows."""
+        joined planes — the ordering the promotion design relies on) and
+        :meth:`flush_hosted` only on a STOPPED engine; a live off-feeder
+        drain could flip flags and lose the join/apply ordering race."""
         with self._host_mu:
             if not self._promote_pending:
                 return
@@ -804,14 +813,40 @@ class DeviceEngine:
                 # NEXT bucket bound to this recycled row after one take.
                 self._promote_pending.discard(int(row))
 
-    def flush_hosted(self) -> int:
+    def flush_hosted(self, timeout: float = 10.0) -> int:
         """Promote every host-resident bucket to the device path (exact
         batched join). Used by checkpoint RESTORE, whose dense max-join
-        only sees device planes. Returns rows promoted."""
+        only sees device planes. Returns rows promoted.
+
+        The drain itself runs on the FEEDER (we only mark + wait): a
+        drain on this thread would flip residency flags, release the
+        host lock, and only then take the state lock for the join — a
+        racing take could route device-ward and be applied by the feeder
+        against pre-join planes (over-admission, and the later max-join
+        would erase the smaller own-lane debit). Feeder-driven drains
+        flip and join strictly before the same tick's _apply, which is
+        the ordering the promotion design relies on."""
         with self._host_mu:
             rows = list(self._hosted.keys())
             self._promote_pending.update(rows)
-        self._drain_promotions()
+        if not rows:
+            return 0
+        if self._stopped:
+            # Feeder is gone and no traffic can race a stopped engine:
+            # drain inline.
+            self._drain_promotions()
+            return len(rows)
+        with self._cond:
+            self._cond.notify()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._host_mu:
+                drained = not self._promote_pending
+            if drained:
+                with self._cond:
+                    if not self._busy:  # join landed (drain runs in-tick)
+                        return len(rows)
+            time.sleep(0.0005)
         return len(rows)
 
     def snapshot_planes(self) -> Tuple[np.ndarray, np.ndarray]:
