@@ -162,10 +162,21 @@ class Command:
             native_front = native_http.NativeHTTPFront(
                 api, host or "127.0.0.1", int(port)
             )
+            # h2c parity (command.go:41-44): a loopback python h2 server
+            # receives preface-bearing connections spliced through the
+            # C++ front, so `--http-front native` speaks BOTH protocols
+            # (h1 on the fast path, h2 at the python front's throughput).
+            server = await serve(api, "127.0.0.1", 0)
+            h2_port = server.sockets[0].getsockname()[1]
+            native_front.set_h2_backend(h2_port)
             base_stats = stats
 
             def stats_with_http() -> dict:  # /debug/vars includes the front
-                return {**base_stats(), **native_front.stats()}
+                return {
+                    **base_stats(),
+                    **native_front.stats(),
+                    "h2_backend_port": h2_port,
+                }
 
             api.stats = stats_with_http
         else:

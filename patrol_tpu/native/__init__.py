@@ -117,6 +117,8 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pt_http_complete_other.restype = ctypes.c_int
         lib.pt_http_stats.argtypes = [ctypes.c_int, _u64p]
         lib.pt_http_stats.restype = ctypes.c_int
+        lib.pt_http_set_h2_backend.argtypes = [ctypes.c_int, ctypes.c_uint16]
+        lib.pt_http_set_h2_backend.restype = ctypes.c_int
         lib.pt_http_stop.argtypes = [ctypes.c_int]
         lib.pt_http_stop.restype = ctypes.c_int
         lib.pt_dir_create.argtypes = [ctypes.c_int64, _u8p, _i32p]

@@ -237,6 +237,11 @@ struct Conn {
   bool close_after = false;
   bool want_close = false;  // fully close once wbuf drains
   size_t body_skip = 0;     // request body bytes still to drain
+  // h2c splice mode: this conn forwards raw bytes to/from its peer slot
+  // (an h2 client conn and its backend conn form a pair) — the h2
+  // protocol itself is served by the python front on the backend port.
+  bool proxy = false;
+  int peer_slot = -1;
   std::chrono::steady_clock::time_point req_start{};  // latency stamp
 };
 
@@ -252,6 +257,7 @@ struct Server {
   std::condition_variable cv;  // signals the Python pump: work available
   std::vector<Conn> conns;     // slot-indexed
   std::vector<int> free_slots;
+  uint16_t h2_backend_port = 0;  // 0 = h2c preface rejected with 400
   std::deque<TakeRec> take_q;
   std::deque<OtherRec> other_q;
   // Completions flow: pump → (mu) wbuf append → eventfd kick.
@@ -331,10 +337,11 @@ void epoll_mod(Server* s, int slot) {
 
 void close_conn(Server* s, int slot) {
   Conn& c = s->conns[slot];
-  if (c.fd >= 0) {
-    epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c.fd, nullptr);
-    ::close(c.fd);
-  }
+  if (c.fd < 0) return;  // already closed (e.g. via a splice pair-close):
+  // a second close must not re-push the slot into free_slots — two
+  // accepts would then alias one Conn.
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, c.fd, nullptr);
+  ::close(c.fd);
   c.fd = -1;
   c.gen++;  // invalidate outstanding tags
   c.rbuf.clear();
@@ -344,7 +351,63 @@ void close_conn(Server* s, int slot) {
   c.woff = 0;
   c.in_flight = c.close_after = c.want_close = false;
   c.body_skip = 0;
+  int peer = c.peer_slot;
+  c.proxy = false;
+  c.peer_slot = -1;
   s->free_slots.push_back(slot);
+  if (peer >= 0 && peer < (int)s->conns.size() &&
+      s->conns[peer].peer_slot == slot) {
+    // Unlink FIRST so the recursive close can't bounce back.
+    s->conns[peer].peer_slot = -1;
+    close_conn(s, peer);
+  }
+}
+
+// Turn an h2c client conn into a splice pair with a fresh backend conn
+// to the python front (which speaks the actual h2 protocol). The client
+// conn's buffered bytes (the preface and anything after it) are queued
+// verbatim to the backend. Returns false when the backend is not
+// configured or the connect fails — the caller falls back to the 400.
+bool start_h2_proxy(Server* s, int slot) {
+  if (s->h2_backend_port == 0) return false;
+  int bfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (bfd < 0) return false;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(s->h2_backend_port);
+  inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+  if (connect(bfd, (sockaddr*)&addr, sizeof(addr)) < 0 &&
+      errno != EINPROGRESS) {
+    ::close(bfd);
+    return false;
+  }
+  int one = 1;
+  setsockopt(bfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int bslot;
+  if (!s->free_slots.empty()) {
+    bslot = s->free_slots.back();
+    s->free_slots.pop_back();
+  } else {
+    bslot = (int)s->conns.size();
+    s->conns.emplace_back();
+  }
+  // emplace_back may reallocate: re-take the client ref after.
+  Conn& b = s->conns[bslot];
+  Conn& c = s->conns[slot];
+  b.fd = bfd;
+  b.proxy = true;
+  b.peer_slot = slot;
+  b.wbuf.swap(c.rbuf);  // forward everything read so far (incl. preface)
+  c.rbuf.clear();
+  c.proxy = true;
+  c.peer_slot = bslot;
+  c.in_flight = false;
+  c.req_start = {};
+  epoll_event ev{};
+  ev.events = EPOLLIN | (b.wbuf.size() ? EPOLLOUT : 0);
+  ev.data.u64 = make_tag(bslot, b.gen);
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, bfd, &ev);
+  return true;
 }
 
 // Parse one request out of c->rbuf (mu held). Returns false when more
@@ -369,6 +432,10 @@ bool try_parse_one(Server* s, int slot) {
     constexpr size_t kPrefaceLen = sizeof(kPreface) - 1;
     if (c.rbuf.size() >= kPrefaceLen &&
         c.rbuf.compare(0, kPrefaceLen, kPreface) == 0) {
+      // h2c prior-knowledge client: splice the connection to the python
+      // front's h2 server (protocol parity, command.go:41-44); without a
+      // backend, reject cleanly.
+      if (start_h2_proxy(s, slot)) return false;
       c.close_after = true;
       queue_response(s, &c, 400, "text/plain", "h2c not supported here\n", 23);
     } else if (c.rbuf.size() > kRbufMax) {
@@ -396,7 +463,9 @@ bool try_parse_one(Server* s, int slot) {
   if (method == "PRI") {
     // A complete h2 preface ("PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n") contains
     // \r\n\r\n, so it reaches the normal parse path rather than the
-    // incomplete-header preface check above.
+    // incomplete-header preface check above. NOTHING was consumed yet, so
+    // the proxy handoff forwards the raw buffer verbatim.
+    if (start_h2_proxy(s, slot)) return false;
     c.close_after = true;
     queue_response(s, &c, 400, "text/plain", "h2c not supported here\n", 23);
     c.rbuf.erase(0, consumed);
@@ -520,8 +589,8 @@ bool try_parse_one(Server* s, int slot) {
 }
 
 void flush_writes(Server* s, int slot) {
-  Conn& c = s->conns[slot];
   while (true) {
+    Conn& c = s->conns[slot];  // re-take: try_parse_one may grow conns
     while (c.woff < c.wbuf.size()) {
       ssize_t n = ::send(c.fd, c.wbuf.data() + c.woff, c.wbuf.size() - c.woff,
                          MSG_NOSIGNAL);
@@ -542,14 +611,15 @@ void flush_writes(Server* s, int slot) {
       close_conn(s, slot);
       return;
     }
+    if (c.proxy) break;  // splice conns carry no h1 requests to parse
     // Response done: a pipelined next request may already be buffered —
     // and may queue an immediate response (405/400), so loop until the
     // write buffer stays empty.
     bool parsed = false;
     while (try_parse_one(s, slot)) parsed = true;
-    if (!parsed || c.wbuf.empty()) break;
+    if (!parsed || s->conns[slot].wbuf.empty()) break;
   }
-  if (c.fd >= 0) epoll_mod(s, slot);
+  if (s->conns[slot].fd >= 0) epoll_mod(s, slot);
 }
 
 void serve_loop(Server* s) {
@@ -616,7 +686,10 @@ void serve_loop(Server* s) {
           ssize_t rd = recv(c.fd, buf, sizeof(buf), 0);
           if (rd > 0) {
             c.rbuf.append(buf, rd);
-            if (c.rbuf.size() > (size_t)kRbufMax * 4) {  // hostile flood
+            // Hostile-flood cap: h1 conns only. A splice conn's rbuf is
+            // a transit buffer cleared every event (large h2 bodies are
+            // legitimate); its backpressure is the peer-wbuf cap below.
+            if (!c.proxy && c.rbuf.size() > (size_t)kRbufMax * 4) {
               closed = true;
               break;
             }
@@ -625,16 +698,57 @@ void serve_loop(Server* s) {
           if (rd == 0) closed = true;
           break;  // EAGAIN or close
         }
+        if (c.proxy && c.peer_slot >= 0) {
+          // Splice: everything read forwards verbatim to the peer.
+          Conn& p = s->conns[c.peer_slot];
+          if (!c.rbuf.empty()) {
+            p.wbuf.append(c.rbuf);
+            c.rbuf.clear();
+          }
+          if (p.wbuf.size() - p.woff > (size_t)kRbufMax * 16) {
+            close_conn(s, slot);  // runaway peer backlog: drop the pair
+            continue;
+          }
+          if (p.fd >= 0 && p.wbuf.size() > p.woff)
+            flush_writes(s, c.peer_slot);
+          if (closed) {
+            // Half-close: let the peer DRAIN its pending bytes (the tail
+            // of an h2 response/GOAWAY) before closing — an immediate
+            // pair-close would clear its wbuf mid-flight.
+            int peer = c.peer_slot;
+            c.peer_slot = -1;
+            if (peer >= 0 && s->conns[peer].fd >= 0 &&
+                s->conns[peer].peer_slot == slot) {
+              Conn& pc = s->conns[peer];
+              pc.peer_slot = -1;  // unlink: no recursive close
+              if (pc.wbuf.size() > pc.woff) {
+                pc.want_close = true;  // close once drained
+              } else {
+                close_conn(s, peer);
+              }
+            }
+            close_conn(s, slot);
+            continue;
+          }
+          continue;
+        }
         if (closed && c.rbuf.empty()) {
           close_conn(s, slot);
           continue;
         }
         while (try_parse_one(s, slot)) {
         }
-        if (c.fd >= 0 && c.wbuf.size() > c.woff) flush_writes(s, slot);
-        if (closed && c.fd >= 0 && !c.in_flight) close_conn(s, slot);
+        // Re-take the ref: an h2 handoff inside try_parse_one may have
+        // grown the conn table (reference invalidation) and turned this
+        // conn into a splice.
+        Conn& c2 = s->conns[slot];
+        if (c2.fd >= 0 && c2.wbuf.size() > c2.woff) flush_writes(s, slot);
+        if (closed && s->conns[slot].fd >= 0 && !s->conns[slot].in_flight &&
+            !s->conns[slot].proxy)
+          close_conn(s, slot);
       }
-      if (c.fd >= 0 && (evs[i].events & EPOLLOUT)) flush_writes(s, slot);
+      if (s->conns[slot].fd >= 0 && (evs[i].events & EPOLLOUT))
+        flush_writes(s, slot);
     }
   }
 }
@@ -693,6 +807,17 @@ int pt_http_start(const char* ip, uint16_t port) {
 int pt_http_port(int h) {
   Server* s = g_servers[h];
   return s ? s->port : -1;
+}
+
+// Configure the h2c splice backend (the python front's loopback h2
+// server). 0 disables (preface → 400, the pre-r4 behavior).
+int pt_http_set_h2_backend(int h, uint16_t port) {
+  std::lock_guard<std::mutex> reg(g_reg_mu);
+  Server* s = g_servers[h];
+  if (!s) return -EBADF;
+  std::lock_guard<std::mutex> lk(s->mu);
+  s->h2_backend_port = port;
+  return 0;
 }
 
 // Drain parsed requests. Blocks up to timeout_ms when both queues are

@@ -36,7 +36,11 @@ NAME_MAX = 256
 
 
 class NativeHTTPFront:
-    """C++ epoll HTTP/1.1 server + Python batch pump."""
+    """C++ epoll HTTP/1.1 server + Python batch pump. h2c clients are
+    spliced byte-for-byte to a loopback python h2 server when one is
+    configured via :meth:`set_h2_backend` (protocol parity with the
+    reference's h2c front, command.go:41-44, at the python front's
+    throughput; h1 keep-alive stays on the C++ fast path)."""
 
     def __init__(self, api, host: str, port: int, batch: int = 1024):
         lib = native.load()
@@ -49,6 +53,7 @@ class NativeHTTPFront:
             import os
 
             raise OSError(-self.h, os.strerror(-self.h))
+        self.h2_backend_port = 0
         self.batch = batch
         b = batch
         self._tags = np.zeros(b, np.uint64)
@@ -186,6 +191,14 @@ class NativeHTTPFront:
         fut.add_done_callback(done)
 
     # -- lifecycle / observability -------------------------------------------
+
+    def set_h2_backend(self, port: int) -> None:
+        """Enable h2c prior-knowledge: preface-bearing connections splice
+        to the python h2 server at 127.0.0.1:``port``."""
+        rc = self.lib.pt_http_set_h2_backend(self.h, port)
+        if rc != 0:
+            raise OSError(-rc, "pt_http_set_h2_backend failed")
+        self.h2_backend_port = port
 
     def stats(self) -> dict:
         out = np.zeros(8, np.uint64)

@@ -295,6 +295,14 @@ class DeltaArrays(NamedTuple):
 # drop it via mode="drop" (ops/merge.py merge_batch_folded).
 _FOLD_PAD_ROW = 1 << 30
 
+# Fold-to-dense hybrid: a tick row touching at least this many lanes
+# commits its full lane plane as ONE row-window scatter update instead of
+# one update per lane (0 = auto: max(4, nodes // 3) — the point where the
+# row window's extra transfer bytes beat the per-update scatter cost on a
+# transfer-walled link; on a PCIe-attached chip 4 is already a win).
+ROW_DENSE_MIN = int(os.environ.get("PATROL_ROW_DENSE_MIN", 0))
+MAX_ROW_DENSE = 512  # padded-shape ceiling of the row-dense batch
+
 
 def _pad_size(n: int, lo: int = 8, hi: int = MAX_MERGE_ROWS) -> int:
     """Next power of two ≥ n, bounded — keeps the jit-variant count ~log."""
@@ -302,6 +310,52 @@ def _pad_size(n: int, lo: int = 8, hi: int = MAX_MERGE_ROWS) -> int:
     while size < n and size < hi:
         size <<= 1
     return size
+
+
+def fold_hybrid(deltas: DeltaArrays, nodes: int, row_dense_min: int):
+    """Fold-to-dense hybrid split (VERDICT r3 item 3): rows whose tick
+    touches ≥ ``row_dense_min`` lanes commit their FULL lane plane as ONE
+    row-window scatter update (TPU scatter is per update, window size
+    free — a hot-key tick collapses from ~N updates to 1); the sparse
+    remainder rides the flagged pair scatter. Returns
+    (packed|None, (rows, updates, elapsed)|None); module-level so the
+    bench measures the exact engine-tick computation."""
+    ur, us, ua, ut, er, e = DeviceEngine._fold_core(deltas)
+    nrow = np.empty(len(ur), bool)
+    nrow[0] = True
+    np.not_equal(ur[1:], ur[:-1], out=nrow[1:])
+    rstart = np.flatnonzero(nrow)
+    counts = np.diff(np.append(rstart, len(ur)))
+    dense_sel = counts >= row_dense_min
+    if not dense_sel.any():
+        return DeviceEngine._pack_folded(ur, us, ua, ut, er, e), None
+    di = np.flatnonzero(dense_sel)
+    if len(di) > MAX_ROW_DENSE:
+        # Cap the dense batch at its padded-shape ceiling; the
+        # overflow rides the sparse scatter (correct, just slower).
+        dense_sel = np.zeros_like(dense_sel)
+        dense_sel[di[:MAX_ROW_DENSE]] = True
+    pair_dense = np.repeat(dense_sel, counts)
+    d_rows = er[dense_sel]  # unique + sorted (er follows ur's order)
+    R = len(d_rows)
+    upd = np.zeros((R, nodes, 2), dtype=np.int64)
+    pr_idx = np.repeat(np.arange(R), counts[dense_sel])
+    upd[pr_idx, us[pair_dense], 0] = ua[pair_dense]
+    upd[pr_idx, us[pair_dense], 1] = ut[pair_dense]
+    sparse = ~pair_dense
+    packed = DeviceEngine._pack_folded(
+        ur[sparse], us[sparse], ua[sparse], ut[sparse],
+        er[~dense_sel], e[~dense_sel],
+    )
+    rp = _pad_size(R, lo=8, hi=MAX_ROW_DENSE)
+    rows_p = np.empty(rp, dtype=np.int64)
+    rows_p[:R] = d_rows
+    rows_p[R:] = _FOLD_PAD_ROW + np.arange(rp - R)  # OOB, unique, sorted
+    upd_p = np.zeros((rp, nodes, 2), dtype=np.int64)
+    upd_p[:R] = upd
+    el_p = np.zeros(rp, dtype=np.int64)
+    el_p[:R] = e[dense_sel]
+    return packed, (rows_p, upd_p, el_p)
 
 
 # Packed-transfer variants: host↔device latency is dominated by per-array
@@ -374,6 +428,22 @@ def _jit_merge_packed_folded():
 
 
 @lru_cache(maxsize=8)
+def _jit_merge_rows_dense():
+    """Row-window scatter-max — the dense half of the fold-to-dense
+    hybrid (one update per row, full lane plane per window)."""
+
+    def step(state, rows, updates, elapsed):
+        batch = merge_mod.RowDenseBatch(
+            rows=rows.astype(jnp.int32),
+            updates=updates,
+            elapsed_ns=elapsed,
+        )
+        return merge_mod.merge_rows_dense(state, batch)
+
+    return jax.jit(step, donate_argnums=0)
+
+
+@lru_cache(maxsize=8)
 def _jit_merge_scalar_packed():
     """Deficit-attribution merge for scalar-semantics (reference-peer)
     deltas — interop path, typically a small batch."""
@@ -408,6 +478,7 @@ class DeviceEngine:
         self.node_slot = node_slot
         self.clock = clock
         self.on_broadcast = on_broadcast
+        self._row_dense_min = ROW_DENSE_MIN or max(4, config.nodes // 3)
         self.directory = BucketDirectory(config.buckets)
         self.state: LimiterState = init_state(config, device=device)
 
@@ -1580,6 +1651,34 @@ class DeviceEngine:
                     self.state, jnp.zeros((5, size), jnp.int64)
                 )
             size <<= 1
+        if jax.default_backend() != "cpu":
+            # The accelerator tick path commits through the FOLDED kernel
+            # (flags asserted) — warm its variants too, or the first real
+            # tick compiles mid-serve.
+            size = 8
+            while size <= MAX_MERGE_ROWS:
+                packed = np.zeros((6, size), np.int64)
+                packed[0] = _FOLD_PAD_ROW
+                packed[1] = np.arange(size)
+                packed[4] = _FOLD_PAD_ROW + np.arange(size)
+                with self._state_mu:
+                    self.state = _jit_merge_packed_folded()(
+                        self.state, jnp.asarray(packed)
+                    )
+                size <<= 1
+            # Fold-to-dense row-window commits ride the same accel-only
+            # fold path — CPU ticks never reach either kernel.
+            size = 8
+            while size <= MAX_ROW_DENSE:
+                with self._state_mu:
+                    self.state = _jit_merge_rows_dense()(
+                        self.state,
+                        jnp.full((size,), _FOLD_PAD_ROW, jnp.int64)
+                        + jnp.arange(size, dtype=jnp.int64),
+                        jnp.zeros((size, self.config.nodes, 2), jnp.int64),
+                        jnp.zeros((size,), jnp.int64),
+                    )
+                size <<= 1
         size = 1
         while size <= 1024:  # snapshot/introspection gathers
             self.read_rows(np.zeros(size, np.int32))
@@ -1990,11 +2089,20 @@ class DeviceEngine:
         # no effect there.
         fold_default = "0" if jax.default_backend() == "cpu" else "1"
         if os.environ.get("PATROL_TICK_FOLD", fold_default) != "0":
-            packed = self._fold_lane_merges(deltas)
+            packed, dense = self._fold_hybrid(deltas)
             with self._state_mu:
-                self.state = _jit_merge_packed_folded()(
-                    self.state, jnp.asarray(packed)
-                )
+                if dense is not None:
+                    rows_p, upd_p, el_p = dense
+                    self.state = _jit_merge_rows_dense()(
+                        self.state,
+                        jnp.asarray(rows_p),
+                        jnp.asarray(upd_p),
+                        jnp.asarray(el_p),
+                    )
+                if packed is not None:
+                    self.state = _jit_merge_packed_folded()(
+                        self.state, jnp.asarray(packed)
+                    )
             self._ticks += 1
             return
         n = len(deltas)
@@ -2035,6 +2143,12 @@ class DeviceEngine:
             packed[1] = np.arange(k)
             packed[4] = _FOLD_PAD_ROW + np.arange(k)
             return packed
+        return DeviceEngine._pack_folded(*DeviceEngine._fold_core(deltas))
+
+    @staticmethod
+    def _fold_core(deltas: DeltaArrays):
+        """The fold computation: → (unique-pair rows, slots, added, taken,
+        per-unique-row rows, elapsed), all sorted, duplicates max-joined."""
         order = np.lexsort((deltas.slots, deltas.rows))
         r = deltas.rows[order]
         s = deltas.slots[order]
@@ -2051,16 +2165,24 @@ class DeviceEngine:
         row_starts = np.flatnonzero(new_row)
         er = r[row_starts]
         e = np.maximum.reduceat(el_sorted, row_starts)
-        n = len(starts)
-        ne = len(row_starts)
+        return r[starts], s[starts], a, t, er, e
+
+    @staticmethod
+    def _pack_folded(ur, us, ua, ut, er, e) -> Optional[np.ndarray]:
+        """Sentinel-padded int64[6, k] tick matrix from folded arrays
+        (None when empty). Sentinel tail: rows above every live row keep
+        the keys sorted; distinct slots keep them unique; mode="drop"
+        discards them."""
+        n = len(ur)
+        if n == 0:
+            return None
+        ne = len(er)
         k = _pad_size(n)
         packed = np.empty((6, k), dtype=np.int64)
-        packed[0, :n] = r[starts]
-        packed[1, :n] = s[starts]
-        packed[2, :n] = a
-        packed[3, :n] = t
-        # Sentinel tail: rows above every live row keep the keys sorted;
-        # distinct slots keep them unique; mode="drop" discards them.
+        packed[0, :n] = ur
+        packed[1, :n] = us
+        packed[2, :n] = ua
+        packed[3, :n] = ut
         packed[0, n:] = _FOLD_PAD_ROW
         packed[1, n:] = np.arange(k - n)
         packed[2, n:] = 0
@@ -2070,6 +2192,9 @@ class DeviceEngine:
         packed[4, ne:] = _FOLD_PAD_ROW + np.arange(k - ne)
         packed[5, ne:] = 0
         return packed
+
+    def _fold_hybrid(self, deltas: DeltaArrays):
+        return fold_hybrid(deltas, self.config.nodes, self._row_dense_min)
 
     def _apply_scalar_merges(self, deltas: DeltaArrays) -> None:
         """Deficit-attribution merge of reference-peer deltas (interop).
