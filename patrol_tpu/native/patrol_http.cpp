@@ -698,6 +698,17 @@ void serve_loop(Server* s) {
           if (rd == 0) closed = true;
           break;  // EAGAIN or close
         }
+        if (c.proxy && c.peer_slot < 0) {
+          // Orphaned splice (peer closed; we survive only to drain
+          // want_close writes): incoming bytes have no destination —
+          // discard them (unbounded rbuf otherwise, the flood cap is
+          // proxy-exempt), and EOF closes NOW (the h1 tail below skips
+          // proxy conns, which would leave a level-triggered EPOLLIN
+          // refiring on the dead socket forever).
+          c.rbuf.clear();
+          if (closed) close_conn(s, slot);
+          continue;
+        }
         if (c.proxy && c.peer_slot >= 0) {
           // Splice: everything read forwards verbatim to the peer.
           Conn& p = s->conns[c.peer_slot];

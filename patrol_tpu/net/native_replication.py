@@ -26,7 +26,7 @@ import numpy as np
 
 from patrol_tpu import native
 from patrol_tpu.ops import wire
-from patrol_tpu.net.replication import SlotTable, parse_addr, _resolve
+from patrol_tpu.net.replication import ReplyGate, SlotTable, parse_addr, _resolve
 
 log = logging.getLogger("patrol.native-replication")
 
@@ -70,6 +70,7 @@ class NativeReplicator:
         self._peer_ips = np.array([_ip_to_u32(h) for h, _ in peers], np.uint32)
         self._peer_ports = np.array([p for _, p in peers], np.uint16)
         self.repo = None  # wired by the supervisor
+        self.reply_gate = ReplyGate()
         self.rx_packets = 0
         self.rx_errors = 0
         self.tx_packets = 0
@@ -194,7 +195,14 @@ class NativeReplicator:
         return pkts, szs
 
     def _reply_incasts(self, requests) -> None:
-        """Serve a batch of incast requests with ONE device gather."""
+        """Serve a batch of incast requests with ONE device gather. The
+        reply gate bounds storm amplification: one burst per (bucket,
+        requester) per TTL (see replication.ReplyGate)."""
+        requests = [
+            r for r in requests if self.reply_gate.allow(r[0], (r[1], r[2]))
+        ]
+        if not requests:
+            return
         by_name = self.repo.engine.snapshot_many([name for name, _, _, _ in requests])
         for name, ip, port, multi_ok in requests:
             states = by_name.get(name)
@@ -324,6 +332,7 @@ class NativeReplicator:
             "replication_rx_errors": self.rx_errors,
             "replication_tx_packets": self.tx_packets,
             "replication_peers": len(self.peers),
+            "replication_incast_suppressed": self.reply_gate.suppressed,
             "replication_backend": 1,  # 1 = native
         }
 

@@ -401,7 +401,18 @@ def pack_multi(states: Sequence[WireState]) -> List[WireState]:
     back to the input unchanged when the states lack lane/cap data or only
     one lane exists (the 30 B lane trailer is smaller than a 33 B 1-lane
     multi). Every packet repeats the full aggregate header — idempotent
-    under the reference's scalar max-merge, like the per-lane form."""
+    under the reference's scalar max-merge, like the per-lane form.
+
+    Amplification bound: the reply to one incast request is EXACTLY
+    ⌈non-zero lanes / max_multi_lanes(len(name))⌉ packets — ~12 lanes per
+    packet at short names, so a flagship-shape 256-lane bucket answers in
+    ~22 packets where the per-lane form would send 256 (the reference
+    sends 1, but carries one scalar pair where we carry every PN lane).
+    Responder-side pacing on top of this bound lives in
+    net/replication.py ``ReplyGate``: one burst per (bucket, requester)
+    per TTL, so a cold-start storm's reply traffic is bounded by
+    distinct-requesters × ⌈lanes/per-packet⌉ per TTL window, regardless
+    of request rate."""
     if len(states) <= 1:
         return list(states)
     first = states[0]

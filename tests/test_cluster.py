@@ -394,3 +394,105 @@ class TestWireModeCompat:
             probe.close()
             for cl in clients:
                 cl.close()
+
+
+class TestFlagshipIncastDiscipline:
+    """VERDICT r3 item 8: the 256-lane (flagship-shape) incast reply path —
+    packet count exactly the ⌈lanes/per-packet⌉ bound, every lane delivered
+    once, and the responder-side gate bounds storm traffic."""
+
+    def test_pack_multi_256_lanes_meets_bound(self):
+        import math
+
+        from patrol_tpu.ops import wire
+
+        name = "flagship"
+        states = [
+            wire.from_nanotokens(
+                name, (i + 1) * wire.NANO, i * wire.NANO, 7,
+                origin_slot=i, cap_nt=10 * wire.NANO,
+                lane_added_nt=(i + 1) * wire.NANO, lane_taken_nt=i * wire.NANO,
+            )
+            for i in range(256)
+        ]
+        per = wire.max_multi_lanes(len(name.encode()))
+        packed = wire.pack_multi(states)
+        assert len(packed) == math.ceil(256 / per)
+        # Every packet must ENCODE within the 256-byte datagram bound and
+        # decode back to its exact lanes.
+        seen = {}
+        for st in packed:
+            data = wire.encode(st)
+            assert len(data) <= wire.PACKET_SIZE
+            dec = wire.decode(data)
+            assert dec.lanes is not None
+            for slot, la, lt in dec.lanes:
+                assert slot not in seen
+                seen[slot] = (la, lt)
+        assert len(seen) == 256
+        for i in range(256):
+            assert seen[i] == ((i + 1) * wire.NANO, i * wire.NANO)
+
+    def test_reply_gate_bounds_storm(self):
+        from patrol_tpu.net.replication import ReplyGate
+
+        gate = ReplyGate(ttl_s=0.2)
+        addr = ("127.0.0.1", 9999)
+        # A tight request loop: exactly one burst allowed per TTL window.
+        allowed = sum(gate.allow("flagship", addr) for _ in range(500))
+        assert allowed == 1
+        assert gate.suppressed == 499
+        # Distinct requesters are independently served (unicast replies).
+        assert gate.allow("flagship", ("127.0.0.1", 1111))
+        # Distinct buckets are independent too.
+        assert gate.allow("other", addr)
+
+    def test_cold_start_storm_reply_traffic_bounded(self):
+        """End-to-end over a live 2-node cluster: hammer node 0 with
+        repeated incast requests for one bucket from ONE probe socket and
+        assert the reply traffic stays at one burst (≤ the pack bound),
+        not requests × burst."""
+        import math
+        import socket as sk
+        import time as tm
+
+        from patrol_tpu.ops import wire
+
+        from test_cluster import Cluster  # self-import safe under pytest
+
+        cluster = Cluster(2)
+        try:
+            cl = KeepAliveClient(cluster.api_ports[0])
+            try:
+                for _ in range(3):
+                    cl.take("stormy", "8:1h")
+            finally:
+                cl.close()
+            probe = sk.socket(sk.AF_INET, sk.SOCK_DGRAM)
+            probe.bind(("127.0.0.1", 0))
+            probe.settimeout(0.3)
+            node_port = int(cluster.commands[0].node_addr.rsplit(":", 1)[1])
+            req = wire.encode(
+                wire.WireState("stormy", 0.0, 0.0, 0, origin_slot=3, multi_ok=True)
+            )
+            for _ in range(40):  # storm: 40 requests within one TTL
+                probe.sendto(req, ("127.0.0.1", node_port))
+            pkts = []
+            deadline = tm.time() + 1.0
+            while tm.time() < deadline:
+                try:
+                    pkts.append(probe.recv(512))
+                except sk.timeout:
+                    break
+            lanes = sum(
+                len(wire.decode(p).lanes or (None,)) for p in pkts
+            )
+            per = wire.max_multi_lanes(len(b"stormy"))
+            assert 1 <= len(pkts) <= math.ceil(4 / per) + 1, (
+                f"storm amplification: {len(pkts)} reply packets"
+            )
+            assert lanes >= 1
+            stats = cluster.commands[0].replicator.stats()
+            assert stats["replication_incast_suppressed"] >= 35
+        finally:
+            cluster.close()
