@@ -6,8 +6,11 @@ This is the *exact-semantics host model* of the reference's core
 1. It is the differential-testing oracle for the batched device kernels in
    :mod:`patrol_tpu.ops.take` / :mod:`patrol_tpu.ops.merge` — every kernel
    behavior is cross-checked against this model.
-2. It is the low-latency host fast path for cold / low-QPS buckets, where a
-   device round-trip would cost more than it saves.
+2. Its arithmetic is the semantic model for the LIVE host fast path
+   (``runtime/engine.py HostLanes`` — per-lane state, same take math):
+   cold/low-QPS buckets are served in-process, µs-class, and promoted to
+   the device path when hot (VERDICT r3 item 1; see tests/test_fastpath.py
+   for the host/device equivalence laws).
 3. It preserves the reference's ``Repo`` seam (repo.go:13-18) so the API and
    replication layers are backend-agnostic.
 
