@@ -24,6 +24,7 @@ Differences by design:
 from __future__ import annotations
 
 import asyncio
+import itertools
 import socket
 import threading
 import time
@@ -93,9 +94,24 @@ class ReplyGate:
         if self._seen.get(key, 0.0) > now:
             self.suppressed += 1
             return False
+        # pop-then-insert so dict position tracks GRANT time: a re-granted
+        # expired key moves to the back, otherwise the hard-evict below
+        # could drop a just-granted key as "oldest" and let its requester
+        # escape the TTL gate mid-storm.
+        self._seen.pop(key, None)
         self._seen[key] = now + self.ttl_s
         if len(self._seen) > self.cap:
             self._seen = {k: v for k, v in self._seen.items() if v > now}
+            if len(self._seen) > self.cap:
+                # A storm of >cap distinct keys inside one TTL: nothing has
+                # expired, so the sweep alone would rebuild the whole dict
+                # on EVERY allow (quadratic in exactly the storm this gate
+                # bounds). Hard-evict the oldest half (insertion order ≈
+                # grant order) so the dict stays capped and the next sweep
+                # is ≥cap/2 inserts away — O(1) amortized.
+                drop = len(self._seen) - self.cap // 2
+                for k in list(itertools.islice(self._seen, drop)):
+                    del self._seen[k]
         return True
 
 

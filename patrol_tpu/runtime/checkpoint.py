@@ -90,7 +90,8 @@ def restore(directory: str, engine) -> int:
 
     # Any live host-resident rows move device-side before the join: a
     # restored name could collide with a hosted row, and the max-join
-    # below only sees device planes.
+    # below only sees device planes. flush_hosted raises on timeout —
+    # proceeding would silently restore into still-hosted rows.
     engine.flush_hosted()
     engine.flush()
 

@@ -27,6 +27,7 @@ to preserve the unique-rows kernel invariant (sequential semantics).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import threading
@@ -501,6 +502,16 @@ class DeviceEngine:
         self._hosted: Dict[int, HostLanes] = {}
         self._hosted_flag = np.zeros(config.buckets, dtype=bool)
         self._promote_pending: set = set()
+        # Lanes popped from _hosted by a promotion drain but whose device
+        # join hasn't landed yet. snapshot_planes joins this dict too, so
+        # a checkpoint save in the pop→merge window still sees the lanes
+        # (they'd otherwise be in NEITHER _hosted nor the device planes —
+        # a restored checkpoint would drop the spend and over-admit).
+        # Entries are cleared under _host_mu only AFTER the _state_mu
+        # merge lands; the join is a max (idempotent), so a snapshot that
+        # reads both the merged planes and a not-yet-cleared entry is
+        # still exact.
+        self._promoting: Dict[int, HostLanes] = {}
         self._host_mu = threading.Lock()
         self._host_takes = 0  # takes served by the fast path
         self._promotions = 0  # host→device residency transitions
@@ -767,7 +778,23 @@ class DeviceEngine:
         tick start (before _apply, so same-tick device work sees the
         joined planes — the ordering the promotion design relies on) and
         :meth:`flush_hosted` only on a STOPPED engine; a live off-feeder
-        drain could flip flags and lose the join/apply ordering race."""
+        drain could flip flags and lose the join/apply ordering race.
+
+        The whole pop→merge window runs under ``_evict_mu``: an eviction
+        (or release) landing between the pop and the merge would zero and
+        recycle the device row, and the already-packed merge would then
+        resurrect the dead bucket's lanes into whatever bucket is bound
+        to the recycled row next. ``_evict_mu`` is taken strictly outside
+        ``_host_mu``/``_state_mu`` everywhere (same order as _evict and
+        release_bucket), so this adds no ordering cycle."""
+        with self._host_mu:
+            if not self._promote_pending:
+                return
+        with self._evict_mu:
+            self._drain_promotions_locked()
+
+    def _drain_promotions_locked(self) -> None:
+        """Body of :meth:`_drain_promotions`; caller holds ``_evict_mu``."""
         with self._host_mu:
             if not self._promote_pending:
                 return
@@ -778,6 +805,9 @@ class DeviceEngine:
                 if lanes is not None:
                     self._promotions += 1
                     popped.append((row, lanes))
+                    # Keep the lanes snapshot-visible until the device
+                    # join lands (see _promoting's init comment).
+                    self._promoting[row] = lanes
             self._promote_pending.clear()
         if not popped:
             return
@@ -813,6 +843,12 @@ class DeviceEngine:
                     self.state, jnp.asarray(packed)
                 )
             self._ticks += 1
+        # All chunk joins have landed: the staged lanes are now fully
+        # represented in the device planes, so drop the snapshot aliases.
+        # (pop, not clear — an eviction may have already dropped some.)
+        with self._host_mu:
+            for row, _lanes in popped:
+                self._promoting.pop(row, None)
 
     def _host_absorb_ingest(
         self,
@@ -883,11 +919,18 @@ class DeviceEngine:
                 # A stale pending entry would promote (and de-host) the
                 # NEXT bucket bound to this recycled row after one take.
                 self._promote_pending.discard(int(row))
+                # A staged mid-promotion entry would resurrect the dead
+                # bucket's lanes into a snapshot of the recycled row.
+                self._promoting.pop(int(row), None)
 
     def flush_hosted(self, timeout: float = 10.0) -> int:
         """Promote every host-resident bucket to the device path (exact
         batched join). Used by checkpoint RESTORE, whose dense max-join
-        only sees device planes. Returns rows promoted.
+        only sees device planes. Returns rows promoted; raises
+        ``TimeoutError`` if the feeder's join hasn't landed within
+        ``timeout`` — a silent partial flush would let the caller proceed
+        against planes that never received the host-lane join (restore
+        would then max-join into still-hosted rows and drop spend).
 
         The drain itself runs on the FEEDER (we only mark + wait): a
         drain on this thread would flip residency flags, release the
@@ -910,31 +953,47 @@ class DeviceEngine:
         with self._cond:
             self._cond.notify()
         deadline = time.monotonic() + timeout
+        ours = set(rows)
         while time.monotonic() < deadline:
             with self._host_mu:
-                drained = not self._promote_pending
-            if drained:
-                with self._cond:
-                    if not self._busy:  # join landed (drain runs in-tick)
-                        return len(rows)
+                # A row leaves _promote_pending at the drain's pop and
+                # leaves _promoting only after the device join lands —
+                # absence from both is the exact "flush visible in device
+                # planes" signal. Scoped to OUR rows: on a live engine,
+                # ongoing traffic keeps feeding new promotions, and a
+                # global-emptiness wait could spin past the deadline (and
+                # spuriously raise) with our join long landed.
+                if not (ours & self._promote_pending) and not (
+                    ours & self._promoting.keys()
+                ):
+                    return len(rows)
             time.sleep(0.0005)
-        return len(rows)
+        raise TimeoutError(
+            f"flush_hosted: promotion join for {len(rows)} rows did not "
+            f"land within {timeout}s"
+        )
 
     def snapshot_planes(self) -> Tuple[np.ndarray, np.ndarray]:
         """Host copies of the device planes with every host-resident
         bucket's lanes max-joined in — the checkpoint-save view. Atomic
-        against promotions: copy and join run under ``_host_mu`` (lock
-        order host→state, same as the promotion drain), so a concurrent
-        _drain_promotions either hasn't popped a bucket yet (we join its
-        live lanes) or has already merged it into the device planes we
-        copy. Residency is untouched — a save must not demote every cold
-        bucket it snapshots. Host serving stalls for the copy; checkpoint
-        cadence is operator-controlled and rare."""
+        against promotions: copy and join run under ``_host_mu``, and a
+        bucket mid-promotion lives in exactly one of three places we all
+        read — ``_hosted`` (not popped yet), ``_promoting`` (popped, device
+        join in flight), or the device planes (join landed; the staged
+        entry may linger until the drain's clear, which is harmless — the
+        join is a max, so joining it twice is exact). The drain never
+        holds both locks across its pop→merge window; ``_promoting`` is
+        what makes this read atomic anyway. Residency is untouched — a
+        save must not demote every cold bucket it snapshots. Host serving
+        stalls for the copy; checkpoint cadence is operator-controlled and
+        rare."""
         with self._host_mu:
             with self._state_mu:
                 pn = np.array(self.state.pn)
                 elapsed = np.array(self.state.elapsed)
-            for row, lanes in self._hosted.items():
+            for row, lanes in itertools.chain(
+                self._hosted.items(), self._promoting.items()
+            ):
                 np.maximum(pn[row, :, 0], lanes.added, out=pn[row, :, 0])
                 np.maximum(pn[row, :, 1], lanes.taken, out=pn[row, :, 1])
                 if elapsed[row] < lanes.elapsed_ns:

@@ -447,6 +447,22 @@ class TestFlagshipIncastDiscipline:
         # Distinct buckets are independent too.
         assert gate.allow("other", addr)
 
+    def test_reply_gate_hard_caps_distinct_key_storm(self):
+        """r4 advisor low: >cap DISTINCT (bucket, requester) keys inside
+        one TTL — nothing expires, so the expiry sweep alone would rebuild
+        the whole dict on every subsequent allow (quadratic in the storm).
+        The gate must stay hard-capped and keep admitting new keys."""
+        from patrol_tpu.net.replication import ReplyGate
+
+        gate = ReplyGate(ttl_s=60.0, cap=256)
+        for i in range(4 * 256):
+            assert gate.allow(f"b{i}", ("10.0.0.1", 5000))
+            assert len(gate._seen) <= 256 + 1
+        # Evicted-oldest keys may be re-allowed early (bounded memory wins
+        # over strict one-per-TTL under adversarial cardinality); recent
+        # keys are still gated.
+        assert not gate.allow(f"b{4 * 256 - 1}", ("10.0.0.1", 5000))
+
     def test_cold_start_storm_reply_traffic_bounded(self):
         """End-to-end over a live 2-node cluster: hammer node 0 with
         repeated incast requests for one bucket from ONE probe socket and

@@ -279,6 +279,38 @@ class TestReviewRegressions:
         assert engine.hosted_buckets == 1
         assert engine.promotions == 0
 
+    def test_snapshot_sees_lanes_mid_promotion(self, engine):
+        """r4 advisor medium: a checkpoint save in the drain's pop→merge
+        window used to find a promoted bucket's lanes in NEITHER _hosted
+        nor the device planes (snapshot read 0 taken where host lanes held
+        the spend). The drain now stages popped lanes in _promoting until
+        the device join lands; snapshot_planes joins that dict too."""
+        engine.take("mid", RATE, 5)
+        row = engine.directory.lookup("mid")
+        # Reproduce the exact intermediate state the drain creates between
+        # releasing _host_mu (lanes popped, flag cleared) and the
+        # _state_mu merge landing.
+        with engine._host_mu:
+            lanes = engine._hosted.pop(row)
+            engine._hosted_flag[row] = False
+            engine._promoting[row] = lanes
+        pn, elapsed = engine.snapshot_planes()
+        assert int(pn[row, :, 1].sum()) == 5 * NANO  # spend still visible
+        # Restore the real state so teardown paths stay consistent.
+        with engine._host_mu:
+            engine._hosted[row] = engine._promoting.pop(row)
+            engine._hosted_flag[row] = True
+
+    def test_flush_hosted_timeout_raises(self, engine):
+        """r4 advisor low: flush_hosted returning len(rows) on the timeout
+        path was indistinguishable from success — checkpoint.restore would
+        max-join against planes that never received the host-lane join."""
+        engine.take("stuck", RATE, 1)
+        assert engine.hosted_buckets == 1
+        engine._drain_promotions = lambda: None  # feeder can't drain
+        with pytest.raises(TimeoutError):
+            engine.flush_hosted(timeout=0.05)
+
     def test_promotion_deltas_hold_pins(self, engine):
         """r4 review: promotion deltas queue outside the assign path, but
         the tick unconditionally unpins drained delta rows — they must
