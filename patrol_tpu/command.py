@@ -95,7 +95,16 @@ class Command:
                 clock=self.clock,
             )
         else:
-            engine = DeviceEngine(self.config, node_slot=slots.self_slot, clock=self.clock)
+            engine = DeviceEngine(
+                self.config,
+                node_slot=slots.self_slot,
+                clock=self.clock,
+                # Native front ⇒ host-resident lanes live in the C++ store
+                # and /take is served on the epoll thread (api.go:51-86's
+                # in-process shape); python front keeps the pure-Python
+                # host tier.
+                native_host=(self.http_front == "native"),
+            )
 
         from patrol_tpu.net import native_replication
 

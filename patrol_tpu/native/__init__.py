@@ -144,6 +144,43 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pt_rx_classify.restype = ctypes.c_int64
         lib.pt_dir_destroy.argtypes = [ctypes.c_int]
         lib.pt_dir_destroy.restype = ctypes.c_int
+        # -- host-lane store (in-front /take serving) --
+        lib.pt_hls_create.argtypes = [
+            ctypes.c_int, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, _i64p, _i64p, _i64p,
+        ]
+        lib.pt_hls_create.restype = ctypes.c_int
+        lib.pt_hls_destroy.argtypes = [ctypes.c_int]
+        lib.pt_hls_destroy.restype = ctypes.c_int
+        lib.pt_hls_lock.argtypes = [ctypes.c_int]
+        lib.pt_hls_lock.restype = ctypes.c_int
+        lib.pt_hls_unlock.argtypes = [ctypes.c_int]
+        lib.pt_hls_unlock.restype = ctypes.c_int
+        lib.pt_hls_host_locked.argtypes = [ctypes.c_int, ctypes.c_int32]
+        lib.pt_hls_host_locked.restype = ctypes.c_int64
+        lib.pt_hls_unhost_locked.argtypes = [ctypes.c_int, ctypes.c_int32]
+        lib.pt_hls_unhost_locked.restype = ctypes.c_int
+        lib.pt_hls_drain_locked.argtypes = [
+            ctypes.c_int, _i32p, ctypes.c_int, _i32p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.pt_hls_drain_locked.restype = ctypes.c_int
+        lib.pt_hls_stats.argtypes = [ctypes.c_int, _u64p]
+        lib.pt_hls_stats.restype = ctypes.c_int
+        lib.pt_http_attach_host.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.pt_http_attach_host.restype = ctypes.c_int
+        lib.pt_hls_take_probe.argtypes = [
+            ctypes.c_int, ctypes.c_int, _u8p, ctypes.c_int, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.pt_hls_take_probe.restype = ctypes.c_int
+        lib.pt_dir_resolve_rt.argtypes = [
+            ctypes.c_int, _u8p, ctypes.c_int32, _i64p, ctypes.c_int64,
+        ]
+        lib.pt_dir_resolve_rt.restype = ctypes.c_int32
         lib.pt_http_blast.argtypes = [
             ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, _u64p,

@@ -32,6 +32,7 @@
 
 #include <algorithm>
 #include <mutex>
+#include <shared_mutex>
 #include <vector>
 
 namespace {
@@ -416,6 +417,13 @@ struct PtDir {
   const int32_t* name_lens = nullptr;   // [capacity], Python-owned
   int64_t tombs = 0;
   int maxprobe = 1;
+  // Table writers (insert/delete/rebuild, all Python-lock-serialized
+  // already) vs the HTTP front's epoll-thread resolve (pt_dir_resolve_rt,
+  // NOT under the Python lock): writers take unique, the runtime resolve
+  // takes shared. The Python-side batch resolvers stay lock-free readers
+  // — the Python directory lock already serializes them against every
+  // writer; only the epoll thread needs this.
+  std::shared_mutex tab_mu;
 };
 
 PtDir* g_dirs[16] = {nullptr};
@@ -490,6 +498,7 @@ int pt_dir_create(int64_t capacity, const uint8_t* name_bytes,
 int pt_dir_insert(int h, uint64_t hash, int32_t row) {
   PtDir* d = g_dirs[h];
   if (!d) return -EBADF;
+  std::unique_lock<std::shared_mutex> wl(d->tab_mu);
   ptdir_insert(d, hash, row);
   return 0;
 }
@@ -500,6 +509,7 @@ int pt_dir_insert_batch(int h, const uint64_t* hashes, const int32_t* rows,
                         int n) {
   PtDir* d = g_dirs[h];
   if (!d) return -EBADF;
+  std::unique_lock<std::shared_mutex> wl(d->tab_mu);
   for (int i = 0; i < n; i++) ptdir_insert(d, hashes[i], rows[i]);
   return 0;
 }
@@ -507,6 +517,7 @@ int pt_dir_insert_batch(int h, const uint64_t* hashes, const int32_t* rows,
 int pt_dir_delete(int h, uint64_t hash, int32_t row) {
   PtDir* d = g_dirs[h];
   if (!d) return -EBADF;
+  std::unique_lock<std::shared_mutex> wl(d->tab_mu);
   uint64_t pos = hash & d->mask;
   for (int p = 0; p < d->maxprobe; p++) {
     int32_t r = d->tab[pos].row;
@@ -556,6 +567,26 @@ inline int32_t ptdir_resolve_one(const PtDir* d, uint64_t hv,
 }
 
 }  // namespace
+
+// Single-name resolve for the HTTP front's epoll thread (the only caller
+// NOT serialized by the Python directory lock): computes the FNV hash,
+// probes under the table's shared lock, and stamps the LRU clock on a hit
+// (plain aligned int64 store — tear-free on x86-64; eviction reading a
+// stale stamp is the same benignity the Python batch resolve accepts).
+// No pin is taken: the inline host take completes before returning to the
+// event loop, so there is no in-flight window for eviction to violate —
+// a take racing the eviction itself answers from the dying bucket's last
+// state, the same bounded anomaly the Python fast path documents.
+int32_t pt_dir_resolve_rt(int h, const uint8_t* name_padded, int32_t len,
+                          int64_t* last_used, int64_t now) {
+  PtDir* d = g_dirs[h];
+  if (!d || len < 0) return -1;
+  uint64_t hv = fnv1a64(name_padded, len);
+  std::shared_lock<std::shared_mutex> rl(d->tab_mu);
+  int32_t row = ptdir_resolve_one(d, hv, name_padded, len);
+  if (row >= 0 && last_used) last_used[row] = now;
+  return row;
+}
 
 // Batch resolve: rows_out[i] = row or -1 (miss/malformed). On a hit, pins
 // and last_used (Python-owned numpy buffers) are updated in place.

@@ -39,13 +39,22 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <atomic>
 #include <cctype>
 #include <chrono>
+#include <cmath>
 #include <condition_variable>
 #include <deque>
 #include <mutex>
 #include <thread>
+#include <unordered_map>
 #include <vector>
+
+// From patrol_host.cpp (same shared library): epoll-thread-safe single
+// name resolve against the C++ directory probe table.
+extern "C" int32_t pt_dir_resolve_rt(int h, const uint8_t* name_padded,
+                                     int32_t len, int64_t* last_used,
+                                     int64_t now);
 
 namespace {
 
@@ -213,6 +222,131 @@ std::string pct_decode(const std::string& s, bool plus_to_space) {
   return out;
 }
 
+// ---- Host-lane store (the C++ twin of runtime/engine.py HostLanes) --------
+//
+// The reference serves the whole /take decision natively in-process
+// (api.go:51-86 → bucket.go:186-225). This store lets the epoll thread do
+// the same for host-resident buckets: per-row PN lane blocks in plain
+// int64 memory, shared with Python — the engine maps each block as numpy
+// views (runtime/hoststore.py), so every Python-side operation (rx
+// absorb, snapshot, checkpoint, promotion join) runs the EXISTING
+// HostLanes code on the same bytes. One native mutex replaces the
+// engine's _host_mu: Python takes it via pt_hls_lock/unlock (ctypes
+// releases the GIL), the epoll thread takes it inline per take.
+//
+// Block layout (int64 words): added[nodes] | taken[nodes] | elapsed_ns |
+// win_start_ns | win_takes | win_rx | resident | dirty.
+constexpr int64_t kNano = 1000000000LL;
+
+struct HostStore {
+  std::mutex mu;
+  int nodes = 0;
+  int words = 0;          // per-block int64 words = 2*nodes + 6
+  int64_t node_slot = 0;
+  int64_t promote_takes = 0;  // <=0: native take pressure never promotes
+  int64_t window_ns = 0;
+  int64_t clock_offset_ns = 0;  // realtime → injected-clock domain
+  const int64_t* cap_base = nullptr;  // Python directory arrays (stable
+  const int64_t* created = nullptr;   // fixed-size allocations)
+  int64_t* last_used = nullptr;       // LRU stamps (eviction input)
+  // row → block. Blocks are immortal until store destroy: a popped
+  // (promoted/evicted) row's Python views stay valid, and a re-host of
+  // the same row reuses its block (bounded by rows ever hosted).
+  std::unordered_map<int32_t, int64_t*> blocks;
+  std::vector<int32_t> dirty_rows;    // coalesced-broadcast queue
+  std::vector<int32_t> promote_rows;  // take-pressure threshold crossings
+  // Event sequence for the pump's poll predicate (read without mu).
+  std::atomic<uint64_t> events{0};
+  uint64_t native_takes = 0;  // takes served by the epoll thread
+};
+
+HostStore* g_hls[16] = {nullptr};
+std::mutex g_hls_mu;
+
+inline int64_t sat_mul_nano(int64_t v) {
+  if (v > kInt64Max / kNano) return kInt64Max;
+  if (v < -(kInt64Max / kNano)) return -kInt64Max;
+  return v * kNano;
+}
+
+// One take against a resident block. MUST mirror HostLanes.take
+// (runtime/engine.py) step-for-step — the same lazy capacity base,
+// monotonic-time guard, float64 refill grant, capacity cap (possibly
+// negative ⇒ monotone forfeit booked as taken), conditional commit, and
+// remaining_for_request(have, k, count_nt, 0) fan-out — so a bucket's
+// observable behavior is identical whichever side serves it and the
+// promotion join stays exact. Caller holds st->mu.
+void hls_take_locked(HostStore* st, int64_t* blk, int32_t row, int64_t freq,
+                     int64_t per_ns, int64_t count, int64_t now,
+                     int64_t* remaining, int* ok, bool* events_bumped) {
+  const int n = st->nodes;
+  int64_t* added = blk;
+  int64_t* taken = blk + n;
+  int64_t* sc = blk + 2 * n;  // scalars (layout above)
+  if (now - sc[1] > st->window_ns) {
+    sc[1] = now;
+    sc[2] = 0;
+    sc[3] = 0;
+  }
+  sc[2]++;
+  if (st->promote_takes > 0 && sc[2] == st->promote_takes + 1) {
+    st->promote_rows.push_back(row);
+    // Promotions wake the pump promptly (poll predicate); dirty marks
+    // below deliberately don't — broadcasts coalesce on the pump's short
+    // poll tick, so a take never pays a pump wakeup on its latency path.
+    st->events.fetch_add(1, std::memory_order_relaxed);
+    *events_bumped = true;
+  }
+  const int64_t cap = st->cap_base[row];
+  const int64_t cap_now = sat_mul_nano(freq);
+  int64_t sum_a = 0, sum_t = 0;
+  for (int i = 0; i < n; i++) {
+    sum_a += added[i];
+    sum_t += taken[i];
+  }
+  const int64_t tokens = cap + sum_a - sum_t;
+  int64_t last = st->created[row] + sc[0];
+  if (now < last) last = now;
+  const int64_t delta = now - last;  // >= 0 by the min above
+  const int64_t interval = freq ? per_ns / freq : 0;
+  int64_t grant = 0;
+  if (freq != 0 && per_ns != 0 && interval != 0) {
+    // float64(delta)/float64(interval) tokens then ·1e9, floored — the
+    // exact expression (and operation order) of the kernel and of
+    // HostLanes.take.
+    double gf = ((double)delta / (double)interval) * 1e9;
+    if (gf < 0.0) gf = 0.0;
+    const double hi = 4611686018427387904.0;  // float(2**62), exact
+    if (gf > hi) gf = hi;
+    grant = (int64_t)std::floor(gf);
+  }
+  if (grant > cap_now - tokens) grant = cap_now - tokens;
+  const int64_t have = tokens + grant;
+  const int64_t count_nt = sat_mul_nano(count);
+  const int k = (count_nt > 0 && have >= count_nt) ? 1 : 0;
+  if (k) {
+    const int64_t forfeit = grant < 0 ? -grant : 0;
+    added[st->node_slot] += grant > 0 ? grant : 0;
+    taken[st->node_slot] += count_nt + forfeit;
+    sc[0] += delta;
+  }
+  int64_t rem = have - (k ? count_nt : 0);
+  if (rem < 0) rem = 0;
+  *remaining = rem / kNano;
+  *ok = k;
+  st->native_takes++;
+  if (!sc[5]) {
+    sc[5] = 1;
+    st->dirty_rows.push_back(row);
+  }
+}
+
+int64_t realtime_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return (int64_t)ts.tv_sec * kNano + ts.tv_nsec;
+}
+
 struct TakeRec {
   uint64_t tag;
   int64_t freq, per_ns, count;
@@ -258,6 +392,13 @@ struct Server {
   std::vector<Conn> conns;     // slot-indexed
   std::vector<int> free_slots;
   uint16_t h2_backend_port = 0;  // 0 = h2c preface rejected with 400
+  // In-front host serving (pt_http_attach_host): resolve via this C++
+  // directory handle, serve host-resident rows from this store without
+  // ever crossing into Python. -1/null = every take rides the ring.
+  int dir_h = -1;
+  HostStore* hls = nullptr;
+  uint64_t hls_events_seen = 0;  // poll predicate cursor
+  uint64_t hls_takes = 0;        // served in-front (this server)
   std::deque<TakeRec> take_q;
   std::deque<OtherRec> other_q;
   // Completions flow: pump → (mu) wbuf append → eventfd kick.
@@ -553,6 +694,52 @@ bool try_parse_one(Server* s, int slot) {
     }
     if (count == 0) count = 1;  // api.go:63-65 (incl. bad/negative count)
 
+    // In-front fast path: a host-resident bucket's whole take decision —
+    // resolve, lane arithmetic, response — runs here on the epoll thread,
+    // the reference's in-process shape (api.go:51-86). Misses (unknown
+    // names, device-resident rows) fall through to the Python ring, which
+    // binds/hosts/promotes exactly as before.
+    if (s->hls != nullptr && s->dir_h >= 0) {
+      alignas(8) uint8_t padded[kNameMax] = {0};
+      memcpy(padded, name.data(), name.size());
+      const int64_t now =
+          realtime_ns() + s->hls->clock_offset_ns;
+      bool served = false, bumped = false;
+      int64_t remaining = 0;
+      int ok = 0;
+      {
+        // Resolve INSIDE the store's critical section: re-hosting a
+        // recycled row requires this same mutex (_host_mu IS this lock),
+        // so a resolve→take pair under it can never be interleaved by
+        // evict→rebind→rehost and charge the wrong bucket. The nested
+        // tab_mu(shared) inside hls->mu is cycle-free — no thread takes
+        // hls->mu while holding the directory's table lock.
+        std::lock_guard<std::mutex> hlk(s->hls->mu);
+        int32_t row = pt_dir_resolve_rt(s->dir_h, padded,
+                                        (int32_t)name.size(),
+                                        s->hls->last_used, now);
+        if (row >= 0) {
+          auto it = s->hls->blocks.find(row);
+          if (it != s->hls->blocks.end() &&
+              it->second[2 * s->hls->nodes + 4] != 0) {  // resident
+            hls_take_locked(s->hls, it->second, row, freq, per_ns, count,
+                            now, &remaining, &ok, &bumped);
+            served = true;
+          }
+        }
+      }
+      if (served) {
+        s->hls_takes++;
+        char body[24];
+        int bl = snprintf(body, sizeof(body), "%lld", (long long)remaining);
+        queue_response(s, &c, ok ? 200 : 429, "text/plain", body, bl);
+        // Promotions wake the pump promptly (poll predicate); broadcast
+        // dirty marks ride the pump's short poll tick instead.
+        if (bumped) s->cv.notify_one();
+        return true;
+      }
+    }
+
     if ((int)s->take_q.size() >= kRingCap) {
       s->dropped++;
       queue_response(s, &c, 503, "text/plain", "overloaded\n", 11);
@@ -844,9 +1031,14 @@ int pt_http_poll(int h, int timeout_ms,
   std::unique_lock<std::mutex> lk(s->mu);
   if (s->take_q.empty() && s->other_q.empty() && timeout_ms > 0) {
     s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
-      return !s->take_q.empty() || !s->other_q.empty() || !s->running;
+      return !s->take_q.empty() || !s->other_q.empty() || !s->running ||
+             (s->hls != nullptr &&
+              s->hls->events.load(std::memory_order_relaxed) !=
+                  s->hls_events_seen);
     });
   }
+  if (s->hls != nullptr)
+    s->hls_events_seen = s->hls->events.load(std::memory_order_relaxed);
   int nt = 0;
   while (nt < cap_t && !s->take_q.empty()) {
     TakeRec& r = s->take_q.front();
@@ -1110,6 +1302,179 @@ int pt_http_blast(const char* ip, uint16_t port, const char* target,
   out5[3] = ok200;
   out5[4] = lim429;
   return 0;
+}
+
+// ---- Host-lane store ABI --------------------------------------------------
+
+// Create a store. cap_base/created/last_used are the Python directory's
+// fixed-size int64 arrays (stable allocations; the C++ side reads the
+// first two and stamps the third). promote_takes <= 0 disables native
+// take-pressure promotion: an in-front take costs ~0.2 µs, so unlike the
+// Python host path there is no QPS past which the device tick serves ONE
+// row's takes faster — promotion stays rx-pressure/scalar-driven.
+int pt_hls_create(int nodes, int64_t node_slot, int64_t promote_takes,
+                  int64_t window_ns, int64_t clock_offset_ns,
+                  const int64_t* cap_base, const int64_t* created,
+                  int64_t* last_used) {
+  std::lock_guard<std::mutex> reg(g_hls_mu);
+  int h = -1;
+  for (int i = 0; i < 16; i++)
+    if (!g_hls[i]) {
+      h = i;
+      break;
+    }
+  if (h < 0) return -EMFILE;
+  HostStore* st = new HostStore();
+  st->nodes = nodes;
+  st->words = 2 * nodes + 6;
+  st->node_slot = node_slot;
+  st->promote_takes = promote_takes;
+  st->window_ns = window_ns;
+  st->clock_offset_ns = clock_offset_ns;
+  st->cap_base = cap_base;
+  st->created = created;
+  st->last_used = last_used;
+  g_hls[h] = st;
+  return h;
+}
+
+// Destroy: caller (engine.stop) must guarantee the HTTP front is detached
+// and no Python proxy views the blocks afterwards.
+int pt_hls_destroy(int h) {
+  HostStore* st;
+  {
+    std::lock_guard<std::mutex> reg(g_hls_mu);
+    st = g_hls[h];
+    if (!st) return -EBADF;
+    g_hls[h] = nullptr;
+  }
+  for (auto& kv : st->blocks) delete[] kv.second;
+  delete st;
+  return 0;
+}
+
+// Python's _host_mu: ctypes releases the GIL for the blocking acquire, so
+// the epoll thread (which never takes the GIL) cannot deadlock it.
+int pt_hls_lock(int h) {
+  HostStore* st = g_hls[h];
+  if (!st) return -EBADF;
+  st->mu.lock();
+  return 0;
+}
+
+int pt_hls_unlock(int h) {
+  HostStore* st = g_hls[h];
+  if (!st) return -EBADF;
+  st->mu.unlock();
+  return 0;
+}
+
+// Get-or-create the row's block, zeroed, resident. Returns the block
+// address for numpy views (0 on failure). Caller holds the store lock.
+int64_t pt_hls_host_locked(int h, int32_t row) {
+  HostStore* st = g_hls[h];
+  if (!st) return 0;
+  int64_t*& blk = st->blocks[row];
+  if (blk == nullptr) blk = new int64_t[st->words];
+  std::memset(blk, 0, sizeof(int64_t) * st->words);
+  blk[2 * st->nodes + 4] = 1;  // resident
+  return (int64_t)(intptr_t)blk;
+}
+
+// Stop serving the row in-front (promotion pop / eviction / release).
+// The block and its Python views stay valid. Caller holds the store lock.
+int pt_hls_unhost_locked(int h, int32_t row) {
+  HostStore* st = g_hls[h];
+  if (!st) return -EBADF;
+  auto it = st->blocks.find(row);
+  if (it != st->blocks.end()) it->second[2 * st->nodes + 4] = 0;
+  return 0;
+}
+
+// Drain pending events: dirty rows (coalesced-broadcast queue; flags
+// cleared) and promote rows. Caller holds the store lock and owns turning
+// the rows into wire states / promotion marks.
+int pt_hls_drain_locked(int h, int32_t* dirty_out, int cap_d,
+                        int32_t* promote_out, int cap_p, int* n_promote) {
+  HostStore* st = g_hls[h];
+  if (!st) return -EBADF;
+  // Pop at most cap rows; the remainder KEEPS its queue entries and dirty
+  // flags, so overflow rows are re-delivered on the caller's next drain
+  // (a silent truncation here would permanently lose a bucket's final
+  // broadcast — the caller loops until both queues come back empty).
+  int nd = 0;
+  for (; nd < cap_d && nd < (int)st->dirty_rows.size(); nd++) {
+    int32_t row = st->dirty_rows[nd];
+    auto it = st->blocks.find(row);
+    if (it != st->blocks.end()) it->second[2 * st->nodes + 5] = 0;
+    dirty_out[nd] = row;
+  }
+  st->dirty_rows.erase(st->dirty_rows.begin(), st->dirty_rows.begin() + nd);
+  int np = 0;
+  for (; np < cap_p && np < (int)st->promote_rows.size(); np++)
+    promote_out[np] = st->promote_rows[np];
+  st->promote_rows.erase(st->promote_rows.begin(),
+                         st->promote_rows.begin() + np);
+  *n_promote = np;
+  return nd;
+}
+
+// out4 = {native_takes, resident_rows, blocks_allocated, pending_events}.
+int pt_hls_stats(int h, uint64_t* out4) {
+  HostStore* st = g_hls[h];
+  if (!st) return -EBADF;
+  std::lock_guard<std::mutex> lk(st->mu);
+  out4[0] = st->native_takes;
+  uint64_t res = 0;
+  for (auto& kv : st->blocks)
+    if (kv.second[2 * st->nodes + 4]) res++;
+  out4[1] = res;
+  out4[2] = st->blocks.size();
+  out4[3] = st->dirty_rows.size() + st->promote_rows.size();
+  return 0;
+}
+
+// Wire the HTTP front to a store + C++ directory; -1/-1 detaches.
+int pt_http_attach_host(int http_h, int hls_h, int dir_h) {
+  std::lock_guard<std::mutex> reg(g_reg_mu);
+  Server* s = g_servers[http_h];
+  if (!s) return -EBADF;
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (hls_h < 0) {
+    s->hls = nullptr;
+    s->dir_h = -1;
+    return 0;
+  }
+  HostStore* st = g_hls[hls_h];
+  if (!st) return -EBADF;
+  s->hls = st;
+  s->dir_h = dir_h;
+  return 0;
+}
+
+// Test hook: run the EXACT in-front take path (resolve + residency +
+// hls_take_locked) with a caller-controlled clock. Returns 1 (admitted),
+// 0 (limited), -1 (not servable in front: miss or device-resident).
+int pt_hls_take_probe(int hls_h, int dir_h, const uint8_t* name, int len,
+                      int64_t freq, int64_t per_ns, int64_t count,
+                      int64_t now, int64_t* remaining) {
+  HostStore* st = g_hls[hls_h];
+  if (!st) return -EBADF;
+  alignas(8) uint8_t padded[kNameMax] = {0};
+  if (len < 0 || len > kNameMax) return -EINVAL;
+  std::memcpy(padded, name, (size_t)len);
+  // Same shape as the front's inline path: resolve inside the store's
+  // critical section (see try_parse_one).
+  std::lock_guard<std::mutex> lk(st->mu);
+  int32_t row = pt_dir_resolve_rt(dir_h, padded, len, st->last_used, now);
+  if (row < 0) return -1;
+  auto it = st->blocks.find(row);
+  if (it == st->blocks.end() || it->second[2 * st->nodes + 4] == 0) return -1;
+  bool bumped = false;
+  int ok = 0;
+  hls_take_locked(st, it->second, row, freq, per_ns, count, now, remaining,
+                  &ok, &bumped);
+  return ok;
 }
 
 // Exposed for differential tests against ops/rate.py.

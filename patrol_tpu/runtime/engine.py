@@ -474,6 +474,7 @@ class DeviceEngine:
         clock: ClockFn = system_clock,
         on_broadcast: Optional[BroadcastFn] = None,
         device=None,
+        native_host: bool = False,
     ):
         self.config = config
         self.node_slot = node_slot
@@ -513,6 +514,31 @@ class DeviceEngine:
         # still exact.
         self._promoting: Dict[int, HostLanes] = {}
         self._host_mu = threading.Lock()
+        # Native host-lane store (VERDICT r4 item 1): when requested and
+        # the native library is available, host-resident lanes live in C++
+        # blocks the HTTP front serves takes from WITHOUT crossing into
+        # Python; the engine sees the same bytes through numpy-view
+        # proxies, and _host_mu becomes the store's native mutex so both
+        # sides serialize on one lock. Python code paths are unchanged —
+        # they just operate on shared memory.
+        self._native_store = None
+        if native_host and HOST_FASTPATH:
+            from patrol_tpu.runtime import hoststore
+
+            # Map the injected clock onto CLOCK_REALTIME for the epoll
+            # thread's takes: offset = clock() - realtime at init. Exact
+            # for the CLI's offset clocks (main.go:35-37 semantics); a
+            # test FakeClock driving the C++ path uses the probe's
+            # explicit now instead.
+            self._native_store = hoststore.NativeHostStore.create(
+                nodes=config.nodes,
+                node_slot=node_slot,
+                directory=self.directory,
+                clock_offset_ns=int(self.clock()) - time.time_ns(),
+                window_ns=HOST_PROMOTE_WINDOW_NS,
+            )
+            if self._native_store is not None:
+                self._host_mu = self._native_store.mutex()
         self._host_takes = 0  # takes served by the fast path
         self._promotions = 0  # host→device residency transitions
         self._stopped = False
@@ -705,7 +731,12 @@ class DeviceEngine:
             if lanes is None:
                 if not fresh:
                     return False  # promoted by a concurrent rx/take
-                lanes = HostLanes(self.config.nodes)
+                if self._native_store is not None:
+                    # C++-backed block (we hold _host_mu == store mutex):
+                    # from here the epoll thread serves this row in-front.
+                    lanes = self._native_store.host_locked(row)
+                else:
+                    lanes = HostLanes(self.config.nodes)
                 self._hosted[row] = lanes
                 self._hosted_flag[row] = True
             lanes.roll_window(now)
@@ -802,6 +833,11 @@ class DeviceEngine:
             for row in self._promote_pending:
                 lanes = self._hosted.pop(row, None)
                 self._hosted_flag[row] = False
+                if self._native_store is not None:
+                    # Stop in-front serving NOW, inside the same critical
+                    # section that flips the Python flag (the block's data
+                    # stays valid for the join below).
+                    self._native_store.unhost_locked(row)
                 if lanes is not None:
                     self._promotions += 1
                     popped.append((row, lanes))
@@ -916,6 +952,8 @@ class DeviceEngine:
                 if self._hosted_flag[row]:
                     self._hosted.pop(int(row), None)
                     self._hosted_flag[row] = False
+                    if self._native_store is not None:
+                        self._native_store.unhost_locked(int(row))
                 # A stale pending entry would promote (and de-host) the
                 # NEXT bucket bound to this recycled row after one take.
                 self._promote_pending.discard(int(row))
@@ -999,6 +1037,60 @@ class DeviceEngine:
                 if elapsed[row] < lanes.elapsed_ns:
                     elapsed[row] = lanes.elapsed_ns
         return pn, elapsed
+
+    def drain_native_broadcasts(self) -> None:
+        """Turn the C++ front's coalesced take effects into replication:
+        emit each dirty row's LATEST full state once (CvRDT: a later state
+        subsumes all earlier ones — lossless coalescing of the reference's
+        per-take broadcast, repo.go:123-127) and mark take-pressure
+        promotions. Called by the native front's pump each cycle; the C++
+        side wakes the pump promptly via the poll predicate."""
+        st = self._native_store
+        if st is None:
+            return
+        while True:
+            # Snapshot per-row INTEGERS under the lock; build wire states
+            # outside it — _host_mu is the very mutex the epoll thread's
+            # in-front takes block on, so Python-level wire construction
+            # under it would stall the whole HTTP front (the Python fast
+            # path broadcasts after releasing _host_mu for the same
+            # reason). Loop until both queues drain: the C++ side pops at
+            # most a buffer's worth per call and re-queues the rest.
+            snap: List[Tuple[str, int, int, int, int, int, int]] = []
+            with self._host_mu:
+                dirty, promotes = st.drain_locked()
+                for row in promotes:
+                    if row in self._hosted:
+                        self._promote_locked(row)
+                for row in dirty:
+                    lanes = self._hosted.get(row)
+                    if lanes is None:
+                        continue  # promoted/evicted since marked: its
+                        # state rides the device completion broadcasts
+                    cap = int(self.directory.cap_base_nt[row])
+                    own_a = int(lanes.added[self.node_slot])
+                    own_t = int(lanes.taken[self.node_slot])
+                    elapsed = lanes.elapsed_ns
+                    if not (own_a or own_t or elapsed or cap):
+                        continue  # zero state is the incast marker
+                    name = self.directory.name_of(row)
+                    if name is None:
+                        continue
+                    snap.append((
+                        name, cap, own_a, own_t, elapsed,
+                        int(lanes.added.sum()), int(lanes.taken.sum()),
+                    ))
+            if snap:
+                self._emit_broadcasts([
+                    wire.from_nanotokens(
+                        name, cap + sum_a, sum_t, elapsed,
+                        origin_slot=self.node_slot, cap_nt=cap,
+                        lane_added_nt=own_a, lane_taken_nt=own_t,
+                    )
+                    for name, cap, own_a, own_t, elapsed, sum_a, sum_t in snap
+                ])
+            if not dirty and not promotes:
+                return
 
     def take(
         self, name: str, rate: Rate, count: int, now_ns: Optional[int] = None
@@ -1777,6 +1869,23 @@ class DeviceEngine:
         # drain is still producing ticks (stranded tickets, leaked pins).
         self._thread.join(timeout=5)
         self._completer.join(timeout=5)
+        if self._native_store is not None:
+            # The HTTP front must already be detached (command.py closes
+            # the front before engine.stop). Frees every lane block — so
+            # drop every proxy first; host-lane views are invalid from
+            # here and post-stop introspection sees device planes only.
+            with self._host_mu:
+                self._hosted.clear()
+                self._promoting.clear()
+                self._hosted_flag[:] = False
+            store, self._native_store = self._native_store, None
+            if getattr(self, "_leak_native_store", False):
+                # A wedged front pump may still be inside the store
+                # (native_http.close's leaked-server path): leak the
+                # blocks rather than free them under a live thread.
+                log.error("leaking native host store (wedged http pump)")
+            else:
+                store.destroy()
         self.directory.close()  # releases the native resolve table
 
     # -- completion pipeline ------------------------------------------------
@@ -1843,8 +1952,12 @@ class DeviceEngine:
 
     @property
     def host_takes(self) -> int:
-        """Takes answered in-process by the host fast path (µs-class)."""
-        return self._host_takes
+        """Takes answered in-process by the host fast path (µs-class):
+        Python-served plus C++-in-front-served."""
+        n = self._host_takes
+        if self._native_store is not None:
+            n += self._native_store.native_takes
+        return n
 
     @property
     def promotions(self) -> int:

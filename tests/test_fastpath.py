@@ -191,10 +191,10 @@ class TestHostDeviceDifferential:
                     )
                 )
 
-        def run(fastpath: bool):
+        def run(fastpath: bool, native: bool = False):
             monkeypatch.setattr(engine_mod, "HOST_FASTPATH", fastpath)
             clock = FakeClock()
-            eng = DeviceEngine(CFG, node_slot=0, clock=clock)
+            eng = DeviceEngine(CFG, node_slot=0, clock=clock, native_host=native)
             results = []
             try:
                 for op in ops:
@@ -230,6 +230,14 @@ class TestHostDeviceDifferential:
         res_dev, state_dev = run(False)
         assert res_fast == res_dev, f"seed {seed}: per-take results diverge"
         assert state_fast == state_dev, f"seed {seed}: final states diverge"
+        # Same law with the host tier backed by the C++ store (numpy-view
+        # proxies over native blocks): identical results, identical state.
+        from patrol_tpu import native as native_mod
+
+        if native_mod.load() is not None:
+            res_nat, state_nat = run(True, native=True)
+            assert res_nat == res_dev, f"seed {seed}: native-store results diverge"
+            assert state_nat == state_dev, f"seed {seed}: native-store state diverges"
 
 
 class TestReviewRegressions:
