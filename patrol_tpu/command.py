@@ -156,6 +156,7 @@ class Command:
                 "engine_hosted_buckets": engine.hosted_buckets,
                 "engine_host_takes": engine.host_takes,
                 "engine_promotions": engine.promotions,
+                "engine_demotions": engine.demotions,
                 "buckets": len(engine.directory),
                 "node_slot": slots.self_slot,
                 **replicator.stats(),

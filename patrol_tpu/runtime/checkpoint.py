@@ -91,32 +91,42 @@ def restore(directory: str, engine) -> int:
     # Any live host-resident rows move device-side before the join: a
     # restored name could collide with a hosted row, and the max-join
     # below only sees device planes. flush_hosted raises on timeout —
-    # proceeding would silently restore into still-hosted rows.
-    engine.flush_hosted()
-    engine.flush()
+    # proceeding would silently restore into still-hosted rows. Idle
+    # demotion is paused across the whole flush→load→join sequence: a
+    # demotion in the gap would zero the very device rows the join is
+    # about to land on (the restored spend would be stranded where the
+    # host path never reads it — or erased outright by the demotion's
+    # zero racing the join).
+    engine._demotion_paused = True
+    try:
+        engine.flush_hosted()
+        engine.flush()
 
-    data = np.load(os.path.join(directory, "state.npz"))
-    import jax.numpy as jnp
+        data = np.load(os.path.join(directory, "state.npz"))
+        import jax.numpy as jnp
 
-    from patrol_tpu.models.limiter import LimiterState
+        from patrol_tpu.models.limiter import LimiterState
 
-    restored = LimiterState(
-        pn=jnp.asarray(data["pn"]), elapsed=jnp.asarray(data["elapsed"])
-    )
-    with engine._state_mu:
-        engine.state = LimiterState(
-            pn=jnp.maximum(engine.state.pn, restored.pn),
-            elapsed=jnp.maximum(engine.state.elapsed, restored.elapsed),
+        restored = LimiterState(
+            pn=jnp.asarray(data["pn"]), elapsed=jnp.asarray(data["elapsed"])
         )
+        with engine._state_mu:
+            engine.state = LimiterState(
+                pn=jnp.maximum(engine.state.pn, restored.pn),
+                elapsed=jnp.maximum(engine.state.elapsed, restored.elapsed),
+            )
 
-    d = engine.directory
-    with d._mu:
-        for name, row in meta["rows"].items():
-            row = int(row)
-            # Full bind (not just the dict): sets _bound (eviction
-            # eligibility), name bytes + hash, and the resolve-table entry
-            # so restored buckets are hash-resolvable by the wire rx path.
-            d._bind_locked(name, row, int(meta["created_ns"][str(row)]))
-            d.cap_base_nt[row] = int(meta["cap_base_nt"][str(row)])
-            d._next_fresh = max(d._next_fresh, row + 1)
-    return len(meta["rows"])
+        d = engine.directory
+        with d._mu:
+            for name, row in meta["rows"].items():
+                row = int(row)
+                # Full bind (not just the dict): sets _bound (eviction
+                # eligibility), name bytes + hash, and the resolve-table
+                # entry so restored buckets are hash-resolvable by the
+                # wire rx path.
+                d._bind_locked(name, row, int(meta["created_ns"][str(row)]))
+                d.cap_base_nt[row] = int(meta["cap_base_nt"][str(row)])
+                d._next_fresh = max(d._next_fresh, row + 1)
+        return len(meta["rows"])
+    finally:
+        engine._demotion_paused = False

@@ -50,6 +50,11 @@ MESH_WARM_MAX = 1 << 12
 
 
 class MeshEngine(DeviceEngine):
+    # Idle demotion stays off here: the per-row gather/zero pair runs
+    # against SHARDED planes, whose resharding cost/shape is unmeasured —
+    # promoted rows remain device-resident as in r4.
+    _demotion_capable = False
+
     def __init__(
         self,
         config: LimiterConfig,
