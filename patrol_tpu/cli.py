@@ -70,10 +70,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--http-front",
-        choices=["python", "native"],
-        default="python",
-        help="API server: python asyncio (h2c-capable) or the C++ epoll "
-        "front (HTTP/1.1, the /take hot path in native code)",
+        choices=["auto", "python", "native"],
+        default="auto",
+        help="API server: the C++ epoll front serves /take in-process "
+        "(native code, h2c via loopback splice) and is the default when "
+        "the toolchain builds it; python asyncio is the protocol-"
+        "reference implementation and the fallback",
     )
     p.add_argument(
         "--shutdown-timeout",

@@ -44,12 +44,15 @@ class Command:
     # pre-lane-trailer patrol_tpu builds) or "compat" (raw own-lane headers
     # for rolling upgrades). See ops/wire.py module docs.
     wire_mode: str = "aggregate"
-    # HTTP front: "python" = asyncio server (protocol-complete: h2c,
-    # pipelining); "native" = C++ epoll front (net/native_http.py, the Go
-    # net/http performance class for /take; HTTP/1.1 only). Python stays
-    # the default because it speaks h2c; deployments chasing /take rps
-    # pick native.
-    http_front: str = "python"
+    # HTTP front: "native" = C++ epoll front (net/native_http.py) — the
+    # /take decision runs entirely in-process for host-resident buckets
+    # (the reference's performance class, api.go:51-86) and h2c clients
+    # splice to a loopback python h2 server, so protocol parity holds;
+    # "python" = asyncio server, the protocol-reference implementation;
+    # "auto" (default) = native when the toolchain built it, else python.
+    # r4 kept python as default for h2c; the r5 in-front take path plus
+    # the h2c splice makes native strictly better when available.
+    http_front: str = "auto"
     # Checkpoint/resume (the reference has none, SURVEY §5): restore at
     # boot when the directory holds a snapshot; save every interval (0 ⇒
     # only at shutdown) and at graceful shutdown.
@@ -85,6 +88,11 @@ class Command:
         slots = SlotTable(
             self.node_addr, self.peer_addrs, max_slots=self.config.nodes
         )
+        http_front = self.http_front
+        if http_front == "auto":
+            from patrol_tpu.net import native_http as _nh
+
+            http_front = "native" if _nh.available() else "python"
         if self.mesh_replicas > 0:
             from patrol_tpu.runtime.mesh_engine import MeshEngine
 
@@ -103,7 +111,7 @@ class Command:
                 # and /take is served on the epoll thread (api.go:51-86's
                 # in-process shape); python front keeps the pure-Python
                 # host tier.
-                native_host=(self.http_front == "native"),
+                native_host=(http_front == "native"),
             )
 
         from patrol_tpu.net import native_replication
@@ -166,7 +174,7 @@ class Command:
         host, _, port = self.api_addr.rpartition(":")
         native_front = None
         server = None
-        if self.http_front == "native":
+        if http_front == "native":
             from patrol_tpu.net import native_http
 
             native_front = native_http.NativeHTTPFront(
