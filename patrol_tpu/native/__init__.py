@@ -181,6 +181,13 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, _u8p, ctypes.c_int32, _i64p, ctypes.c_int64,
         ]
         lib.pt_dir_resolve_rt.restype = ctypes.c_int32
+        lib.pt_fold_hybrid.argtypes = [
+            _i64p, _i64p, _i64p, _i64p, _i64p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            _i64p, _i64p, _i64p, ctypes.c_int64,
+            _i64p, _i64p, _i64p, _i64p, _i64p, _i64p, _i64p,
+        ]
+        lib.pt_fold_hybrid.restype = ctypes.c_int
         lib.pt_http_blast.argtypes = [
             ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, _u64p,

@@ -31,8 +31,12 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <memory>
 #include <mutex>
 #include <shared_mutex>
+#include <thread>
+#include <unordered_map>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -832,6 +836,179 @@ int pt_dir_destroy(int h) {
   if (!d) return -EBADF;
   g_dirs[h] = nullptr;
   delete d;
+  return 0;
+}
+
+}  // extern "C"
+
+// ---- Native fold-to-dense hybrid (VERDICT r4 item 6) ----------------------
+//
+// The engine's hot-key path was fold-dominated: 131k deltas for one row
+// cost ~6.1 ms of single-threaded numpy (lexsort + reduceat) against a
+// ~0.2 ms device commit. This is the C++ fold: one pass over the batch
+// into per-row lane blocks (dense accumulate + touched bitmap), threaded
+// across cores for large batches — grouping work the clustered/hot-key
+// shapes need WITHOUT a sort. The uniform shape (distinct rows ≈ batch)
+// intentionally bails to the numpy path: per-row blocks would allocate
+// rows×nodes, and that shape is scatter-bound anyway.
+
+namespace {
+
+struct FoldRowAcc {
+  int64_t* lanes = nullptr;   // [nodes, 2] max-joined values
+  uint64_t* bits = nullptr;   // touched-slot bitmap
+  int64_t elapsed = 0;
+  int64_t touched = 0;
+};
+
+struct FoldShard {
+  std::unordered_map<int64_t, FoldRowAcc> map;
+  std::vector<std::unique_ptr<int64_t[]>> lane_arena;
+  std::vector<std::unique_ptr<uint64_t[]>> bit_arena;
+  bool aborted = false;
+};
+
+void fold_shard(const int64_t* rows, const int64_t* slots,
+                const int64_t* added, const int64_t* taken,
+                const int64_t* elapsed, int64_t lo, int64_t hi,
+                int64_t nodes, int64_t max_distinct, int64_t bit_words,
+                FoldShard* sh) {
+  auto& map = sh->map;
+  for (int64_t i = lo; i < hi; i++) {
+    int64_t slot = slots[i];
+    if (slot < 0 || slot >= nodes) {
+      sh->aborted = true;  // malformed: let the python path handle it
+      return;
+    }
+    auto it = map.find(rows[i]);
+    if (it == map.end()) {
+      if ((int64_t)map.size() >= max_distinct) {
+        sh->aborted = true;  // uniform shape: numpy path is the right tool
+        return;
+      }
+      sh->lane_arena.emplace_back(new int64_t[nodes * 2]());
+      sh->bit_arena.emplace_back(new uint64_t[bit_words]());
+      FoldRowAcc acc;
+      acc.lanes = sh->lane_arena.back().get();
+      acc.bits = sh->bit_arena.back().get();
+      it = map.emplace(rows[i], acc).first;
+    }
+    FoldRowAcc& a = it->second;
+    uint64_t w = (uint64_t)slot >> 6, b = 1ULL << (slot & 63);
+    if (!(a.bits[w] & b)) {
+      a.bits[w] |= b;
+      a.touched++;
+    }
+    int64_t* lane = a.lanes + slot * 2;
+    if (added[i] > lane[0]) lane[0] = added[i];
+    if (taken[i] > lane[1]) lane[1] = taken[i];
+    if (elapsed[i] > a.elapsed) a.elapsed = elapsed[i];
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// → 0 ok, -1 fall back to the numpy fold (too many distinct rows or a
+// malformed slot). out_counts = {n_sparse_pairs, n_sparse_rows, n_dense}.
+// Dense rows beyond cap_dense spill to the sparse outputs in ascending
+// row order — the same first-cap selection as the numpy hybrid.
+int pt_fold_hybrid(const int64_t* rows, const int64_t* slots,
+                   const int64_t* added, const int64_t* taken,
+                   const int64_t* elapsed, int64_t n, int64_t nodes,
+                   int64_t row_dense_min, int64_t max_distinct,
+                   int64_t* d_rows, int64_t* d_upd, int64_t* d_el,
+                   int64_t cap_dense, int64_t* sp_rows, int64_t* sp_slots,
+                   int64_t* sp_a, int64_t* sp_t, int64_t* sp_er,
+                   int64_t* sp_e, int64_t* out_counts) {
+  if (n <= 0 || nodes <= 0) return -1;
+  const int64_t bit_words = (nodes + 63) / 64;
+  unsigned hw = std::thread::hardware_concurrency();
+  int T = (n >= 65536 && hw > 1) ? (int)std::min<unsigned>(hw, 8) : 1;
+  // Test/tuning override: force the shard count (exercises the shard
+  // merge on single-core boxes; 0/unset = auto).
+  if (const char* tf = getenv("PATROL_FOLD_THREADS")) {
+    int v = atoi(tf);
+    if (v > 0) T = std::min(v, 8);
+  }
+  std::vector<FoldShard> shards((size_t)T);
+  if (T == 1) {
+    fold_shard(rows, slots, added, taken, elapsed, 0, n, nodes,
+               max_distinct, bit_words, &shards[0]);
+  } else {
+    std::vector<std::thread> ts;
+    int64_t step = (n + T - 1) / T;
+    for (int t = 0; t < T; t++) {
+      int64_t lo = t * step, hi = std::min<int64_t>(n, lo + step);
+      if (lo >= hi) break;
+      ts.emplace_back(fold_shard, rows, slots, added, taken, elapsed, lo,
+                      hi, nodes, max_distinct, bit_words, &shards[t]);
+    }
+    for (auto& t : ts) t.join();
+  }
+  for (auto& sh : shards)
+    if (sh.aborted) return -1;
+  // Merge shards 1..T-1 into shard 0 (small maps: ≤ max_distinct rows).
+  FoldShard& m = shards[0];
+  for (int t = 1; t < T; t++) {
+    for (auto& kv : shards[t].map) {
+      auto it = m.map.find(kv.first);
+      if (it == m.map.end()) {
+        if ((int64_t)m.map.size() >= max_distinct) return -1;
+        m.lane_arena.emplace_back(new int64_t[nodes * 2]());
+        m.bit_arena.emplace_back(new uint64_t[bit_words]());
+        FoldRowAcc acc;
+        acc.lanes = m.lane_arena.back().get();
+        acc.bits = m.bit_arena.back().get();
+        it = m.map.emplace(kv.first, acc).first;
+      }
+      FoldRowAcc& a = it->second;
+      const FoldRowAcc& b = kv.second;
+      for (int64_t w = 0; w < bit_words; w++) a.bits[w] |= b.bits[w];
+      for (int64_t j = 0; j < nodes * 2; j++)
+        if (b.lanes[j] > a.lanes[j]) a.lanes[j] = b.lanes[j];
+      if (b.elapsed > a.elapsed) a.elapsed = b.elapsed;
+      a.touched = 0;
+      for (int64_t w = 0; w < bit_words; w++)
+        a.touched += __builtin_popcountll(a.bits[w]);
+    }
+  }
+  // Emit in ascending row order (the numpy fold's sorted invariant).
+  std::vector<std::pair<int64_t, const FoldRowAcc*>> ordered;
+  ordered.reserve(m.map.size());
+  for (auto& kv : m.map) ordered.emplace_back(kv.first, &kv.second);
+  std::sort(ordered.begin(), ordered.end(),
+            [](const auto& x, const auto& y) { return x.first < y.first; });
+  int64_t np = 0, nr = 0, nd = 0;
+  for (auto& [row, acc] : ordered) {
+    if (acc->touched >= row_dense_min && nd < cap_dense) {
+      d_rows[nd] = row;
+      d_el[nd] = acc->elapsed;
+      std::memcpy(d_upd + nd * nodes * 2, acc->lanes,
+                  sizeof(int64_t) * nodes * 2);
+      nd++;
+      continue;
+    }
+    for (int64_t w = 0; w < bit_words; w++) {
+      uint64_t bits = acc->bits[w];
+      while (bits) {
+        int64_t slot = w * 64 + __builtin_ctzll(bits);
+        bits &= bits - 1;
+        sp_rows[np] = row;
+        sp_slots[np] = slot;
+        sp_a[np] = acc->lanes[slot * 2];
+        sp_t[np] = acc->lanes[slot * 2 + 1];
+        np++;
+      }
+    }
+    sp_er[nr] = row;
+    sp_e[nr] = acc->elapsed;
+    nr++;
+  }
+  out_counts[0] = np;
+  out_counts[1] = nr;
+  out_counts[2] = nd;
   return 0;
 }
 

@@ -327,6 +327,105 @@ def _pad_size(n: int, lo: int = 8, hi: int = MAX_MERGE_ROWS) -> int:
     return size
 
 
+# Distinct-row bound for the native fold: past this the per-row lane
+# blocks stop paying for themselves (the uniform shape is scatter-bound
+# anyway) and the numpy fold takes over.
+FOLD_NATIVE_MAX_DISTINCT = int(
+    os.environ.get("PATROL_FOLD_NATIVE_MAX_DISTINCT", 4096)
+)
+
+# Per-thread reusable output buffers for the native fold (the feeder is
+# the caller in production; the bench drives it from the main thread; two
+# engines in one process each fold on their own feeder — thread-local
+# keeps them from sharing).
+_fold_tls = threading.local()
+
+
+def _fold_buffers(nodes: int, cap_pairs: int):
+    cached = getattr(_fold_tls, "bufs", None)
+    if (
+        cached is not None
+        and cached[0][0] == nodes
+        and cached[0][1] >= cap_pairs
+    ):
+        return cached[1]
+    cap_pairs = 1 << max(cap_pairs - 1, 1).bit_length()  # grow-once sizes
+    cap_rows = min(cap_pairs, FOLD_NATIVE_MAX_DISTINCT)
+    bufs = (
+        np.empty(MAX_ROW_DENSE, np.int64),
+        np.empty((MAX_ROW_DENSE, nodes, 2), np.int64),
+        np.empty(MAX_ROW_DENSE, np.int64),
+        np.empty(cap_pairs, np.int64),
+        np.empty(cap_pairs, np.int64),
+        np.empty(cap_pairs, np.int64),
+        np.empty(cap_pairs, np.int64),
+        np.empty(cap_rows, np.int64),
+        np.empty(cap_rows, np.int64),
+        np.zeros(3, np.int64),
+    )
+    _fold_tls.bufs = ((nodes, cap_pairs), bufs)
+    return bufs
+
+
+def _fold_hybrid_native(deltas: DeltaArrays, nodes: int, row_dense_min: int):
+    """C++ fold (pt_fold_hybrid): one hash pass into per-row lane blocks,
+    threaded across cores for large batches — replaces the numpy
+    lexsort+reduceat fold that dominated the hot-key tick (~6.1 ms for
+    131k deltas vs ~0.2 ms of device commit, VERDICT r4 item 6). Returns
+    the exact numpy-fold result shape, or None to fall back (library
+    unavailable, tiny batch, or a distinct-row set past the bound)."""
+    n = len(deltas.rows)
+    if n < 1024:
+        return None  # per-call buffers beat numpy only at batch scale
+    # Cheap shape probe BEFORE any allocation or native work: a mostly-
+    # distinct sample means the uniform shape (the native fold would only
+    # burn a partial hash pass to discover it must bail, and the numpy
+    # fold would then redo the batch from scratch). The sample is sized
+    # so a clustered batch can't trip it: its unique count is bounded by
+    # the true distinct-row count, so only shapes near/past the native
+    # bound (where numpy is the right path anyway) read as uniform.
+    sample = deltas.rows[:: max(1, n // 2048)][:2048]
+    if len(np.unique(sample)) >= 0.85 * len(sample):
+        return None
+    from patrol_tpu import native as native_mod
+
+    lib = native_mod.load()
+    if lib is None:
+        return None
+    rows = np.ascontiguousarray(deltas.rows, np.int64)
+    slots = np.ascontiguousarray(deltas.slots, np.int64)
+    added = np.ascontiguousarray(deltas.added_nt, np.int64)
+    taken = np.ascontiguousarray(deltas.taken_nt, np.int64)
+    elapsed = np.ascontiguousarray(deltas.elapsed_ns, np.int64)
+    bufs = _fold_buffers(nodes, min(n, FOLD_NATIVE_MAX_DISTINCT * nodes))
+    (d_rows, d_upd, d_el, sp_rows, sp_slots, sp_a, sp_t, sp_er, sp_e,
+     counts) = bufs
+    counts[:] = 0
+    rc = lib.pt_fold_hybrid(
+        rows, slots, added, taken, elapsed, n, nodes, row_dense_min,
+        FOLD_NATIVE_MAX_DISTINCT, d_rows, d_upd, d_el, MAX_ROW_DENSE,
+        sp_rows, sp_slots, sp_a, sp_t, sp_er, sp_e, counts,
+    )
+    if rc != 0:
+        return None
+    n_pairs, n_rows, n_dense = int(counts[0]), int(counts[1]), int(counts[2])
+    packed = DeviceEngine._pack_folded(
+        sp_rows[:n_pairs], sp_slots[:n_pairs], sp_a[:n_pairs],
+        sp_t[:n_pairs], sp_er[:n_rows], sp_e[:n_rows],
+    )
+    if n_dense == 0:
+        return packed, None
+    rp = _pad_size(n_dense, lo=8, hi=MAX_ROW_DENSE)
+    rows_p = np.empty(rp, dtype=np.int64)
+    rows_p[:n_dense] = d_rows[:n_dense]
+    rows_p[n_dense:] = _FOLD_PAD_ROW + np.arange(rp - n_dense)
+    upd_p = np.zeros((rp, nodes, 2), dtype=np.int64)
+    upd_p[:n_dense] = d_upd[:n_dense]
+    el_p = np.zeros(rp, dtype=np.int64)
+    el_p[:n_dense] = d_el[:n_dense]
+    return packed, (rows_p, upd_p, el_p)
+
+
 def fold_hybrid(deltas: DeltaArrays, nodes: int, row_dense_min: int):
     """Fold-to-dense hybrid split (VERDICT r3 item 3): rows whose tick
     touches ≥ ``row_dense_min`` lanes commit their FULL lane plane as ONE
@@ -334,7 +433,12 @@ def fold_hybrid(deltas: DeltaArrays, nodes: int, row_dense_min: int):
     free — a hot-key tick collapses from ~N updates to 1); the sparse
     remainder rides the flagged pair scatter. Returns
     (packed|None, (rows, updates, elapsed)|None); module-level so the
-    bench measures the exact engine-tick computation."""
+    bench measures the exact engine-tick computation. Large clustered
+    batches fold in C++ (:func:`_fold_hybrid_native`); the numpy fold
+    below is the reference implementation and the uniform-shape path."""
+    native_res = _fold_hybrid_native(deltas, nodes, row_dense_min)
+    if native_res is not None:
+        return native_res
     ur, us, ua, ut, er, e = DeviceEngine._fold_core(deltas)
     nrow = np.empty(len(ur), bool)
     nrow[0] = True
