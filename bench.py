@@ -115,6 +115,7 @@ def _force(tree) -> int:
 def _bench(
     fn, state, *args,
     iters=2, warmup=2, repeats=3, iters_hi=12, indexed=False, device_loop=False,
+    diag=None,
 ):
     """Differential forced-completion timing with ON-DEVICE iteration.
 
@@ -205,17 +206,34 @@ def _bench(
     # landing in one short window could even make a difference negative
     # and lock in an absurd per-step time).
     best_lo = best_hi = float("inf")
+    lo_times, hi_times = [], []
     for _ in range(repeats):
         t0 = time.perf_counter()
         state = run_lo(state, *args)
         _force(state)
-        best_lo = min(best_lo, time.perf_counter() - t0)
+        lo_times.append(time.perf_counter() - t0)
+        best_lo = min(best_lo, lo_times[-1])
         t0 = time.perf_counter()
         state = run_hi(state, *args)
         _force(state)
-        best_hi = min(best_hi, time.perf_counter() - t0)
+        hi_times.append(time.perf_counter() - t0)
+        best_hi = min(best_hi, hi_times[-1])
         if _left() < 30:  # budget guard: keep the first window's number
             break
+    if diag is not None:
+        # Resolution evidence: the signal is the window difference; the
+        # noise estimate is each window's min-to-second-min gap (how well
+        # the min has converged). A caller can then label its number
+        # "measured" vs "upper-bound class" on data instead of vibes.
+        lo_s, hi_s = sorted(lo_times), sorted(hi_times)
+        diag["signal_ms"] = round((best_hi - best_lo) * 1e3, 3)
+        diag["noise_ms"] = round(
+            max(
+                (lo_s[1] - lo_s[0]) if len(lo_s) > 1 else 0.0,
+                (hi_s[1] - hi_s[0]) if len(hi_s) > 1 else 0.0,
+            ) * 1e3, 3,
+        )
+        diag["repeats_done"] = len(hi_times)
     return max(best_hi - best_lo, 1e-9) / (n_hi - n_lo), state
 
 
@@ -473,6 +491,46 @@ def _run_stages(out) -> None:
     _roofline(out, "scatter", K * 128, dt_scatter)
     _stage_done("scatter")
     _log(f"scatter: {out['scatter_merges_per_s']:.3g} merges/s")
+
+    # -- the PRODUCTION uniform-tick kernel: folded flagged scatter ---------
+    # On accelerator backends the engine tick always folds
+    # (PATROL_TICK_FOLD default 1): host fold → sorted UNIQUE
+    # sentinel-padded pairs → merge_batch_folded with both scatter flags
+    # + mode="drop". The plain stage above measures the unflagged scatter
+    # class for r3/r4 continuity; THIS is what config #3 deltas actually
+    # ride on TPU (probe matrix: scripts/probe_scatter.py — flags ~1.7×
+    # the plain class; a flat re-key regresses and was declined).
+    if _budget_out("folded scatter"):
+        return
+    from patrol_tpu.runtime.engine import DeltaArrays as _DA
+    from patrol_tpu.runtime.engine import DeviceEngine as _DE
+    from patrol_tpu.ops.merge import FoldedMergeBatch, merge_batch_folded
+
+    r_np, s_np, a_np, t_np, e_np = _mk_merge_batch(K, B, N, as_numpy=True)
+    packed_np = _DE._fold_lane_merges(_DA(
+        rows=r_np, slots=s_np, added_nt=a_np, taken_nt=t_np,
+        elapsed_ns=e_np, scalar=None,
+    ))
+    packed_dev = jnp.asarray(packed_np)
+
+    def folded_step(s, p, i):
+        return merge_batch_folded(
+            s,
+            FoldedMergeBatch(
+                rows=p[0].astype(jnp.int32), slots=p[1].astype(jnp.int32),
+                added_nt=p[2] + i, taken_nt=p[3] + i,
+                erows=p[4].astype(jnp.int32), elapsed_ns=p[5] + i,
+            ),
+        )
+
+    _log("folded scatter (production uniform kernel)…")
+    dt_folded, state = _bench(
+        folded_step, state, packed_dev, iters=2, iters_hi=12, indexed=True
+    )
+    out["scatter_folded_merges_per_s"] = round(K / dt_folded)
+    _roofline(out, "scatter_folded", K * 128, dt_folded)
+    _stage_done("scatter-folded")
+    _log(f"folded scatter: {out['scatter_folded_merges_per_s']:.3g} merges/s")
 
     # -- pallas-vs-XLA scatter (VERDICT r1 item 5; TPU only) ----------------
     if _budget_out("pallas compare"):
@@ -739,13 +797,34 @@ def _stage_mesh_step(out, B, N) -> None:
         return step(s, mb_i, req_)[0]
 
     _log("mesh step (compile)…")
-    dt, state = _bench(run, state, mb, req, iters=2, iters_hi=12, indexed=True)
-    # Honesty annotation: the fused step is ~0.5-5 ms, so even this
-    # 10-step differential signal sits at the tunnel's ±15 ms noise floor
-    # (r3 captures ranged 0.0-4.8 ms/step; a 32-step window did not help
-    # and compiled for ~8 min). Treat the number as an upper-bound class,
-    # not a resolved per-step time.
-    out["mesh_step_note"] = "differential at tunnel noise floor; upper-bound class"
+    # VERDICT r4 item 8: buy a real measurement. Amortize harder (a
+    # 2→32-step unrolled window: 30 steps of signal) AND repeat harder
+    # (10 windows per size: the min-estimator converges well under the
+    # tunnel's per-execute jitter), then label the basis from DATA: the
+    # window diagnostic reports the signal (hi−lo minima difference) and
+    # a noise estimate (each window's min→second-min gap). "measured"
+    # requires signal > 4× noise — otherwise the honest r3/r4 label
+    # stands. A fori amortization is NOT available here: the carry
+    # ping-pong would force a full 4 GB sharded-state copy per iteration
+    # on this scatter-shaped step (see _bench's device_loop note).
+    mdiag = {}
+    dt, state = _bench(
+        run, state, mb, req, iters=2, iters_hi=32, repeats=10,
+        indexed=True, diag=mdiag,
+    )
+    resolved = (
+        mdiag.get("signal_ms", 0.0) > 4 * max(mdiag.get("noise_ms", 0.0), 1e-3)
+    )
+    out["mesh_step_basis"] = "measured" if resolved else "upper-bound class"
+    out["mesh_step_note"] = (
+        "measured: 30-step differential signal "
+        f"{mdiag.get('signal_ms')} ms vs window-min noise "
+        f"{mdiag.get('noise_ms')} ms over {mdiag.get('repeats_done')} repeats"
+        if resolved
+        else "differential at tunnel noise floor; upper-bound class "
+        f"(signal {mdiag.get('signal_ms')} ms vs noise "
+        f"{mdiag.get('noise_ms')} ms)"
+    )
     out["mesh_step_us"] = round(dt * 1e6, 1)
     out["mesh_step_ops"] = kt + km
     out["mesh_devices"] = n_dev
@@ -1037,11 +1116,15 @@ def _stage_ingest_replay(out, B, N, on_accel) -> None:
         out["ingest_device_drain_ms"] = round((dt - t_host) * 1e3, 1)
         # What the same pipeline sustains with a LOCAL device (no tunnel
         # between host and HBM): the slower of the host pipeline and the
-        # device scatter-merge ceiling measured by the scatter stage. The
-        # host term prefers the ISOLATED stage's rate — the in-replay
-        # decode/feed walls are contention-inflated by the drain threads
-        # sharing this 1-vCPU host whenever the transport walls the drain.
-        dev_rate = out.get("scatter_merges_per_s")
+        # device scatter-merge ceiling: the PRODUCTION uniform kernel
+        # (folded flagged scatter — what the accelerator tick dispatches)
+        # when measured, else the plain class. The host term prefers the
+        # ISOLATED stage's rate — the in-replay decode/feed walls are
+        # contention-inflated by the drain threads sharing this 1-vCPU
+        # host whenever the transport walls the drain.
+        dev_rate = out.get("scatter_folded_merges_per_s") or out.get(
+            "scatter_merges_per_s"
+        )
         # `or`, not a .get default: the isolated stage records 0 when the
         # budget ran out before its first window, and a recorded 0 must
         # fall back to the in-replay rate rather than erase the metric.

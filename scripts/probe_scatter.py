@@ -165,6 +165,97 @@ def main():
 
     stages["el_flags"] = (el_flags, mk_el, ())
 
+    # --- the engine's REAL uniform tick kernel (r5): host C++ fold →
+    # sorted unique sentinel-padded pairs → flagged scatter. The plain
+    # elem3 above is the unfolded class the r4 bench measured; if the
+    # flags buy a material win, the bench's scatter stage should measure
+    # THIS (it is what the engine dispatches for uniform batches on
+    # accelerator backends, PATROL_TICK_FOLD default 1).
+    from patrol_tpu.ops.merge import FoldedMergeBatch, merge_batch_folded
+    from patrol_tpu.runtime.engine import DeltaArrays, DeviceEngine
+
+    deltas_np = DeltaArrays(
+        rows=rows_np.astype(np.int64), slots=slots_np.astype(np.int64),
+        added_nt=np.asarray(a), taken_nt=np.asarray(t),
+        elapsed_ns=np.asarray(e), scalar=np.zeros(K, bool),
+    )
+    packed_np = DeviceEngine._fold_lane_merges(deltas_np)
+    packed = jnp.asarray(packed_np)
+
+    def folded(s, i):
+        from patrol_tpu.models.limiter import LimiterState
+
+        st = LimiterState(pn=s[0], elapsed=s[1])
+        st = merge_batch_folded(
+            st,
+            FoldedMergeBatch(
+                rows=packed[0].astype(jnp.int32),
+                slots=packed[1].astype(jnp.int32),
+                added_nt=packed[2] + i,
+                taken_nt=packed[3] + i,
+                erows=packed[4].astype(jnp.int32),
+                elapsed_ns=packed[5] + i,
+            ),
+        )
+        return (st.pn, st.elapsed)
+
+    stages["folded"] = (folded, mk_pn_el, ())
+
+    # --- folded + flat key: the folded pack's sorted UNIQUE (row,slot)
+    # pairs re-keyed as row*N+slot — one index dim, sorted+unique flags,
+    # sentinel tail dropped via OOB mode="drop" (sentinel rows are far
+    # above B so their flat keys are OOB of B*N).
+    Kp = packed_np.shape[1]
+    flat_packed = jnp.asarray(packed_np[0] * N + packed_np[1])
+    p2 = jnp.asarray(packed_np[2])
+    p3 = jnp.asarray(packed_np[3])
+    p4 = jnp.asarray(packed_np[4].astype(np.int32))
+    p5 = jnp.asarray(packed_np[5])
+
+    def folded_flat(s, i):
+        pn, el = s
+        fp = pn.reshape(B * N, 2)
+        fp = fp.at[flat_packed].max(
+            jnp.stack([p2 + i, p3 + i], -1),
+            indices_are_sorted=True, unique_indices=True, mode="drop",
+        )
+        el = el.at[p4].max(
+            p5 + i, indices_are_sorted=True, unique_indices=True,
+            mode="drop",
+        )
+        return (fp.reshape(B, N, 2), el)
+
+    stages["folded_flat"] = (folded_flat, mk_pn_el, ())
+
+    # --- flat-key single scatter: same [B,N,2] memory viewed [B*N, 2],
+    # ONE pair-window scatter at row*N+slot (one index dim instead of
+    # two). A probe-only layout question: reshape is free, so a win here
+    # is adoptable without moving bytes.
+    flat_idx = jnp.asarray(rows_np.astype(np.int64) * N + slots_np)
+    flat_sorted = jnp.asarray(
+        np.sort(rows_np.astype(np.int64) * N + slots_np)
+    )
+
+    def flat(s, i):
+        pn, el = s
+        fp = pn.reshape(B * N, 2)
+        fp = fp.at[flat_idx].max(jnp.stack([a + i, t + i], -1))
+        el = el.at[rows].max(e + i)
+        return (fp.reshape(B, N, 2), el)
+
+    stages["flat"] = (flat, mk_pn_el, ())
+
+    def flat_flags(s, i):
+        pn, el = s
+        fp = pn.reshape(B * N, 2)
+        fp = fp.at[flat_sorted].max(
+            jnp.stack([a + i, t + i], -1), indices_are_sorted=True
+        )
+        el = el.at[rows_sorted].max(e + i, indices_are_sorted=True)
+        return (fp.reshape(B, N, 2), el)
+
+    stages["flat_flags"] = (flat_flags, mk_pn_el, ())
+
     # --- take-shaped commits (K unique rows, add semantics) ---
     KT = 4096
     trows = jnp.asarray(

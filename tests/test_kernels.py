@@ -10,7 +10,9 @@ import pytest
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
-from patrol_tpu.models.limiter import ADDED, TAKEN, NANO, LimiterConfig, init_state
+from patrol_tpu.models.limiter import (
+    ADDED, TAKEN, NANO, LimiterConfig, LimiterState, init_state,
+)
 from patrol_tpu.ops.merge import (
     MergeBatch,
     merge_batch,
@@ -230,6 +232,29 @@ class TestMergeKernels:
         # Join with itself is idempotent.
         again = merge_dense(joined, b)
         assert (np.asarray(again.pn) == np.asarray(joined.pn)).all()
+
+    def test_merge_dense_u64_bitcast_equals_signed_max(self):
+        """r5: merge_dense runs its max on uint64-bitcast planes (v5e's
+        unsigned u32-pair emulation streams ~1.36× the signed one). For
+        the CRDT's non-negative domain the two are bit-identical —
+        pinned here over random planes plus the edge values (0, 1,
+        2^62, INT64_MAX)."""
+        rng = np.random.default_rng(12)
+        edges = np.array([0, 1, 2**62, 2**63 - 1], np.int64)
+        for _ in range(4):
+            shape = (16, 4, 2)
+            a = rng.integers(0, 2**63 - 1, shape, dtype=np.int64)
+            b = rng.integers(0, 2**63 - 1, shape, dtype=np.int64)
+            a.ravel()[:4] = edges
+            b.ravel()[:4] = edges[::-1]
+            ea = rng.integers(0, 2**63 - 1, 16, dtype=np.int64)
+            eb = rng.integers(0, 2**63 - 1, 16, dtype=np.int64)
+            got = merge_dense(
+                LimiterState(pn=jnp.asarray(a), elapsed=jnp.asarray(ea)),
+                LimiterState(pn=jnp.asarray(b), elapsed=jnp.asarray(eb)),
+            )
+            assert (np.asarray(got.pn) == np.maximum(a, b)).all()
+            assert (np.asarray(got.elapsed) == np.maximum(ea, eb)).all()
 
     def test_merge_then_take_sees_remote_takes(self):
         """Cross-node visibility: node 1's replicated takes reduce what node 0
