@@ -101,18 +101,18 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pt_http_port.restype = ctypes.c_int
         lib.pt_http_poll.argtypes = [
             ctypes.c_int, ctypes.c_int,
-            _u64p, _u8p, _i32p, _i64p, _i64p, _i64p, ctypes.c_int,
-            _u64p, _u8p, _i32p, _u8p, ctypes.c_int,
+            _u64p, _i32p, _u8p, _i32p, _i64p, _i64p, _i64p, ctypes.c_int,
+            _u64p, _i32p, _u8p, _i32p, _u8p, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int),
         ]
         lib.pt_http_poll.restype = ctypes.c_int
         lib.pt_http_complete_takes.argtypes = [
-            ctypes.c_int, _u64p, _i32p, _i64p, ctypes.c_int,
+            ctypes.c_int, _u64p, _i32p, _i32p, _i64p, ctypes.c_int,
         ]
         lib.pt_http_complete_takes.restype = ctypes.c_int
         lib.pt_http_complete_other.argtypes = [
-            ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p,
-            ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
         ]
         lib.pt_http_complete_other.restype = ctypes.c_int
         lib.pt_http_stats.argtypes = [ctypes.c_int, _u64p]
@@ -193,6 +193,11 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_int, ctypes.c_int, _u64p,
         ]
         lib.pt_http_blast.restype = ctypes.c_int
+        lib.pt_http_blast_h2.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint16, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, _u64p,
+        ]
+        lib.pt_http_blast_h2.restype = ctypes.c_int
         lib.pt_parse_rate.argtypes = [
             ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_int64),

@@ -45,6 +45,7 @@
 #include <cmath>
 #include <condition_variable>
 #include <deque>
+#include <dlfcn.h>
 #include <mutex>
 #include <thread>
 #include <unordered_map>
@@ -349,6 +350,7 @@ int64_t realtime_ns() {
 
 struct TakeRec {
   uint64_t tag;
+  int32_t stream;  // h2 stream id; 0 = HTTP/1.1
   int64_t freq, per_ns, count;
   uint8_t name[kNameMax];
   int name_len;
@@ -356,10 +358,136 @@ struct TakeRec {
 
 struct OtherRec {
   uint64_t tag;
+  int32_t stream;  // h2 stream id; 0 = HTTP/1.1
   char method[8];
   char target[kPathMax];  // path?query
   int target_len;
 };
+
+// ---- native h2c (VERDICT r4 item 9) ---------------------------------------
+//
+// The reference serves h2c from its single front (command.go:41-44); r4's
+// splice satisfied protocol parity at python-front speed. This serves the
+// h2 request/response framing DIRECTLY for the API's bodyless shapes:
+// SETTINGS/PING/WINDOW_UPDATE handling, HEADERS (+CONTINUATION, padding,
+// priority) with HPACK decoding delegated to the system libnghttp2
+// inflater (the same battle-tested one net/h2.py and curl use; response
+// headers use only HPACK literals-without-indexing, so no deflater), and
+// flow-controlled DATA out. net/h2.py is the porting spec. When
+// libnghttp2 is unavailable the old splice (python h2 backend) remains
+// the fallback; the h1→h2c Upgrade dance stays a python-front feature.
+
+struct Nghttp2 {
+  void* handle = nullptr;
+  int (*inflate_new)(void**) = nullptr;
+  void (*inflate_del)(void*) = nullptr;
+  ssize_t (*inflate_hd2)(void*, void* nv, int* flags, const uint8_t* in,
+                         size_t inlen, int in_final) = nullptr;
+  int (*inflate_end_headers)(void*) = nullptr;
+  bool ok() const { return inflate_hd2 != nullptr; }
+};
+
+struct NgNV {  // nghttp2_nv layout (name/value pointers + lengths + flags)
+  uint8_t* name;
+  uint8_t* value;
+  size_t namelen;
+  size_t valuelen;
+  uint8_t flags;
+};
+
+Nghttp2* load_nghttp2() {
+  static Nghttp2 g;
+  static std::once_flag once;
+  std::call_once(once, [] {
+    void* h = dlopen("libnghttp2.so.14", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = dlopen("libnghttp2.so", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) return;
+    g.handle = h;
+    g.inflate_new = (int (*)(void**))dlsym(h, "nghttp2_hd_inflate_new");
+    g.inflate_del = (void (*)(void*))dlsym(h, "nghttp2_hd_inflate_del");
+    g.inflate_hd2 = (ssize_t (*)(void*, void*, int*, const uint8_t*, size_t,
+                                 int))dlsym(h, "nghttp2_hd_inflate_hd2");
+    g.inflate_end_headers =
+        (int (*)(void*))dlsym(h, "nghttp2_hd_inflate_end_headers");
+    if (!g.inflate_new || !g.inflate_del || !g.inflate_end_headers)
+      g.inflate_hd2 = nullptr;
+  });
+  return g.ok() ? &g : nullptr;
+}
+
+constexpr int kH2HeadersFrame = 0x1;
+constexpr int kH2Priority = 0x2;
+constexpr int kH2RstStream = 0x3;
+constexpr int kH2Settings = 0x4;
+constexpr int kH2Ping = 0x6;
+constexpr int kH2Goaway = 0x7;
+constexpr int kH2WindowUpdate = 0x8;
+constexpr int kH2Continuation = 0x9;
+constexpr int kH2Data = 0x0;
+constexpr uint8_t kH2FlagEndStream = 0x1;
+constexpr uint8_t kH2FlagAck = 0x1;
+constexpr uint8_t kH2FlagEndHeaders = 0x4;
+constexpr uint8_t kH2FlagPadded = 0x8;
+constexpr uint8_t kH2FlagPriority = 0x20;
+
+// Peers must accept frames up to the h2 default; we never send larger
+// (RFC 7540 §4.2: SETTINGS_MAX_FRAME_SIZE is never below this).
+constexpr size_t kH2MaxSend = 16384;
+// Hostile-input bounds: one header block, and the conn's total write
+// backlog (an unread socket must backpressure, not buffer unboundedly).
+constexpr size_t kH2MaxHeaderBlock = 64 * 1024;
+constexpr size_t kH2MaxWbuf = 1 << 20;
+
+struct H2State {
+  void* inflater = nullptr;
+  int64_t conn_send_window = 65535;
+  int64_t peer_initial_window = 65535;
+  // CONTINUATION accumulation for one in-flight header block.
+  int32_t hdr_stream = 0;
+  std::string hdr_block;
+  // DATA parked behind a spent connection OR stream window:
+  // (stream, body, stream_window_remaining).
+  std::deque<std::tuple<int32_t, std::string, int64_t>> pending;
+  uint64_t rx_data_unacked = 0;
+};
+
+void h2_append_frame(std::string& out, int type, uint8_t flags,
+                     int32_t stream, const char* payload, size_t n) {
+  out.push_back((char)((n >> 16) & 0xFF));
+  out.push_back((char)((n >> 8) & 0xFF));
+  out.push_back((char)(n & 0xFF));
+  out.push_back((char)type);
+  out.push_back((char)flags);
+  out.push_back((char)((stream >> 24) & 0x7F));
+  out.push_back((char)((stream >> 16) & 0xFF));
+  out.push_back((char)((stream >> 8) & 0xFF));
+  out.push_back((char)(stream & 0xFF));
+  out.append(payload, n);
+}
+
+// HPACK literal-without-indexing, new name, no Huffman (RFC 7541 §6.2.2)
+// — the always-valid canonical form net/h2.py uses for responses.
+void hpack_literal(std::string& out, const char* name, size_t nlen,
+                   const char* value, size_t vlen) {
+  out.push_back('\0');
+  auto prefix_int = [&](size_t n) {
+    if (n < 127) {
+      out.push_back((char)n);
+      return;
+    }
+    out.push_back(127);
+    n -= 127;
+    while (n >= 128) {
+      out.push_back((char)((n & 0x7F) | 0x80));
+      n >>= 7;
+    }
+    out.push_back((char)n);
+  };
+  prefix_int(nlen);
+  out.append(name, nlen);
+  prefix_int(vlen);
+  out.append(value, vlen);
+}
 
 struct Conn {
   int fd = -1;
@@ -376,6 +504,9 @@ struct Conn {
   // protocol itself is served by the python front on the backend port.
   bool proxy = false;
   int peer_slot = -1;
+  // Native h2c mode (preferred over the splice when libnghttp2 loads):
+  // the connection speaks h2 frames directly; h2 != nullptr is the flag.
+  H2State* h2 = nullptr;
   std::chrono::steady_clock::time_point req_start{};  // latency stamp
 };
 
@@ -485,6 +616,14 @@ void close_conn(Server* s, int slot) {
   ::close(c.fd);
   c.fd = -1;
   c.gen++;  // invalidate outstanding tags
+  if (c.h2) {
+    if (c.h2->inflater) {
+      Nghttp2* ng = load_nghttp2();
+      if (ng) ng->inflate_del(c.h2->inflater);
+    }
+    delete c.h2;
+    c.h2 = nullptr;
+  }
   c.rbuf.clear();
   c.rbuf.shrink_to_fit();
   c.wbuf.clear();
@@ -502,6 +641,247 @@ void close_conn(Server* s, int slot) {
     s->conns[peer].peer_slot = -1;
     close_conn(s, peer);
   }
+}
+
+// Emit one stream's DATA, split to the always-valid frame size, debiting
+// the connection window (the caller already cleared the stream window).
+void h2_emit_data(Conn* c, int32_t stream, const char* body, size_t n) {
+  c->h2->conn_send_window -= (int64_t)n;
+  size_t off = 0;
+  do {
+    size_t chunk = std::min(n - off, kH2MaxSend);
+    bool last = off + chunk == n;
+    h2_append_frame(c->wbuf, kH2Data, last ? kH2FlagEndStream : 0, stream,
+                    body + off, chunk);
+    off += chunk;
+  } while (off < n);
+}
+
+// Queue one h2 response (HEADERS + DATA/END_STREAM) onto the conn,
+// respecting BOTH flow-control windows (HEADERS frames are exempt; DATA
+// debits the connection window and must fit the stream's initial window
+// — we send exactly one response per stream, so its window at send time
+// is the peer's INITIAL_WINDOW_SIZE plus any stream WINDOW_UPDATEs,
+// tracked only for parked responses). mu held.
+void queue_h2_response(Server* s, Conn* c, int32_t stream, int code,
+                       const char* ctype, const char* body,
+                       size_t body_len) {
+  std::string block;
+  char st[8], cl[8];
+  int stl = snprintf(st, sizeof(st), "%d", code);
+  int cll = snprintf(cl, sizeof(cl), "%zu", body_len);
+  hpack_literal(block, ":status", 7, st, stl);
+  hpack_literal(block, "content-type", 12, ctype, strlen(ctype));
+  hpack_literal(block, "content-length", 14, cl, cll);
+  // Header blocks above the frame bound continue in CONTINUATION frames.
+  size_t off = 0;
+  bool first = true;
+  do {
+    size_t chunk = std::min(block.size() - off, kH2MaxSend);
+    bool last = off + chunk == block.size();
+    uint8_t fl = (last ? kH2FlagEndHeaders : 0) |
+                 (first && body_len == 0 ? kH2FlagEndStream : 0);
+    h2_append_frame(c->wbuf, first ? kH2HeadersFrame : kH2Continuation, fl,
+                    stream, block.data() + off, chunk);
+    first = false;
+    off += chunk;
+  } while (off < block.size());
+  if (body_len == 0) return;
+  H2State* h = c->h2;
+  if ((int64_t)body_len <= h->conn_send_window &&
+      (int64_t)body_len <= h->peer_initial_window) {
+    h2_emit_data(c, stream, body, body_len);
+  } else {
+    // Spent window (connection, or a client that paused reads with a
+    // tiny INITIAL_WINDOW_SIZE): park until WINDOW_UPDATEs arrive.
+    h->pending.emplace_back(stream, std::string(body, body_len),
+                            h->peer_initial_window);
+  }
+}
+
+void h2_flush_pending(Server* s, Conn* c) {
+  H2State* h = c->h2;
+  while (!h->pending.empty()) {
+    auto& [stream, body, swin] = h->pending.front();
+    if ((int64_t)body.size() > h->conn_send_window ||
+        (int64_t)body.size() > swin)
+      break;
+    h2_emit_data(c, stream, body.data(), body.size());
+    h->pending.pop_front();
+  }
+}
+
+bool try_parse_one(Server* s, int slot);  // fwd (h1 parser)
+void serve_h2_request(Server* s, int slot, int32_t stream,
+                      const std::string& method, const std::string& target);
+
+// Decode one accumulated header block and dispatch the request. Returns
+// false on a connection-fatal HPACK error.
+bool h2_dispatch_headers(Server* s, int slot) {
+  Conn& c = s->conns[slot];
+  H2State* h = c.h2;
+  Nghttp2* ng = load_nghttp2();
+  std::string method, path;
+  void* inf = h->inflater;
+  const uint8_t* in = (const uint8_t*)h->hdr_block.data();
+  size_t left = h->hdr_block.size();
+  while (true) {
+    NgNV nv{};
+    int flags = 0;
+    ssize_t used = ng->inflate_hd2(inf, &nv, &flags, in, left, 1);
+    if (used < 0) return false;
+    in += used;
+    left -= (size_t)used;
+    if (flags & 0x02 /*EMIT*/) {
+      if (nv.namelen == 7 && memcmp(nv.name, ":method", 7) == 0)
+        method.assign((const char*)nv.value, nv.valuelen);
+      else if (nv.namelen == 5 && memcmp(nv.name, ":path", 5) == 0)
+        path.assign((const char*)nv.value, nv.valuelen);
+    }
+    if (flags & 0x01 /*FINAL*/) break;
+    if (used == 0 && !(flags & 0x02)) return false;  // stalled: malformed
+  }
+  ng->inflate_end_headers(inf);
+  int32_t stream = h->hdr_stream;
+  h->hdr_stream = 0;
+  h->hdr_block.clear();
+  serve_h2_request(s, slot, stream, method, path);
+  return true;
+}
+
+// Process buffered h2 frames on an h2-mode conn (mu held). Returns false
+// when the connection must close (protocol error / GOAWAY). Frames are
+// walked by offset and the buffer compacted ONCE per call — a per-frame
+// erase is quadratic over a pipelined client's event batch.
+bool h2_process(Server* s, int slot) {
+  Conn& c = s->conns[slot];
+  H2State* h = c.h2;
+  size_t pos = 0;
+  bool ok = true;
+  while (ok && c.rbuf.size() - pos >= 9) {
+    const uint8_t* p = (const uint8_t*)c.rbuf.data() + pos;
+    size_t len = ((size_t)p[0] << 16) | ((size_t)p[1] << 8) | p[2];
+    int type = p[3];
+    uint8_t flags = p[4];
+    int32_t stream =
+        (int32_t)((((uint32_t)p[5] & 0x7F) << 24) | ((uint32_t)p[6] << 16) |
+                  ((uint32_t)p[7] << 8) | p[8]);
+    if (len > (size_t)1 << 20) {  // absurd frame: kill conn
+      ok = false;
+      break;
+    }
+    if (c.rbuf.size() - pos < 9 + len) break;
+    const uint8_t* pl = p + 9;
+    // A CONTINUATION for an open header block must be exactly next.
+    if (h->hdr_stream != 0 &&
+        (type != kH2Continuation || stream != h->hdr_stream)) {
+      ok = false;
+      break;
+    }
+    switch (type) {
+      case kH2Settings: {
+        if (!(flags & kH2FlagAck)) {
+          for (size_t i = 0; i + 6 <= len; i += 6) {
+            uint16_t id = ((uint16_t)pl[i] << 8) | pl[i + 1];
+            uint32_t v = ((uint32_t)pl[i + 2] << 24) |
+                         ((uint32_t)pl[i + 3] << 16) |
+                         ((uint32_t)pl[i + 4] << 8) | pl[i + 5];
+            if (id == 0x4) {
+              // RFC 7540 §6.9.2: the delta applies to every open
+              // stream's window — ours are only the parked responses.
+              int64_t delta = (int64_t)v - h->peer_initial_window;
+              h->peer_initial_window = v;
+              for (auto& [st_, body_, swin] : h->pending) swin += delta;
+            }
+          }
+          h2_append_frame(c.wbuf, kH2Settings, kH2FlagAck, 0, "", 0);
+          h2_flush_pending(s, &c);
+        }
+        break;
+      }
+      case kH2Ping:
+        if (!(flags & kH2FlagAck) && len == 8)
+          h2_append_frame(c.wbuf, kH2Ping, kH2FlagAck, 0, (const char*)pl, 8);
+        break;
+      case kH2WindowUpdate:
+        if (len == 4) {
+          uint32_t incr = (((uint32_t)pl[0] & 0x7F) << 24) |
+                          ((uint32_t)pl[1] << 16) | ((uint32_t)pl[2] << 8) |
+                          pl[3];
+          if (stream == 0) {
+            h->conn_send_window += incr;
+          } else {
+            for (auto& [st_, body_, swin] : h->pending)
+              if (st_ == stream) swin += incr;
+          }
+          h2_flush_pending(s, &c);
+        }
+        break;
+      case kH2HeadersFrame: {
+        if (stream <= 0 || (stream & 1) == 0) {  // RFC 7540 §5.1.1
+          ok = false;
+          break;
+        }
+        size_t off = 0, tail = 0;
+        if (flags & kH2FlagPadded) {
+          if (len < 1) {
+            ok = false;
+            break;
+          }
+          tail = pl[0];
+          off = 1;
+        }
+        if (flags & kH2FlagPriority) off += 5;
+        if (off + tail > len || len - off - tail > kH2MaxHeaderBlock) {
+          ok = false;
+          break;
+        }
+        h->hdr_stream = stream;
+        h->hdr_block.assign((const char*)pl + off, len - off - tail);
+        if (flags & kH2FlagEndHeaders) ok = h2_dispatch_headers(s, slot);
+        break;
+      }
+      case kH2Continuation:
+        if (h->hdr_block.size() + len > kH2MaxHeaderBlock) {
+          ok = false;  // unbounded-CONTINUATION flood
+          break;
+        }
+        h->hdr_block.append((const char*)pl, len);
+        if (flags & kH2FlagEndHeaders) ok = h2_dispatch_headers(s, slot);
+        break;
+      case kH2Data: {
+        // API requests are bodyless; tolerate and drain small bodies,
+        // crediting the connection window back so clients never stall.
+        h->rx_data_unacked += len;
+        if (h->rx_data_unacked >= 32768) {
+          uint8_t w[4] = {
+              (uint8_t)((h->rx_data_unacked >> 24) & 0x7F),
+              (uint8_t)(h->rx_data_unacked >> 16),
+              (uint8_t)(h->rx_data_unacked >> 8),
+              (uint8_t)h->rx_data_unacked,
+          };
+          h2_append_frame(c.wbuf, kH2WindowUpdate, 0, 0, (const char*)w, 4);
+          h->rx_data_unacked = 0;
+        }
+        break;
+      }
+      case kH2Goaway:
+        ok = false;
+        break;
+      case kH2RstStream:
+      case kH2Priority:
+      default:
+        break;  // ignore (incl. unknown extension frames, RFC 7540 §4.1)
+    }
+    pos += 9 + len;
+  }
+  if (pos > 0) c.rbuf.erase(0, pos);
+  // Write-backlog bound: an unread client socket must not buffer replies
+  // without limit (PING floods, pipelined takes against a stalled
+  // reader) — the h1 path's bound is its one-in-flight gate; this is
+  // the h2 equivalent.
+  if (c.wbuf.size() - c.woff > kH2MaxWbuf) ok = false;
+  return ok;
 }
 
 // Turn an h2c client conn into a splice pair with a fresh backend conn
@@ -551,11 +931,168 @@ bool start_h2_proxy(Server* s, int slot) {
   return true;
 }
 
+// Shared /take query parsing (h1 + h2): first rate= and count= win
+// (parse_qs[0] semantics); malformed rate ⇒ zero Rate (429, api.go:61).
+void parse_take_query(const std::string& query, int64_t* freq,
+                      int64_t* per_ns, int64_t* count) {
+  *freq = *per_ns = *count = 0;
+  bool have_rate = false, have_count = false;
+  size_t qp = 0;
+  while (qp <= query.size() && query.size()) {
+    size_t amp = query.find('&', qp);
+    if (amp == std::string::npos) amp = query.size();
+    std::string kv = query.substr(qp, amp - qp);
+    qp = amp + 1;
+    size_t eq = kv.find('=');
+    std::string k = kv.substr(0, eq == std::string::npos ? kv.size() : eq);
+    std::string v =
+        eq == std::string::npos ? "" : pct_decode(kv.substr(eq + 1), true);
+    if (k == "rate" && !have_rate) {
+      have_rate = true;
+      if (!parse_rate(v, freq, per_ns)) *freq = *per_ns = 0;
+    } else if (k == "count" && !have_count) {
+      have_count = true;
+      size_t b = 0, e2 = v.size();
+      while (b < e2 && isspace((unsigned char)v[b])) b++;
+      while (e2 > b && isspace((unsigned char)v[e2 - 1])) e2--;
+      int64_t cv = 0;
+      if (parse_atoi(v.substr(b, e2 - b), &cv) && cv >= 0) *count = cv;
+    }
+    if (amp == query.size()) break;
+  }
+  if (*count == 0) *count = 1;  // api.go:63-65 (incl. bad/negative count)
+}
+
+// In-front host-store take attempt (h1 + h2). Returns true when served,
+// filling remaining/ok; false ⇒ the caller rides the Python ring.
+bool try_inline_take(Server* s, const std::string& name, int64_t freq,
+                     int64_t per_ns, int64_t count, int64_t* remaining,
+                     int* ok, bool* events_bumped) {
+  if (s->hls == nullptr || s->dir_h < 0) return false;
+  alignas(8) uint8_t padded[kNameMax] = {0};
+  memcpy(padded, name.data(), name.size());
+  const int64_t now = realtime_ns() + s->hls->clock_offset_ns;
+  std::lock_guard<std::mutex> hlk(s->hls->mu);
+  int32_t row = pt_dir_resolve_rt(s->dir_h, padded, (int32_t)name.size(),
+                                  s->hls->last_used, now);
+  if (row < 0) return false;
+  auto it = s->hls->blocks.find(row);
+  if (it == s->hls->blocks.end() ||
+      it->second[2 * s->hls->nodes + 4] == 0)
+    return false;
+  hls_take_locked(s->hls, it->second, row, freq, per_ns, count, now,
+                  remaining, ok, events_bumped);
+  return true;
+}
+
+// Dispatch one decoded h2 request (mu held): the same routing as the h1
+// parser — in-front take, else the Python rings — answered as h2 frames
+// on `stream`. No in_flight gate: h2 multiplexes streams per conn.
+void serve_h2_request(Server* s, int slot, int32_t stream,
+                      const std::string& method, const std::string& target) {
+  Conn& c = s->conns[slot];
+  s->requests++;
+  // No per-conn req_start stamp here: h2 multiplexes streams, so a
+  // single stamp would be overwritten by concurrent requests and
+  // corrupt the latency ring. In-front takes are timed inline below;
+  // ring-completed h2 requests go unsampled (h1 keeps sampling both).
+  auto t0 = std::chrono::steady_clock::now();
+  std::string path = target, query;
+  size_t qm = target.find('?');
+  if (qm != std::string::npos) {
+    path = target.substr(0, qm);
+    query = target.substr(qm + 1);
+  }
+  if (path.compare(0, 6, "/take/") == 0) {
+    if (method != "POST") {
+      queue_h2_response(s, &c, stream, 405, "text/plain",
+                        "method not allowed\n", 19);
+      return;
+    }
+    std::string name = pct_decode(path.substr(6), false);
+    if (name.size() > kNameLimit) {
+      char body[64];
+      int bl = snprintf(body, sizeof(body), "bucket name larger than %d",
+                        kNameLimit);
+      queue_h2_response(s, &c, stream, 400, "text/plain", body, bl);
+      return;
+    }
+    int64_t freq, per_ns, count;
+    parse_take_query(query, &freq, &per_ns, &count);
+    bool bumped = false;
+    int64_t remaining = 0;
+    int ok = 0;
+    if (try_inline_take(s, name, freq, per_ns, count, &remaining, &ok,
+                        &bumped)) {
+      s->hls_takes++;
+      char body[24];
+      int bl = snprintf(body, sizeof(body), "%lld", (long long)remaining);
+      queue_h2_response(s, &c, stream, ok ? 200 : 429, "text/plain", body,
+                        bl);
+      s->lat_ns[s->lat_count++ % Server::kLatRing] =
+          std::chrono::duration_cast<std::chrono::nanoseconds>(
+              std::chrono::steady_clock::now() - t0)
+              .count();
+      if (bumped) s->cv.notify_one();
+      return;
+    }
+    if ((int)s->take_q.size() >= kRingCap) {
+      s->dropped++;
+      queue_h2_response(s, &c, stream, 503, "text/plain", "overloaded\n",
+                        11);
+      return;
+    }
+    TakeRec r{};
+    r.tag = make_tag(slot, c.gen);
+    r.stream = stream;
+    r.freq = freq;
+    r.per_ns = per_ns;
+    r.count = count;
+    r.name_len = (int)name.size();
+    memcpy(r.name, name.data(), name.size());
+    s->take_q.push_back(r);
+    s->cv.notify_one();
+    return;
+  }
+  if (target.size() >= kPathMax || (int)s->other_q.size() >= 1024) {
+    queue_h2_response(s, &c, stream,
+                      target.size() >= kPathMax ? 431 : 503, "text/plain",
+                      "unavailable\n", 12);
+    return;
+  }
+  OtherRec o{};
+  o.tag = make_tag(slot, c.gen);
+  o.stream = stream;
+  snprintf(o.method, sizeof(o.method), "%.7s", method.c_str());
+  memcpy(o.target, target.data(), target.size());
+  o.target_len = (int)target.size();
+  s->other_q.push_back(o);
+  s->cv.notify_one();
+}
+
+// Activate native h2 on a preface-bearing conn: per-conn HPACK inflater
+// + the server's (empty) SETTINGS preface. mu held.
+bool start_h2_native(Server* s, int slot) {
+  Nghttp2* ng = load_nghttp2();
+  if (!ng) return false;
+  Conn& c = s->conns[slot];
+  H2State* h = new H2State();
+  if (ng->inflate_new(&h->inflater) != 0) {
+    delete h;
+    return false;
+  }
+  c.h2 = h;
+  c.in_flight = false;
+  c.req_start = {};
+  h2_append_frame(c.wbuf, kH2Settings, 0, 0, "", 0);
+  return true;
+}
+
 // Parse one request out of c->rbuf (mu held). Returns false when more
 // bytes are needed. May queue an immediate response or push ring records.
 bool try_parse_one(Server* s, int slot) {
   Conn& c = s->conns[slot];
-  if (c.in_flight || c.want_close) return false;
+  if (c.in_flight || c.want_close || c.h2 != nullptr || c.proxy) return false;
   if (c.body_skip > 0) {
     size_t n = c.rbuf.size() < c.body_skip ? c.rbuf.size() : c.body_skip;
     c.rbuf.erase(0, n);
@@ -573,9 +1110,12 @@ bool try_parse_one(Server* s, int slot) {
     constexpr size_t kPrefaceLen = sizeof(kPreface) - 1;
     if (c.rbuf.size() >= kPrefaceLen &&
         c.rbuf.compare(0, kPrefaceLen, kPreface) == 0) {
-      // h2c prior-knowledge client: splice the connection to the python
-      // front's h2 server (protocol parity, command.go:41-44); without a
-      // backend, reject cleanly.
+      // h2c prior-knowledge client. Preference order: serve h2 natively
+      // (libnghttp2 inflater available — wait for the full 24-byte
+      // preface, which contains \r\n\r\n and so reaches the PRI method
+      // branch below once ≥18 bytes arrive); else splice to the python
+      // h2 backend; else reject cleanly.
+      if (load_nghttp2() != nullptr) return false;  // accumulate
       if (start_h2_proxy(s, slot)) return false;
       c.close_after = true;
       queue_response(s, &c, 400, "text/plain", "h2c not supported here\n", 23);
@@ -605,8 +1145,23 @@ bool try_parse_one(Server* s, int slot) {
     // A complete h2 preface ("PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n") contains
     // \r\n\r\n, so it reaches the normal parse path rather than the
     // incomplete-header preface check above. NOTHING was consumed yet, so
-    // the proxy handoff forwards the raw buffer verbatim.
-    if (start_h2_proxy(s, slot)) return false;
+    // both handoffs see the raw buffer verbatim.
+    static const char kFullPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+    if (load_nghttp2() != nullptr) {
+      if (c.rbuf.size() < 24) return false;  // wait for the whole preface
+      if (c.rbuf.compare(0, 24, kFullPreface, 24) == 0 &&
+          start_h2_native(s, slot)) {
+        c.rbuf.erase(0, 24);
+        // Frames may already be buffered behind the preface.
+        if (!h2_process(s, slot)) {
+          close_conn(s, slot);
+          return false;
+        }
+        return false;  // h2 conns never re-enter the h1 parser
+      }
+      // Malformed preface tail: fall through to the h1 400 below.
+    }
+    if (c.h2 == nullptr && start_h2_proxy(s, slot)) return false;
     c.close_after = true;
     queue_response(s, &c, 400, "text/plain", "h2c not supported here\n", 23);
     c.rbuf.erase(0, consumed);
@@ -666,69 +1221,24 @@ bool try_parse_one(Server* s, int slot) {
       queue_response(s, &c, 400, "text/plain", body, bl);
       return true;
     }
-    // Query: first rate= and count= win (parse_qs[0] semantics).
-    int64_t freq = 0, per_ns = 0, count = 0;
-    bool have_rate = false, have_count = false;
-    size_t qp = 0;
-    while (qp <= query.size() && query.size()) {
-      size_t amp = query.find('&', qp);
-      if (amp == std::string::npos) amp = query.size();
-      std::string kv = query.substr(qp, amp - qp);
-      qp = amp + 1;
-      size_t eq = kv.find('=');
-      std::string k = kv.substr(0, eq == std::string::npos ? kv.size() : eq);
-      std::string v = eq == std::string::npos ? "" : pct_decode(kv.substr(eq + 1), true);
-      if (k == "rate" && !have_rate) {
-        have_rate = true;
-        if (!parse_rate(v, &freq, &per_ns)) freq = per_ns = 0;  // zero Rate
-      } else if (k == "count" && !have_count) {
-        have_count = true;
-        // int(v): Python strips ASCII whitespace; sign + digits.
-        size_t b = 0, e2 = v.size();
-        while (b < e2 && isspace((unsigned char)v[b])) b++;
-        while (e2 > b && isspace((unsigned char)v[e2 - 1])) e2--;
-        int64_t cv = 0;
-        if (parse_atoi(v.substr(b, e2 - b), &cv) && cv >= 0) count = cv;
-      }
-      if (amp == query.size()) break;
-    }
-    if (count == 0) count = 1;  // api.go:63-65 (incl. bad/negative count)
+    int64_t freq, per_ns, count;
+    parse_take_query(query, &freq, &per_ns, &count);
 
     // In-front fast path: a host-resident bucket's whole take decision —
     // resolve, lane arithmetic, response — runs here on the epoll thread,
-    // the reference's in-process shape (api.go:51-86). Misses (unknown
-    // names, device-resident rows) fall through to the Python ring, which
-    // binds/hosts/promotes exactly as before.
-    if (s->hls != nullptr && s->dir_h >= 0) {
-      alignas(8) uint8_t padded[kNameMax] = {0};
-      memcpy(padded, name.data(), name.size());
-      const int64_t now =
-          realtime_ns() + s->hls->clock_offset_ns;
-      bool served = false, bumped = false;
+    // the reference's in-process shape (api.go:51-86). The resolve runs
+    // INSIDE the store's critical section: re-hosting a recycled row
+    // requires the same mutex (_host_mu IS this lock), so the pair can
+    // never be interleaved by evict→rebind→rehost and charge the wrong
+    // bucket; the nested tab_mu(shared) is cycle-free. Misses (unknown
+    // names, device-resident rows) fall through to the Python ring,
+    // which binds/hosts/promotes exactly as before.
+    {
+      bool bumped = false;
       int64_t remaining = 0;
       int ok = 0;
-      {
-        // Resolve INSIDE the store's critical section: re-hosting a
-        // recycled row requires this same mutex (_host_mu IS this lock),
-        // so a resolve→take pair under it can never be interleaved by
-        // evict→rebind→rehost and charge the wrong bucket. The nested
-        // tab_mu(shared) inside hls->mu is cycle-free — no thread takes
-        // hls->mu while holding the directory's table lock.
-        std::lock_guard<std::mutex> hlk(s->hls->mu);
-        int32_t row = pt_dir_resolve_rt(s->dir_h, padded,
-                                        (int32_t)name.size(),
-                                        s->hls->last_used, now);
-        if (row >= 0) {
-          auto it = s->hls->blocks.find(row);
-          if (it != s->hls->blocks.end() &&
-              it->second[2 * s->hls->nodes + 4] != 0) {  // resident
-            hls_take_locked(s->hls, it->second, row, freq, per_ns, count,
-                            now, &remaining, &ok, &bumped);
-            served = true;
-          }
-        }
-      }
-      if (served) {
+      if (try_inline_take(s, name, freq, per_ns, count, &remaining, &ok,
+                          &bumped)) {
         s->hls_takes++;
         char body[24];
         int bl = snprintf(body, sizeof(body), "%lld", (long long)remaining);
@@ -876,7 +1386,10 @@ void serve_loop(Server* s) {
             // Hostile-flood cap: h1 conns only. A splice conn's rbuf is
             // a transit buffer cleared every event (large h2 bodies are
             // legitimate); its backpressure is the peer-wbuf cap below.
-            if (!c.proxy && c.rbuf.size() > (size_t)kRbufMax * 4) {
+            // Native-h2 conns drain frame-by-frame per event with a 1 MB
+            // frame sanity bound of their own.
+            if (!c.proxy && c.h2 == nullptr &&
+                c.rbuf.size() > (size_t)kRbufMax * 4) {
               closed = true;
               break;
             }
@@ -928,6 +1441,17 @@ void serve_loop(Server* s) {
             close_conn(s, slot);
             continue;
           }
+          continue;
+        }
+        if (c.h2 != nullptr) {
+          // Native h2: frame processing replaces the h1 parser entirely.
+          if (!h2_process(s, slot)) {
+            close_conn(s, slot);
+            continue;
+          }
+          Conn& ch = s->conns[slot];
+          if (ch.fd >= 0 && ch.wbuf.size() > ch.woff) flush_writes(s, slot);
+          if (closed && s->conns[slot].fd >= 0) close_conn(s, slot);
           continue;
         }
         if (closed && c.rbuf.empty()) {
@@ -1022,9 +1546,11 @@ int pt_http_set_h2_backend(int h, uint16_t port) {
 // empty (GIL released by ctypes). Fills up to cap_t takes and cap_o
 // others; *n_other receives the other-count; returns the take-count.
 int pt_http_poll(int h, int timeout_ms,
-                 uint64_t* tags, uint8_t* names, int* name_lens,
+                 uint64_t* tags, int32_t* streams, uint8_t* names,
+                 int* name_lens,
                  int64_t* freqs, int64_t* pers, int64_t* counts, int cap_t,
-                 uint64_t* otags, uint8_t* otargets, int* otarget_lens,
+                 uint64_t* otags, int32_t* ostreams, uint8_t* otargets,
+                 int* otarget_lens,
                  uint8_t* omethods, int cap_o, int* n_other) {
   Server* s = g_servers[h];
   if (!s) return -EBADF;
@@ -1043,6 +1569,7 @@ int pt_http_poll(int h, int timeout_ms,
   while (nt < cap_t && !s->take_q.empty()) {
     TakeRec& r = s->take_q.front();
     tags[nt] = r.tag;
+    streams[nt] = r.stream;
     memset(names + nt * kNameMax, 0, kNameMax);
     memcpy(names + nt * kNameMax, r.name, r.name_len);
     name_lens[nt] = r.name_len;
@@ -1056,6 +1583,7 @@ int pt_http_poll(int h, int timeout_ms,
   while (no < cap_o && !s->other_q.empty()) {
     OtherRec& o = s->other_q.front();
     otags[no] = o.tag;
+    ostreams[no] = o.stream;
     memcpy(otargets + no * kPathMax, o.target, o.target_len);
     otarget_lens[no] = o.target_len;
     memset(omethods + no * 8, 0, 8);
@@ -1068,7 +1596,9 @@ int pt_http_poll(int h, int timeout_ms,
 }
 
 // Complete a batch of takes: status 200/429 + remaining-tokens body.
-int pt_http_complete_takes(int h, const uint64_t* tags, const int* statuses,
+// streams[i] > 0 answers on that h2 stream; 0 = HTTP/1.1.
+int pt_http_complete_takes(int h, const uint64_t* tags,
+                           const int32_t* streams, const int* statuses,
                            const int64_t* remaining, int n) {
   std::lock_guard<std::mutex> reg(g_reg_mu);
   Server* s = g_servers[h];
@@ -1083,7 +1613,11 @@ int pt_http_complete_takes(int h, const uint64_t* tags, const int* statuses,
       if (c.fd < 0 || c.gen != gen) continue;  // conn died mid-flight
       char body[24];
       int bl = snprintf(body, sizeof(body), "%lld", (long long)remaining[i]);
-      queue_response(s, &c, statuses[i], "text/plain", body, bl);
+      if (streams[i] > 0 && c.h2 != nullptr)
+        queue_h2_response(s, &c, streams[i], statuses[i], "text/plain",
+                          body, bl);
+      else
+        queue_response(s, &c, statuses[i], "text/plain", body, bl);
     }
   }
   uint64_t one = 1;
@@ -1093,8 +1627,9 @@ int pt_http_complete_takes(int h, const uint64_t* tags, const int* statuses,
 }
 
 // Complete one slow-path request with an arbitrary body.
-int pt_http_complete_other(int h, uint64_t tag, int status, const char* ctype,
-                           const uint8_t* body, int body_len) {
+int pt_http_complete_other(int h, uint64_t tag, int32_t stream, int status,
+                           const char* ctype, const uint8_t* body,
+                           int body_len) {
   std::lock_guard<std::mutex> reg(g_reg_mu);
   Server* s = g_servers[h];
   if (!s) return -EBADF;
@@ -1104,8 +1639,13 @@ int pt_http_complete_other(int h, uint64_t tag, int status, const char* ctype,
     uint32_t gen = (uint32_t)tag;
     if (slot < (int)s->conns.size()) {
       Conn& c = s->conns[slot];
-      if (c.fd >= 0 && c.gen == gen)
-        queue_response(s, &c, status, ctype, (const char*)body, body_len);
+      if (c.fd >= 0 && c.gen == gen) {
+        if (stream > 0 && c.h2 != nullptr)
+          queue_h2_response(s, &c, stream, status, ctype,
+                            (const char*)body, body_len);
+        else
+          queue_response(s, &c, status, ctype, (const char*)body, body_len);
+      }
     }
   }
   uint64_t one = 1;
@@ -1475,6 +2015,169 @@ int pt_hls_take_probe(int hls_h, int dir_h, const uint8_t* name, int len,
   hls_take_locked(st, it->second, row, freq, per_ns, count, now, remaining,
                   &ok, &bumped);
   return ok;
+}
+
+// h2 prior-knowledge closed-loop load client: `conns` connections, each
+// keeping `pipeline` streams in flight. The request HEADERS block uses
+// HPACK literals-without-indexing only (stateless, always valid), so no
+// deflater is needed; responses are counted by END_STREAM DATA frames
+// and the :status literal is peeked from our server's known block shape.
+// out5 = {requests_completed, p50_ns, p99_ns, ok_200, limited_429}.
+int pt_http_blast_h2(const char* ip, uint16_t port, const char* target,
+                     int conns, int pipeline, int duration_ms,
+                     uint64_t* out5) {
+  std::vector<std::string> head_frames;  // per-target HEADERS payloads
+  {
+    const char* t = target;
+    while (*t) {
+      const char* e = strchr(t, '\n');
+      size_t len = e ? (size_t)(e - t) : strlen(t);
+      if (len) {
+        std::string block;
+        hpack_literal(block, ":method", 7, "POST", 4);
+        hpack_literal(block, ":scheme", 7, "http", 4);
+        hpack_literal(block, ":authority", 10, "x", 1);
+        hpack_literal(block, ":path", 5, t, len);
+        head_frames.push_back(block);
+      }
+      t += len + (e ? 1 : 0);
+    }
+  }
+  if (head_frames.empty()) return -EINVAL;
+  size_t rr = 0;
+  struct HC {
+    int fd = -1;
+    std::string rbuf, wpend;
+    size_t woff = 0;
+    int inflight = 0;
+    int32_t next_stream = 1;
+    uint64_t rx_data = 0;
+    std::deque<std::chrono::steady_clock::time_point> sent;
+  };
+  std::vector<HC> cs(conns);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, ip, &addr.sin_addr) != 1) return -EINVAL;
+  int ep = epoll_create1(0);
+  for (int i = 0; i < conns; i++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) < 0) {
+      ::close(fd);
+      ::close(ep);
+      return -errno;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    set_nonblock(fd);
+    cs[i].fd = fd;
+    cs[i].wpend.assign("PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n");
+    h2_append_frame(cs[i].wpend, kH2Settings, 0, 0, "", 0);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u32 = i;
+    epoll_ctl(ep, EPOLL_CTL_ADD, fd, &ev);
+  }
+  auto now = [] { return std::chrono::steady_clock::now(); };
+  auto t_end = now() + std::chrono::milliseconds(duration_ms);
+  std::vector<uint64_t> lats;
+  lats.reserve(1 << 20);
+  uint64_t done = 0, ok200 = 0, lim429 = 0;
+
+  auto pump_conn = [&](HC& c) {
+    while (c.inflight < pipeline) {
+      const std::string& block = head_frames[rr++ % head_frames.size()];
+      h2_append_frame(c.wpend, kH2HeadersFrame,
+                      kH2FlagEndHeaders | kH2FlagEndStream, c.next_stream,
+                      block.data(), block.size());
+      c.next_stream += 2;
+      c.inflight++;
+      c.sent.push_back(now());
+    }
+    while (c.woff < c.wpend.size()) {
+      ssize_t wr = ::send(c.fd, c.wpend.data() + c.woff,
+                          c.wpend.size() - c.woff, MSG_NOSIGNAL);
+      if (wr <= 0) break;
+      c.woff += (size_t)wr;
+    }
+    if (c.woff >= c.wpend.size()) {
+      c.wpend.clear();
+      c.woff = 0;
+    }
+  };
+  for (auto& c : cs) pump_conn(c);
+
+  epoll_event evs[64];
+  char buf[65536];
+  while (now() < t_end) {
+    int n = epoll_wait(ep, evs, 64, 50);
+    for (int i = 0; i < n; i++) {
+      HC& c = cs[evs[i].data.u32];
+      while (true) {
+        ssize_t rd = recv(c.fd, buf, sizeof(buf), 0);
+        if (rd <= 0) break;
+        c.rbuf.append(buf, rd);
+      }
+      size_t rpos = 0;
+      while (c.rbuf.size() - rpos >= 9) {
+        const uint8_t* p = (const uint8_t*)c.rbuf.data() + rpos;
+        size_t len = ((size_t)p[0] << 16) | ((size_t)p[1] << 8) | p[2];
+        if (c.rbuf.size() - rpos < 9 + len) break;
+        int type = p[3];
+        uint8_t flags = p[4];
+        const uint8_t* pl = p + 9;
+        if (type == kH2Settings && !(flags & kH2FlagAck)) {
+          h2_append_frame(c.wpend, kH2Settings, kH2FlagAck, 0, "", 0);
+        } else if (type == kH2HeadersFrame && len > 10 && pl[0] == 0 &&
+                   pl[1] == 7) {
+          // Our server's block: literal :status first; peek the value.
+          const uint8_t* v = pl + 2 + 7 + 1;  // 0x00, len, ":status", vlen
+          if (pl[9] >= 3 && v[0] == '2') ok200++;
+          else if (pl[9] >= 3 && v[0] == '4') lim429++;
+        } else if (type == kH2Data) {
+          c.rx_data += len;
+          if (flags & kH2FlagEndStream) {
+            c.inflight--;
+            done++;
+            if (!c.sent.empty()) {
+              lats.push_back(
+                  std::chrono::duration_cast<std::chrono::nanoseconds>(
+                      now() - c.sent.front())
+                      .count());
+              c.sent.pop_front();
+            }
+          }
+          if (c.rx_data >= 16384) {
+            uint8_t w[4] = {(uint8_t)((c.rx_data >> 24) & 0x7F),
+                            (uint8_t)(c.rx_data >> 16),
+                            (uint8_t)(c.rx_data >> 8), (uint8_t)c.rx_data};
+            h2_append_frame(c.wpend, kH2WindowUpdate, 0, 0, (const char*)w,
+                            4);
+            c.rx_data = 0;
+          }
+        } else if (type == kH2Goaway) {
+          rpos = c.rbuf.size();
+          break;
+        }
+        rpos += 9 + len;
+      }
+      if (rpos > 0) c.rbuf.erase(0, rpos);
+      pump_conn(c);
+    }
+  }
+  for (auto& c : cs) ::close(c.fd);
+  ::close(ep);
+  out5[0] = done;
+  if (!lats.empty()) {
+    std::sort(lats.begin(), lats.end());
+    out5[1] = lats[lats.size() / 2];
+    out5[2] = lats[(size_t)(lats.size() * 0.99)];
+  } else {
+    out5[1] = out5[2] = 0;
+  }
+  out5[3] = ok200;
+  out5[4] = lim429;
+  return 0;
 }
 
 // Exposed for differential tests against ops/rate.py.
