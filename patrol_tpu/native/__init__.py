@@ -161,7 +161,7 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pt_hls_unhost_locked.argtypes = [ctypes.c_int, ctypes.c_int32]
         lib.pt_hls_unhost_locked.restype = ctypes.c_int
         lib.pt_hls_drain_locked.argtypes = [
-            ctypes.c_int, _i32p, ctypes.c_int, _i32p, ctypes.c_int,
+            ctypes.c_int, _i32p, _i64p, ctypes.c_int, _i32p, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int),
         ]
         lib.pt_hls_drain_locked.restype = ctypes.c_int

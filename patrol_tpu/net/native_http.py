@@ -28,6 +28,7 @@ import asyncio
 import ctypes
 import logging
 import threading
+import time
 
 import numpy as np
 
@@ -130,6 +131,7 @@ class NativeHTTPFront:
         # bound broadcast delay instead (≤5 ms to peers; replication is
         # eventual by design). Promotions still wake the poll predicate.
         poll_ms = 5 if getattr(self._engine, "_native_store", None) else 50
+        next_drain = 0.0
         while not self._stopped.is_set():
             nt = self.lib.pt_http_poll(
                 self.h, poll_ms,
@@ -157,11 +159,20 @@ class NativeHTTPFront:
                 self._dispatch_other(j)
             if self._engine is not None:
                 drain = getattr(self._engine, "drain_native_broadcasts", None)
-                if drain is not None:
+                now = time.monotonic()
+                if drain is not None and now >= next_drain:
                     try:
                         drain()
                     except Exception:  # pragma: no cover
                         log.exception("native broadcast drain failed")
+                    # Adaptive cadence: broadcast building must never own
+                    # the core the epoll thread serves from — a drain that
+                    # burned T of CPU doesn't rerun for 4T (≥ the poll
+                    # tick). Coalescing makes the longer interval lossless
+                    # (latest state subsumes); convergence lag stays
+                    # bounded at ~4× the per-drain cost.
+                    next_drain = time.monotonic()
+                    next_drain += max(poll_ms / 1000.0, 4 * (next_drain - now))
         self._cq.put(None)  # unblock the completer at shutdown
 
     def _submit_takes(self, repo, nt: int) -> None:

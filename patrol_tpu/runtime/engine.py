@@ -1297,47 +1297,60 @@ class DeviceEngine:
         st = self._native_store
         if st is None:
             return
-        while True:
-            # Snapshot per-row INTEGERS under the lock; build wire states
-            # outside it — _host_mu is the very mutex the epoll thread's
-            # in-front takes block on, so Python-level wire construction
-            # under it would stall the whole HTTP front (the Python fast
-            # path broadcasts after releasing _host_mu for the same
-            # reason). Loop until both queues drain: the C++ side pops at
-            # most a buffer's worth per call and re-queues the rest.
-            snap: List[Tuple[str, int, int, int, int, int, int]] = []
+        if self.on_broadcast is None:
+            # Standalone node: drain the queues (promotion marks still
+            # matter; dirty flags must clear) without building states.
             with self._host_mu:
-                dirty, promotes = st.drain_locked()
+                while True:
+                    dirty, _snap, promotes = st.drain_locked()
+                    for row in promotes:
+                        if row in self._hosted:
+                            self._promote_locked(row)
+                    if not dirty and not promotes:
+                        return
+        n = self.config.nodes
+        while True:
+            # The lock-held work is MINIMAL: the C++ drain copies each
+            # dirty row's lanes into a flat buffer inside the call, and
+            # Python only captures (membership, name, cap) per row —
+            # _host_mu is the very mutex the epoll thread's in-front
+            # takes block on, and a per-row Python pass under it (the
+            # first r5 shape) held it for ~ms per drain at ~1k dirty
+            # rows, surfacing as the front's p99 tail. Wire construction
+            # runs OUTSIDE against the copies. Loop until both queues
+            # drain: the C++ side pops a buffer's worth per call.
+            meta: List[Tuple[int, str, int]] = []  # (snap idx, name, cap)
+            with self._host_mu:
+                dirty, lanes_snap, promotes = st.drain_locked()
                 for row in promotes:
                     if row in self._hosted:
                         self._promote_locked(row)
-                for row in dirty:
-                    lanes = self._hosted.get(row)
-                    if lanes is None:
+                for i, row in enumerate(dirty):
+                    if not self._hosted_flag[row]:
                         continue  # promoted/evicted since marked: its
                         # state rides the device completion broadcasts
-                    cap = int(self.directory.cap_base_nt[row])
-                    own_a = int(lanes.added[self.node_slot])
-                    own_t = int(lanes.taken[self.node_slot])
-                    elapsed = lanes.elapsed_ns
-                    if not (own_a or own_t or elapsed or cap):
-                        continue  # zero state is the incast marker
                     name = self.directory.name_of(row)
                     if name is None:
                         continue
-                    snap.append((
-                        name, cap, own_a, own_t, elapsed,
-                        int(lanes.added.sum()), int(lanes.taken.sum()),
-                    ))
-            if snap:
-                self._emit_broadcasts([
+                    meta.append((i, name, int(self.directory.cap_base_nt[row])))
+            states: List[wire.WireState] = []
+            for i, name, cap in meta:
+                row_snap = lanes_snap[i]
+                own_a = int(row_snap[self.node_slot])
+                own_t = int(row_snap[n + self.node_slot])
+                elapsed = int(row_snap[2 * n])
+                if not (own_a or own_t or elapsed or cap):
+                    continue  # zero state is the incast marker
+                states.append(
                     wire.from_nanotokens(
-                        name, cap + sum_a, sum_t, elapsed,
+                        name, cap + int(row_snap[:n].sum()),
+                        int(row_snap[n : 2 * n].sum()), elapsed,
                         origin_slot=self.node_slot, cap_nt=cap,
                         lane_added_nt=own_a, lane_taken_nt=own_t,
                     )
-                    for name, cap, own_a, own_t, elapsed, sum_a, sum_t in snap
-                ])
+                )
+            if states:
+                self._emit_broadcasts(states)
             if not dirty and not promotes:
                 return
 

@@ -141,6 +141,8 @@ class NativeHostStore:
         self.nodes = nodes
         self.directory = directory
         self._dirty = np.zeros(4096, np.int32)
+        # Per-dirty-row C++ lane snapshot: added[nodes]|taken[nodes]|elapsed.
+        self._snap = np.zeros((4096, 2 * nodes + 1), np.int64)
         self._promote = np.zeros(1024, np.int32)
         self._np = ctypes.c_int(0)
         self._closed = False
@@ -184,16 +186,21 @@ class NativeHostStore:
     def unhost_locked(self, row: int) -> None:
         self.lib.pt_hls_unhost_locked(self.h, row)
 
-    def drain_locked(self) -> Tuple[List[int], List[int]]:
-        """→ (dirty_rows, promote_rows); clears both queues."""
+    def drain_locked(self) -> Tuple[List[int], np.ndarray, List[int]]:
+        """→ (dirty_rows, lane_snapshots[nd, 2*nodes+1], promote_rows);
+        clears both queues. The snapshots are taken in C++ under the held
+        lock — the caller does its per-row work (wire building) OUTSIDE
+        the lock against the copies."""
         nd = self.lib.pt_hls_drain_locked(
-            self.h, self._dirty, len(self._dirty),
+            self.h, self._dirty, self._snap, len(self._dirty),
             self._promote, len(self._promote), ctypes.byref(self._np),
         )
         if nd <= 0 and self._np.value <= 0:
-            return [], []
+            return [], self._snap[:0], []
+        nd = max(nd, 0)
         return (
-            self._dirty[:max(nd, 0)].tolist(),
+            self._dirty[:nd].tolist(),
+            self._snap[:nd],
             self._promote[: self._np.value].tolist(),
         )
 

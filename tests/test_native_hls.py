@@ -243,8 +243,12 @@ class TestInFrontEndToEnd:
         keep-alive session."""
         eng, front = stack
         # Real clock here: pin the promotion window open so the slow
-        # python-loop takes still cross the threshold.
+        # python-loop takes still cross the threshold — and the demote
+        # window too, or an idle gap between flush and the HTTP request
+        # legitimately demotes "ringy" back and the device-residency
+        # assertion races the feature it shares a clock with.
         monkeypatch.setattr(engine_mod, "HOST_PROMOTE_WINDOW_NS", 10**15)
+        monkeypatch.setattr(engine_mod, "HOST_DEMOTE_WINDOW_NS", 10**15)
         n = engine_mod.HOST_PROMOTE_TAKES + 5
         for _ in range(n):
             eng.take("ringy", Rate(freq=4 * n, per_ns=NANO), 1)
