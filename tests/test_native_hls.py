@@ -237,6 +237,82 @@ class TestInFrontEndToEnd:
         assert final[-1].taken_nt == 4 * NANO
         assert final[-1].cap_nt == 100 * NANO
 
+    def test_api_behavior_table_over_native_h2(self, stack):
+        """The reference's api_test.go behavior table, spoken over NATIVE
+        h2 (prior-knowledge): name-too-long → 400, missing rate → 429
+        body "0", default count, success bodies, zero rate → 429, and a
+        non-POST → 405 — same statuses and bodies as h1, decoded from
+        the C++ front's own HPACK-literal responses."""
+        import socket as sk
+
+        from patrol_tpu.net import h2 as h2mod
+
+        if not h2mod.available():
+            pytest.skip("libnghttp2 unavailable")
+        eng, front = stack
+
+        def req_headers(method: str, path: str) -> bytes:
+            return (
+                h2mod._encode_literal(b":method", method.encode())
+                + h2mod._encode_literal(b":scheme", b"http")
+                + h2mod._encode_literal(b":authority", b"x")
+                + h2mod._encode_literal(b":path", path.encode())
+            )
+
+        def drive(requests):
+            """One h2 connection; → [(status, body)] per request."""
+            dec = h2mod.HpackDecoder()
+            s = sk.create_connection(("127.0.0.1", front.port), timeout=5)
+            try:
+                s.sendall(h2mod.PREFACE + h2mod.frame(h2mod.SETTINGS, 0, 0, b""))
+                stream = 1
+                for method, path in requests:
+                    s.sendall(h2mod.frame(
+                        h2mod.HEADERS,
+                        h2mod.FLAG_END_HEADERS | h2mod.FLAG_END_STREAM,
+                        stream, req_headers(method, path),
+                    ))
+                    stream += 2
+                out = {}
+                status_of = {}
+                buf = b""
+                while len(out) < len(requests):
+                    chunk = s.recv(65536)
+                    assert chunk, f"closed with {len(out)} responses"
+                    buf += chunk
+                    while len(buf) >= 9:
+                        ln = (buf[0] << 16) | (buf[1] << 8) | buf[2]
+                        if len(buf) < 9 + ln:
+                            break
+                        ftype, flags = buf[3], buf[4]
+                        sid = int.from_bytes(buf[5:9], "big") & 0x7FFFFFFF
+                        payload = buf[9 : 9 + ln]
+                        if ftype == h2mod.SETTINGS and not (flags & 1):
+                            s.sendall(h2mod.frame(h2mod.SETTINGS, 1, 0, b""))
+                        elif ftype == h2mod.HEADERS:
+                            hdrs = dict(dec.decode(payload))
+                            status_of[sid] = int(hdrs[b":status"])
+                        elif ftype == h2mod.DATA:
+                            if flags & h2mod.FLAG_END_STREAM:
+                                out[sid] = (status_of[sid], payload)
+                        buf = buf[9 + ln :]
+                return [out[1 + 2 * i] for i in range(len(requests))]
+            finally:
+                s.close()
+
+        results = drive([
+            ("POST", "/take/" + "x" * 240),          # 400 name too long
+            ("POST", "/take/h2tbl-norate"),          # 429 body "0"
+            ("POST", "/take/h2tbl-a?rate=2:1h"),     # 200 "1" (count=1)
+            ("POST", "/take/h2tbl-a?rate=2:1h"),     # 200 "0"
+            ("POST", "/take/h2tbl-a?rate=2:1h"),     # 429 "0"
+            ("POST", "/take/h2tbl-z?rate=0:1s"),     # 429 zero rate
+            ("GET", "/take/h2tbl-g?rate=5:1s"),      # 405
+        ])
+        assert [r[0] for r in results] == [400, 429, 200, 200, 429, 429, 405]
+        assert results[1][1] == b"0"
+        assert [r[1] for r in results[2:5]] == [b"1", b"0", b"0"]
+
     def test_mixed_residency_fallthrough(self, stack, monkeypatch):
         """Device-resident buckets keep riding the ring; host-resident
         ones are in-front; behavior stays correct for both in one
