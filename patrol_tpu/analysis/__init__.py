@@ -1,0 +1,12 @@
+"""Static-analysis checks for repo-specific invariants (patrol-check).
+
+The reference's implicit correctness contract is ``go test -race`` plus
+Go's memory safety; this package is the rebuild's equivalent for the
+*Python* layers — invariants that type checkers and generic linters
+cannot see (clock seams, jit-reachability sync discipline, lock order,
+nanotoken dtype discipline) encoded as AST checks over the sources.
+
+Entry points: :func:`patrol_tpu.analysis.lint.lint_repo` (used by
+``scripts/lint_repo.py`` and the ``pytest -m lint`` suite) and
+:func:`patrol_tpu.analysis.lint.lint_sources` (fixture-driven self-tests).
+"""

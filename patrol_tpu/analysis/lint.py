@@ -1,0 +1,589 @@
+"""patrol-check AST lint: repo-specific invariants as checks over the
+Python sources.
+
+Four checks, each encoding a discipline the runtime depends on but no
+generic tool can express:
+
+* **PTL001 wall-clock** — the limiter is driven by an *injected* clock
+  (``runtime/bucket.py::system_clock`` is the one seam; the engine maps
+  it onto CLOCK_REALTIME once, at store init). A stray ``time.time()``
+  or argless ``datetime.now()`` anywhere else silently forks the clock
+  domain: takes and merges would disagree about "now" and the refill
+  arithmetic loses its monotonic-time guard. Observability-only wall
+  clocks (uptime metrics, log timestamps) carry an inline
+  ``# patrol-lint: clock-seam`` declaration.
+
+* **PTL002 sync-in-jit** — functions reachable from the jitted
+  take/merge kernels must stay trace-pure: a host-device sync primitive
+  (``.item()``, ``np.asarray``, ``block_until_ready``) inside them
+  either breaks tracing outright or, worse, silently forces a blocking
+  transfer on every engine tick. The check builds a call graph from
+  every ``jax.jit``/``partial(jax.jit, ...)`` root and walks it.
+
+* **PTL003 lock-order** — the engine's documented order is ``_host_mu``
+  (outer) before ``_state_mu`` (inner); the epoll thread blocks on
+  ``_host_mu`` (it IS the native store mutex), so the reverse nesting
+  deadlocks the native front against the feeder. Re-acquiring a held
+  lock is flagged too (``threading.Lock`` is not reentrant).
+
+* **PTL004 dtype-discipline** — ``ops/wire.py`` / ``ops/merge.py`` state
+  math stays in the declared u32/u64/i64 nanotoken dtypes. Float
+  literals, true division, float dtypes, and dtype-less array
+  constructors (whose defaults float-promote under x64 mode changes)
+  are flagged outside the declared codec-boundary functions — the wire
+  format itself is float64 tokens, and those conversions live ONLY in
+  the boundary set below.
+
+Suppressions (documented in README.md) are inline comments:
+
+    x = time.time()  # patrol-lint: clock-seam (uptime metric)
+    y = a / b        # patrol-lint: wire-f64 (wire tokens are float64)
+    z = risky()      # patrol-lint: disable=PTL001,PTL004
+
+``clock-seam`` suppresses PTL001 only; ``wire-f64`` suppresses PTL004
+only; ``disable=`` names codes explicitly. Every suppression is a
+*declaration* — greppable, reviewed like code.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Declared invariant configuration (the checks' allowlists live HERE, in
+# code review's line of sight, not scattered through the tree).
+
+# PTL001: functions allowed to read the wall clock without an inline
+# declaration — the clock seams themselves.
+CLOCK_SEAMS: Dict[str, Set[str]] = {
+    # The injected-clock default (≙ main.go:35-37 offset clocks).
+    "patrol_tpu/runtime/bucket.py": {"system_clock"},
+    # One-time injected-clock → CLOCK_REALTIME offset for the C++ store.
+    "patrol_tpu/runtime/engine.py": {"DeviceEngine.__init__"},
+}
+
+# PTL004: scope and declared float-boundary functions (the wire format is
+# float64 tokens; the conversion in/out of nanotokens lives only here).
+DTYPE_FILES: Set[str] = {"patrol_tpu/ops/wire.py", "patrol_tpu/ops/merge.py"}
+DTYPE_BOUNDARIES: Dict[str, Set[str]] = {
+    "patrol_tpu/ops/wire.py": {
+        "_sanitize_nt",
+        "sanitize_nt_array",
+        "from_nanotokens",
+    },
+}
+
+# PTL003: lock rank — outer locks first. Acquiring a lock while holding
+# one of strictly lower rank (later in this list) is a violation.
+LOCK_ORDER: List[str] = ["_host_mu", "_state_mu"]
+
+FLOAT_DTYPES = {"float64", "float32", "float16", "bfloat16", "double"}
+# Constructor → positional index of its dtype parameter (None: kwarg only).
+DTYPE_CTORS: Dict[str, Optional[int]] = {
+    "array": 1,
+    "asarray": 1,
+    "ascontiguousarray": 1,
+    "zeros": 1,
+    "ones": 1,
+    "empty": 1,
+    "full": 2,
+    "arange": None,
+}
+SYNC_ATTRS = {"item", "block_until_ready"}
+SYNC_NP_FUNCS = {"asarray", "array", "ascontiguousarray"}
+SYNC_JAX_FUNCS = {"block_until_ready", "device_get"}
+
+_DIRECTIVE_RE = re.compile(r"#\s*patrol-lint:\s*([A-Za-z0-9=,_\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    check: str
+    path: str  # repo-relative, "/"-separated
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.check} {self.message}"
+
+
+class Module:
+    """One parsed source file plus its suppression table."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source, filename=self.relpath)
+        # line → directive tokens ("clock-seam", "wire-f64", "PTL001", ...)
+        self.directives: Dict[int, Set[str]] = {}
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            m = _DIRECTIVE_RE.search(line)
+            if not m:
+                continue
+            toks: Set[str] = set()
+            for raw in re.split(r"[,\s]+", m.group(1).strip()):
+                if not raw:
+                    continue
+                if raw.startswith("disable="):
+                    toks.update(t for t in raw[8:].split(",") if t)
+                else:
+                    toks.add(raw)
+            self.directives[lineno] = toks
+
+    def suppressed(self, check: str, line: int, marker: Optional[str] = None) -> bool:
+        toks = self.directives.get(line, ())
+        return check in toks or (marker is not None and marker in toks)
+
+
+def _time_aliases(tree: ast.AST) -> Tuple[Set[str], Set[str], Set[str]]:
+    """→ (aliases of module ``time``, names bound to time.time/time_ns,
+    names bound to the ``datetime`` class or module)."""
+    mods: Set[str] = set()
+    funcs: Set[str] = set()
+    dt: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mods.add(a.asname or a.name)
+                elif a.name == "datetime":
+                    dt.add(a.asname or a.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "time":
+                for a in node.names:
+                    if a.name in ("time", "time_ns"):
+                        funcs.add(a.asname or a.name)
+            elif node.module == "datetime":
+                for a in node.names:
+                    if a.name == "datetime":
+                        dt.add(a.asname or a.name)
+    return mods, funcs, dt
+
+
+class _ScopedVisitor(ast.NodeVisitor):
+    """Tracks the qualified name of the enclosing function/class."""
+
+    def __init__(self) -> None:
+        self.stack: List[str] = []
+
+    def qualname(self) -> str:
+        return ".".join(self.stack) if self.stack else "<module>"
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):  # noqa: N802
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+
+# ---------------------------------------------------------------------------
+# PTL001 — wall-clock outside the declared clock seams
+
+
+def check_wall_clock(mod: Module) -> List[Finding]:
+    time_mods, time_funcs, dt_names = _time_aliases(mod.tree)
+    seams = CLOCK_SEAMS.get(mod.relpath, set())
+    out: List[Finding] = []
+
+    class V(_ScopedVisitor):
+        def visit_Call(self, node):  # noqa: N802
+            hit = None
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if (
+                    f.attr in ("time", "time_ns")
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in time_mods
+                ):
+                    hit = f"{f.value.id}.{f.attr}()"
+                elif f.attr == "now" and not node.args and not node.keywords:
+                    v = f.value
+                    if (isinstance(v, ast.Name) and v.id in dt_names) or (
+                        isinstance(v, ast.Attribute)
+                        and v.attr == "datetime"
+                        and isinstance(v.value, ast.Name)
+                        and v.value.id in dt_names
+                    ):
+                        hit = "datetime.now()"
+            elif isinstance(f, ast.Name) and f.id in time_funcs:
+                hit = f"{f.id}()"
+            if hit is not None:
+                qn = self.qualname()
+                if qn not in seams and not mod.suppressed(
+                    "PTL001", node.lineno, "clock-seam"
+                ):
+                    out.append(
+                        Finding(
+                            "PTL001",
+                            mod.relpath,
+                            node.lineno,
+                            f"wall-clock call {hit} outside the declared "
+                            f"clock seams (in {qn}); route it through the "
+                            "injected clock or declare the seam with "
+                            "`# patrol-lint: clock-seam`",
+                        )
+                    )
+            self.generic_visit(node)
+
+    V().visit(mod.tree)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PTL002 — host-device sync primitives reachable from jitted kernels
+
+
+def _module_to_relpath(dotted: str) -> str:
+    return dotted.replace(".", "/") + ".py"
+
+
+class _FuncIndex:
+    """(relpath, bare function name) → FunctionDef, plus per-module import
+    resolution for cross-module call-graph edges."""
+
+    def __init__(self, mods: Sequence[Module]):
+        self.funcs: Dict[Tuple[str, str], ast.AST] = {}
+        # relpath → {local name: (target relpath, target func name)}
+        self.imports: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        # relpath → {alias: module relpath} for `import pkg.mod as alias`
+        self.mod_aliases: Dict[str, Dict[str, str]] = {}
+        self.relpaths = {m.relpath for m in mods}
+        for m in mods:
+            imap: Dict[str, Tuple[str, str]] = {}
+            amap: Dict[str, str] = {}
+            for node in ast.walk(m.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.funcs[(m.relpath, node.name)] = node
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    rel = _module_to_relpath(node.module)
+                    for a in node.names:
+                        if rel in self.relpaths:
+                            imap[a.asname or a.name] = (rel, a.name)
+                        else:
+                            sub = _module_to_relpath(f"{node.module}.{a.name}")
+                            if sub in self.relpaths:
+                                amap[a.asname or a.name] = sub
+                elif isinstance(node, ast.Import):
+                    for a in node.names:
+                        rel = _module_to_relpath(a.name)
+                        if rel in self.relpaths:
+                            amap[a.asname or a.name] = rel
+            self.imports[m.relpath] = imap
+            self.mod_aliases[m.relpath] = amap
+
+    def resolve(self, relpath: str, call: ast.Call) -> Optional[Tuple[str, str]]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if (relpath, f.id) in self.funcs:
+                return (relpath, f.id)
+            return self.imports.get(relpath, {}).get(f.id)
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            target = self.mod_aliases.get(relpath, {}).get(f.value.id)
+            if target and (target, f.attr) in self.funcs:
+                return (target, f.attr)
+        return None
+
+
+def _jit_roots(mods: Sequence[Module], index: _FuncIndex) -> Set[Tuple[str, str]]:
+    """Functions handed to jax.jit — directly, via ``partial(jax.jit,
+    ...)(f)``, or as ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators."""
+
+    def is_jit(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Attribute) and expr.attr == "jit":
+            return True
+        if isinstance(expr, ast.Name) and expr.id == "jit":
+            return True
+        if isinstance(expr, ast.Call):  # partial(jax.jit, ...)
+            f = expr.func
+            if (isinstance(f, ast.Name) and f.id == "partial") or (
+                isinstance(f, ast.Attribute) and f.attr == "partial"
+            ):
+                return any(is_jit(a) for a in expr.args)
+        return False
+
+    roots: Set[Tuple[str, str]] = set()
+    for m in mods:
+        for node in ast.walk(m.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(is_jit(d) for d in node.decorator_list):
+                    roots.add((m.relpath, node.name))
+            elif isinstance(node, ast.Call) and is_jit(node.func):
+                for arg in node.args:
+                    target = index.resolve(
+                        m.relpath, ast.Call(func=arg, args=[], keywords=[])
+                    ) if isinstance(arg, (ast.Name, ast.Attribute)) else None
+                    if target:
+                        roots.add(target)
+    return roots
+
+
+def check_jit_sync(mods: Sequence[Module]) -> List[Finding]:
+    index = _FuncIndex(mods)
+    roots = _jit_roots(mods, index)
+    mod_by_path = {m.relpath: m for m in mods}
+    np_aliases: Dict[str, Set[str]] = {}
+    jax_aliases: Dict[str, Set[str]] = {}
+    for m in mods:
+        nps, jaxs = set(), set()
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "numpy":
+                        nps.add(a.asname or a.name)
+                    elif a.name == "jax":
+                        jaxs.add(a.asname or a.name)
+        np_aliases[m.relpath] = nps
+        jax_aliases[m.relpath] = jaxs
+
+    # BFS the call graph from the jit roots.
+    seen: Set[Tuple[str, str]] = set()
+    frontier = [r for r in roots if r in index.funcs]
+    reach_from: Dict[Tuple[str, str], Tuple[str, str]] = {}
+    while frontier:
+        key = frontier.pop()
+        if key in seen:
+            continue
+        seen.add(key)
+        fn = index.funcs[key]
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                target = index.resolve(key[0], node)
+                if target and target in index.funcs and target not in seen:
+                    reach_from[target] = key
+                    frontier.append(target)
+
+    out: List[Finding] = []
+    for relpath, name in sorted(seen):
+        m = mod_by_path[relpath]
+        fn = index.funcs[(relpath, name)]
+        root_note = (
+            "" if (relpath, name) in roots
+            else f" (reachable from jit root via {reach_from.get((relpath, name), ('?', '?'))[1]})"
+        )
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            hit = None
+            if isinstance(f, ast.Attribute):
+                if f.attr in SYNC_ATTRS:
+                    hit = f".{f.attr}()"
+                elif isinstance(f.value, ast.Name):
+                    if f.value.id in np_aliases[relpath] and f.attr in SYNC_NP_FUNCS:
+                        hit = f"{f.value.id}.{f.attr}()"
+                    elif (
+                        f.value.id in jax_aliases[relpath]
+                        and f.attr in SYNC_JAX_FUNCS
+                    ):
+                        hit = f"{f.value.id}.{f.attr}()"
+            if hit and not m.suppressed("PTL002", node.lineno):
+                out.append(
+                    Finding(
+                        "PTL002",
+                        relpath,
+                        node.lineno,
+                        f"host-device sync {hit} inside {name}(), which is "
+                        f"reachable from a jitted take/merge kernel{root_note}",
+                    )
+                )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PTL003 — lock-acquisition ordering (_host_mu before _state_mu)
+
+
+def _lock_name(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute) and expr.attr in LOCK_ORDER:
+        return expr.attr
+    if isinstance(expr, ast.Name) and expr.id in LOCK_ORDER:
+        return expr.id
+    return None
+
+
+def check_lock_order(mod: Module) -> List[Finding]:
+    out: List[Finding] = []
+    rank = {name: i for i, name in enumerate(LOCK_ORDER)}
+
+    def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+        acquired: List[str] = []
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                name = _lock_name(item.context_expr)
+                if name is not None:
+                    _record(name, node.lineno, held + tuple(acquired))
+                    acquired.append(name)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                name = _lock_name(f.value)
+                if name is not None:
+                    _record(name, node.lineno, held)
+        new_held = held + tuple(acquired)
+        for child in ast.iter_child_nodes(node):
+            # Nested defs start a fresh dynamic scope: a closure body does
+            # not run under the enclosing `with` at definition time.
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk_fresh(child)
+            else:
+                walk(child, new_held)
+
+    def walk_fresh(fn: ast.AST) -> None:
+        for child in ast.iter_child_nodes(fn):
+            walk(child, ())
+
+    def _record(name: str, line: int, held: Tuple[str, ...]) -> None:
+        if mod.suppressed("PTL003", line):
+            return
+        if name in held:
+            out.append(
+                Finding(
+                    "PTL003",
+                    mod.relpath,
+                    line,
+                    f"re-acquiring non-reentrant lock {name} while already "
+                    "holding it (self-deadlock)",
+                )
+            )
+            return
+        for h in held:
+            if rank[h] > rank[name]:
+                out.append(
+                    Finding(
+                        "PTL003",
+                        mod.relpath,
+                        line,
+                        f"acquiring {name} while holding {h}: declared order "
+                        f"is {' -> '.join(LOCK_ORDER)} (outer first); the "
+                        "reverse nesting deadlocks the native front against "
+                        "the feeder",
+                    )
+                )
+
+    walk_fresh(mod.tree)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PTL004 — nanotoken dtype discipline in the wire/merge state math
+
+
+def check_dtype_discipline(mod: Module) -> List[Finding]:
+    if mod.relpath not in DTYPE_FILES:
+        return []
+    boundaries = DTYPE_BOUNDARIES.get(mod.relpath, set())
+    out: List[Finding] = []
+
+    def is_float_dtype(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Attribute) and expr.attr in FLOAT_DTYPES:
+            return True
+        if isinstance(expr, ast.Name) and expr.id in ("float",):
+            return True
+        if isinstance(expr, ast.Constant) and expr.value in FLOAT_DTYPES:
+            return True
+        return False
+
+    class V(_ScopedVisitor):
+        def in_boundary(self) -> bool:
+            return any(name in boundaries for name in self.stack)
+
+        def flag(self, node: ast.AST, msg: str) -> None:
+            if self.in_boundary() or mod.suppressed(
+                "PTL004", node.lineno, "wire-f64"
+            ):
+                return
+            out.append(Finding("PTL004", mod.relpath, node.lineno, msg))
+
+        def visit_Constant(self, node):  # noqa: N802
+            if isinstance(node.value, float):
+                self.flag(
+                    node,
+                    f"float literal {node.value!r} in nanotoken state math; "
+                    "stay in u32/u64/i64 (or move to a declared boundary)",
+                )
+
+        def visit_BinOp(self, node):  # noqa: N802
+            if isinstance(node.op, ast.Div):
+                self.flag(
+                    node,
+                    "true division promotes to float64; use // on nanotoken "
+                    "integers (or move to a declared boundary)",
+                )
+            self.generic_visit(node)
+
+        def visit_Attribute(self, node):  # noqa: N802
+            if node.attr in FLOAT_DTYPES:
+                self.flag(
+                    node,
+                    f"float dtype .{node.attr} referenced in nanotoken state "
+                    "math; declared dtypes are u32/u64/i64",
+                )
+            self.generic_visit(node)
+
+        def visit_Call(self, node):  # noqa: N802
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in DTYPE_CTORS:
+                pos = DTYPE_CTORS[f.attr]
+                has_kw = any(k.arg == "dtype" for k in node.keywords)
+                has_pos = pos is not None and len(node.args) > pos
+                if not has_kw and not has_pos:
+                    self.flag(
+                        node,
+                        f"{f.attr}() without an explicit dtype: the default "
+                        "is environment-dependent (x64 mode) and can "
+                        "float-promote; pass the nanotoken dtype explicitly",
+                    )
+            self.generic_visit(node)
+
+    V().visit(mod.tree)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Drivers
+
+PER_MODULE_CHECKS = (check_wall_clock, check_lock_order, check_dtype_discipline)
+ALL_CODES = ("PTL001", "PTL002", "PTL003", "PTL004")
+
+
+def lint_modules(mods: Sequence[Module]) -> List[Finding]:
+    out: List[Finding] = []
+    for m in mods:
+        for chk in PER_MODULE_CHECKS:
+            out.extend(chk(m))
+    out.extend(check_jit_sync(mods))
+    return sorted(out, key=lambda f: (f.path, f.line, f.check))
+
+
+def lint_sources(sources: Dict[str, str]) -> List[Finding]:
+    """Lint in-memory sources ({relpath: source}) — the self-test entry."""
+    return lint_modules([Module(rp, src) for rp, src in sorted(sources.items())])
+
+
+def repo_sources(root: str) -> Dict[str, str]:
+    srcs: Dict[str, str] = {}
+    pkg = os.path.join(root, "patrol_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            with open(path, "r", encoding="utf-8") as f:
+                srcs[rel] = f.read()
+    return srcs
+
+
+def lint_repo(root: str) -> List[Finding]:
+    """Lint every Python source under <root>/patrol_tpu."""
+    return lint_sources(repo_sources(root))

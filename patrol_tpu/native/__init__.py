@@ -167,6 +167,8 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pt_hls_drain_locked.restype = ctypes.c_int
         lib.pt_hls_stats.argtypes = [ctypes.c_int, _u64p]
         lib.pt_hls_stats.restype = ctypes.c_int
+        lib.pt_hls_events.argtypes = [ctypes.c_int]
+        lib.pt_hls_events.restype = ctypes.c_int64
         lib.pt_http_attach_host.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ]

@@ -65,6 +65,13 @@ constexpr int kNameLimit = 231;   // MAX_NAME_LENGTH_V1 (bucket.go:43-44)
 constexpr int kPathMax = 2048;    // slow-path target cap
 constexpr int kRbufMax = 16384;   // per-connection read buffer cap
 constexpr int kRingCap = 8192;    // parsed-take ring capacity
+// Sane request-body bound. The API carries take input in the URL; a
+// Content-Length beyond this is hostile (or a config error) and gets a
+// 400 + close instead of a body drain — and the digit parse saturates
+// HERE rather than wrapping size_t, which under-skipped the body and
+// re-parsed its bytes as pipelined requests (request-smuggling surface
+// behind a connection-reusing proxy; ADVICE r5).
+constexpr size_t kMaxContentLen = (size_t)1 << 30;
 constexpr int64_t kInt64Max = 0x7FFFFFFFFFFFFFFFLL;
 
 // ---- Go time.ParseDuration / ParseRate port (ops/rate.py parity) ----------
@@ -439,6 +446,13 @@ constexpr size_t kH2MaxSend = 16384;
 constexpr size_t kH2MaxHeaderBlock = 64 * 1024;
 constexpr size_t kH2MaxWbuf = 1 << 20;
 
+// Client-reset stream ids remembered per conn (bounded; oldest pruned on
+// overflow, each id pruned when a completion for it is dropped): ring-
+// completed takes must not answer on a closed stream — HEADERS there is
+// a STREAM_CLOSED/PROTOCOL_ERROR that can GOAWAY every other in-flight
+// stream on the connection (ADVICE r5).
+constexpr size_t kH2MaxResetTracked = 128;
+
 struct H2State {
   void* inflater = nullptr;
   int64_t conn_send_window = 65535;
@@ -450,6 +464,7 @@ struct H2State {
   // (stream, body, stream_window_remaining).
   std::deque<std::tuple<int32_t, std::string, int64_t>> pending;
   uint64_t rx_data_unacked = 0;
+  std::deque<int32_t> reset_streams;
 };
 
 void h2_append_frame(std::string& out, int type, uint8_t flags,
@@ -517,7 +532,9 @@ struct Server {
   int event_fd = -1;
   uint16_t port = 0;
   std::thread thread;
-  bool running = false;
+  // Read by the epoll thread each loop, written by pt_http_stop from the
+  // caller's thread: atomic, or the stop handshake is a data race.
+  std::atomic<bool> running{false};
 
   std::mutex mu;
   std::condition_variable cv;  // signals the Python pump: work available
@@ -667,6 +684,14 @@ void h2_emit_data(Conn* c, int32_t stream, const char* body, size_t n) {
 void queue_h2_response(Server* s, Conn* c, int32_t stream, int code,
                        const char* ctype, const char* body,
                        size_t body_len) {
+  // Client already reset the stream: drop the completion (and prune the
+  // tracked id — one response per stream, so it cannot recur).
+  auto& resets = c->h2->reset_streams;
+  auto rit = std::find(resets.begin(), resets.end(), stream);
+  if (rit != resets.end()) {
+    resets.erase(rit);
+    return;
+  }
   std::string block;
   char st[8], cl[8];
   int stl = snprintf(st, sizeof(st), "%d", code);
@@ -851,8 +876,13 @@ bool h2_process(Server* s, int slot) {
         if (flags & kH2FlagEndHeaders) ok = h2_dispatch_headers(s, slot);
         break;
       case kH2Data: {
-        // API requests are bodyless; tolerate and drain small bodies,
-        // crediting the connection window back so clients never stall.
+        // API requests are bodyless; tolerate and drain bodies, crediting
+        // BOTH flow-control windows back so clients never stall. The
+        // connection window batches (32 KiB hysteresis); the per-stream
+        // window is credited per frame — without it the comment's
+        // "never stall" only held for bodies under the 64 KiB initial
+        // stream window, and a larger upload wedged its stream
+        // mid-body (ADVICE r5).
         h->rx_data_unacked += len;
         if (h->rx_data_unacked >= 32768) {
           uint8_t w[4] = {
@@ -864,12 +894,37 @@ bool h2_process(Server* s, int slot) {
           h2_append_frame(c.wbuf, kH2WindowUpdate, 0, 0, (const char*)w, 4);
           h->rx_data_unacked = 0;
         }
+        if (len > 0 && !(flags & kH2FlagEndStream)) {
+          uint8_t w[4] = {
+              (uint8_t)((len >> 24) & 0x7F),
+              (uint8_t)(len >> 16),
+              (uint8_t)(len >> 8),
+              (uint8_t)len,
+          };
+          h2_append_frame(c.wbuf, kH2WindowUpdate, 0, stream,
+                          (const char*)w, 4);
+        }
         break;
       }
       case kH2Goaway:
         ok = false;
         break;
-      case kH2RstStream:
+      case kH2RstStream: {
+        if (len == 4 && stream > 0) {
+          // Drop any parked response body for the stream, then remember
+          // the id so a late ring completion is dropped too.
+          for (auto it = h->pending.begin(); it != h->pending.end();)
+            it = std::get<0>(*it) == stream ? h->pending.erase(it)
+                                            : std::next(it);
+          if (std::find(h->reset_streams.begin(), h->reset_streams.end(),
+                        stream) == h->reset_streams.end()) {
+            h->reset_streams.push_back(stream);
+            if (h->reset_streams.size() > kH2MaxResetTracked)
+              h->reset_streams.pop_front();
+          }
+        }
+        break;
+      }
       case kH2Priority:
       default:
         break;  // ignore (incl. unknown extension frames, RFC 7540 §4.1)
@@ -1203,6 +1258,14 @@ bool try_parse_one(Server* s, int slot) {
       content_len = 0;
       for (char ch : val) {
         if (ch < '0' || ch > '9') break;
+        if (content_len > kMaxContentLen / 10) {
+          // Saturate past the sane bound (a 20+-digit value used to wrap
+          // size_t to a small count — under-skipped body bytes then
+          // reparsed as pipelined requests); the bound check after the
+          // header loop turns this into a 400 + close.
+          content_len = kMaxContentLen + 1;
+          break;
+        }
         content_len = content_len * 10 + (size_t)(ch - '0');
       }
     } else if (ieq(key, "connection", 10)) {
@@ -1217,6 +1280,15 @@ bool try_parse_one(Server* s, int slot) {
         }
       }
     }
+  }
+  if (content_len > kMaxContentLen) {
+    // Oversized (or saturated-overflow) Content-Length: reject and close.
+    // The whole buffer is dropped — body bytes must never be re-parsed
+    // as pipelined requests (the desync/request-smuggling surface).
+    c.close_after = true;
+    queue_response(s, &c, 400, "text/plain", "content length too large\n", 25);
+    c.rbuf.clear();
+    return true;
   }
   std::string_view path = target, query;
   size_t qm = target.find('?');
@@ -1362,7 +1434,7 @@ void flush_writes(Server* s, int slot) {
 
 void serve_loop(Server* s) {
   epoll_event evs[256];
-  while (s->running) {
+  while (s->running.load(std::memory_order_relaxed)) {
     int n = epoll_wait(s->epoll_fd, evs, 256, 100);
     if (n < 0) {
       if (errno == EINTR) continue;
@@ -1597,7 +1669,17 @@ int pt_http_poll(int h, int timeout_ms,
   if (!s) return -EBADF;
   std::unique_lock<std::mutex> lk(s->mu);
   if (s->take_q.empty() && s->other_q.empty() && timeout_ms > 0) {
-    s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+    // wait_until(system_clock) rather than wait_for: wait_for's
+    // steady_clock lowers to pthread_cond_clockwait, which the gcc-10
+    // libtsan doesn't intercept — TSan then never sees the mutex release
+    // inside the wait and reports every later acquisition as a double
+    // lock (the checker must stay usable; scripts/check.sh runs it). A
+    // realtime-clock jump can only shorten/stretch one poll timeout.
+    s->cv.wait_until(
+        lk,
+        std::chrono::system_clock::now() +
+            std::chrono::milliseconds(timeout_ms),
+        [&] {
       return !s->take_q.empty() || !s->other_q.empty() || !s->running ||
              (s->hls != nullptr &&
               s->hls->events.load(std::memory_order_relaxed) !=
@@ -2010,6 +2092,17 @@ int pt_hls_drain_locked(int h, int32_t* dirty_out, int64_t* snap, int cap_d,
                          st->promote_rows.begin() + np);
   *n_promote = np;
   return nd;
+}
+
+// Promotion-event counter: bumped by the epoll thread's takes ONLY on a
+// take-pressure promotion threshold crossing (hls_take_locked). Lock-free
+// read — the pump compares it against its cursor after a poll wake and
+// runs a promotions-only drain when it moved, bypassing the broadcast
+// cadence gate so a newly-hot bucket leaves the slow path promptly.
+int64_t pt_hls_events(int h) {
+  HostStore* st = g_hls[h];
+  if (!st) return -EBADF;
+  return (int64_t)st->events.load(std::memory_order_relaxed);
 }
 
 // out4 = {native_takes, resident_rows, blocks_allocated, pending_events}.

@@ -136,7 +136,7 @@ class API:
         self.repo = repo
         self.log = log
         self.stats = stats or (lambda: {})
-        self.started_at = time.time()
+        self.started_at = time.time()  # patrol-lint: clock-seam (uptime)
         self._batcher = (
             _TakeBatcher(repo)
             if PYFRONT_BATCH and hasattr(repo, "submit_takes_batch")
@@ -321,7 +321,8 @@ class API:
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name} {val}")
         lines.append("# TYPE patrol_uptime_seconds gauge")
-        lines.append(f"patrol_uptime_seconds {time.time() - self.started_at:.3f}")
+        uptime = time.time() - self.started_at  # patrol-lint: clock-seam (uptime)
+        lines.append(f"patrol_uptime_seconds {uptime:.3f}")
         return ("\n".join(lines) + "\n").encode()
 
 

@@ -130,8 +130,16 @@ class NativeHTTPFront:
         # wakeup on its latency path — so the poll tick is shortened to
         # bound broadcast delay instead (≤5 ms to peers; replication is
         # eventual by design). Promotions still wake the poll predicate.
-        poll_ms = 5 if getattr(self._engine, "_native_store", None) else 50
+        store = getattr(self._engine, "_native_store", None)
+        poll_ms = 5 if store else 50
         next_drain = 0.0
+        # Promotion-event cursor: the store's counter moves ONLY on
+        # take-pressure promotion threshold crossings, so a poll woken
+        # early by one can bypass the drain cadence below for a
+        # promotions-only drain (ADVICE r5) — a newly-hot bucket must
+        # not wait out max(poll tick, 4x last drain cost) to leave the
+        # slow path. Broadcast building keeps the cadence gate.
+        events_seen = store.events if store is not None else 0
         while not self._stopped.is_set():
             nt = self.lib.pt_http_poll(
                 self.h, poll_ms,
@@ -161,6 +169,8 @@ class NativeHTTPFront:
                 drain = getattr(self._engine, "drain_native_broadcasts", None)
                 now = time.monotonic()
                 if drain is not None and now >= next_drain:
+                    if store is not None:
+                        events_seen = store.events
                     try:
                         drain()
                     except Exception:  # pragma: no cover
@@ -173,6 +183,14 @@ class NativeHTTPFront:
                     # bounded at ~4× the per-drain cost.
                     next_drain = time.monotonic()
                     next_drain += max(poll_ms / 1000.0, 4 * (next_drain - now))
+                elif store is not None and store.events != events_seen:
+                    # Cadence gate closed but a promote event woke the
+                    # poll: promotions-only drain (dirty rows wait).
+                    events_seen = store.events
+                    try:
+                        self._engine.drain_native_promotions()
+                    except Exception:  # pragma: no cover
+                        log.exception("native promotion drain failed")
         self._cq.put(None)  # unblock the completer at shutdown
 
     def _submit_takes(self, repo, nt: int) -> None:

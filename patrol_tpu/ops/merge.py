@@ -159,7 +159,7 @@ def merge_scalar_batch(state: LimiterState, batch: MergeBatch) -> LimiterState:
     full-state rebroadcast (every take rebroadcasts, README.md:41-43)."""
     k = batch.rows.shape[0]
     pn_rows = state.pn[batch.rows]  # [K, N, 2] gather
-    ar = jnp.arange(k)
+    ar = jnp.arange(k, dtype=jnp.int32)
     lane_a = pn_rows[ar, batch.slots, ADDED]
     lane_t = pn_rows[ar, batch.slots, TAKEN]
     other_a = pn_rows[:, :, ADDED].sum(axis=-1) - lane_a

@@ -45,6 +45,22 @@ from patrol_tpu.ops.take import TakeRequest, TakeResult, take_batch
 REPLICA_AXIS = "r"
 BUCKET_AXIS = "b"
 
+# jax.shard_map graduated from jax.experimental in newer releases (which
+# also renamed check_rep → check_vma); the pinned toolchain (0.4.x) still
+# ships the experimental name and the old kwarg.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - exercised only on older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+import inspect as _inspect
+
+_SM_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
 
 def make_mesh(replicas: int = 1, devices=None) -> Mesh:
     """A (replicas × shards) mesh over the available devices. ``replicas``
@@ -128,7 +144,7 @@ def cluster_step(
 
 def build_cluster_step(mesh: Mesh, node_slot: int):
     """jit(shard_map(cluster_step)) over the mesh, with donated state."""
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(cluster_step, node_slot=node_slot),
         mesh=mesh,
         in_specs=(
@@ -144,7 +160,7 @@ def build_cluster_step(mesh: Mesh, node_slot: int):
         # compile path rejects for s64 ("Supported lowering only of Sum
         # all reduce", BENCH r2). Replication is instead asserted by
         # tests/test_topology.py's cross-replica equality checks.
-        check_vma=False,
+        **{_SM_CHECK_KW: False},
     )
     return jax.jit(fn, donate_argnums=0)
 

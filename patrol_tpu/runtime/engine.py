@@ -1287,6 +1287,22 @@ class DeviceEngine:
                     elapsed[row] = lanes.elapsed_ns
         return pn, elapsed
 
+    def drain_native_promotions(self) -> None:
+        """Promotions-only drain of the native store: no broadcast
+        building, no dirty-row pops. The front's pump calls this when a
+        poll wake finds the store's promotion-event counter moved but the
+        broadcast cadence gate is still closed, so a take-pressure-hot
+        bucket joins the device path promptly instead of waiting out
+        ``max(poll tick, 4x last drain cost)`` (ADVICE r5). Dirty rows
+        keep their queue entries and flags for the cadence-gated drain."""
+        st = self._native_store
+        if st is None:
+            return
+        with self._host_mu:
+            for row in st.drain_promotes_locked():
+                if row in self._hosted:
+                    self._promote_locked(row)
+
     def drain_native_broadcasts(self) -> None:
         """Turn the C++ front's coalesced take effects into replication:
         emit each dirty row's LATEST full state once (CvRDT: a later state

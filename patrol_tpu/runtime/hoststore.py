@@ -204,7 +204,28 @@ class NativeHostStore:
             self._promote[: self._np.value].tolist(),
         )
 
+    def drain_promotes_locked(self) -> List[int]:
+        """Pop ONLY the promote queue (zero dirty-row capacity leaves the
+        broadcast queue and its dirty flags in place for the cadence-gated
+        drain). Used by the pump's promotions-only fast path."""
+        out: List[int] = []
+        while True:
+            self.lib.pt_hls_drain_locked(
+                self.h, self._dirty, self._snap, 0,
+                self._promote, len(self._promote), ctypes.byref(self._np),
+            )
+            n = self._np.value
+            if n <= 0:
+                return out
+            out.extend(self._promote[:n].tolist())
+
     # -- lock-free ----------------------------------------------------------
+
+    @property
+    def events(self) -> int:
+        """Promotion-event counter: bumped by the C++ take path only on
+        take-pressure threshold crossings. Lock-free read."""
+        return int(self.lib.pt_hls_events(self.h))
 
     def stats(self) -> dict:
         out = np.zeros(4, np.uint64)

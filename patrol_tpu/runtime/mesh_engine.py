@@ -71,10 +71,17 @@ class MeshEngine(DeviceEngine):
                 f"buckets ({config.buckets}) must divide over {shards} shards"
             )
         super().__init__(config, node_slot=node_slot, clock=clock, on_broadcast=on_broadcast)
-        self.plan = topo.plan_for(self.mesh, config)
-        self._step = topo.build_cluster_step(self.mesh, node_slot)
-        with self._state_mu:
-            self.state = topo.place_state(self.state, self.mesh)
+        try:
+            self.plan = topo.plan_for(self.mesh, config)
+            self._step = topo.build_cluster_step(self.mesh, node_slot)
+            with self._state_mu:
+                self.state = topo.place_state(self.state, self.mesh)
+        except BaseException:
+            # The base engine is live (threads + native directory handle);
+            # a half-built MeshEngine must release them or every later
+            # engine in the process inherits a shrunken handle registry.
+            self.stop()
+            raise
 
     # -- tick ---------------------------------------------------------------
 

@@ -28,7 +28,7 @@ class JSONFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         out: Dict[str, Any] = {
             "level": record.levelname.lower(),
-            "ts": round(time.time(), 6),
+            "ts": round(time.time(), 6),  # patrol-lint: clock-seam (log stamp)
             "logger": record.name,
             "msg": record.getMessage(),
         }

@@ -113,7 +113,7 @@ def build_profile_values(
     # string_table: every index in insertion order (dict preserves it).
     for v in strings:
         out += _field_bytes(6, v.encode("utf-8", errors="replace"))
-    out += _field_varint(9, time.time_ns())
+    out += _field_varint(9, time.time_ns())  # patrol-lint: clock-seam (pprof)
     out += _field_varint(10, duration_ns)
     out += _field_bytes(11, _value_type(s(period_type[0]), s(period_type[1])))
     out += _field_varint(12, period_ns)

@@ -25,6 +25,13 @@ from collections import Counter
 from typing import Dict, Optional
 
 
+def _qualname(code) -> str:
+    """``co_qualname`` is 3.11+; on 3.10 fall back to the bare name. An
+    AttributeError here used to kill whichever engine thread recorded the
+    first contended wait — feeder death presented as takes hanging."""
+    return getattr(code, "co_qualname", None) or code.co_name
+
+
 class SamplingProfiler:
     """Sample every thread's stack at ``interval_s`` for ``duration_s``;
     report as pprof protobuf (:meth:`run_pprof`, ≙ ``pprof.Profile``'s
@@ -50,7 +57,7 @@ class SamplingProfiler:
                 while f is not None:
                     code = f.f_code  # type: ignore[attr-defined]
                     stack.append(
-                        (code.co_qualname, code.co_filename, f.f_lineno)  # type: ignore[attr-defined]
+                        (_qualname(code), code.co_filename, f.f_lineno)  # type: ignore[attr-defined]
                     )
                     f = f.f_back  # type: ignore[attr-defined]
                 stacks[tuple(stack)] += 1
@@ -125,7 +132,7 @@ class ContentionRegistry:
         f = sys._getframe(skip)
         while f is not None and len(stack) < 24:
             code = f.f_code
-            stack.append((code.co_qualname, code.co_filename, f.f_lineno))
+            stack.append((_qualname(code), code.co_filename, f.f_lineno))
             f = f.f_back
         return tuple(stack)
 

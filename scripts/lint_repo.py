@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Run the patrol-check AST lint over the repo's Python sources.
+
+Part of the `scripts/check.sh` gate (and runnable standalone). Exit code
+0 = zero findings; 1 = findings printed one per line as
+
+    path:line: CODE message
+
+See patrol_tpu/analysis/lint.py for the checks and README.md for the
+suppression format.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from patrol_tpu.analysis import lint  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="repo root (default: this script's parent)",
+    )
+    args = ap.parse_args()
+    findings = lint.lint_repo(args.root)
+    for f in findings:
+        print(f)
+    if findings:
+        print(
+            f"patrol-lint: {len(findings)} finding(s) across "
+            f"{len({f.path for f in findings})} file(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("patrol-lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

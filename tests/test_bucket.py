@@ -7,6 +7,11 @@ permutation test (bucket_test.go:68-114), rebuilt with hypothesis.
 
 import random
 
+import pytest
+
+pytest.importorskip(
+    "hypothesis", reason="property suite needs hypothesis (not in this image)"
+)
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -7,6 +7,9 @@ import random
 import jax.numpy as jnp
 import numpy as np
 import pytest
+pytest.importorskip(
+    "hypothesis", reason="property suite needs hypothesis (not in this image)"
+)
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -1,0 +1,268 @@
+"""patrol-check AST lint self-tests (PTL001-PTL004).
+
+Each check is proven BOTH ways on fixture sources: it fires on a seeded
+violation and stays silent on the fixed form of the same code. The last
+test runs the full lint over the real repo — the `pytest -m lint` slice
+of the scripts/check.sh gate, with no native builds involved.
+"""
+
+import os
+
+import pytest
+
+from patrol_tpu.analysis import lint
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(findings):
+    return [f.check for f in findings]
+
+
+class TestWallClock:
+    def test_fires_on_stray_time_call(self):
+        src = "import time\n\ndef refill(now=None):\n    return time.time_ns()\n"
+        f = lint.lint_sources({"patrol_tpu/runtime/foo.py": src})
+        assert codes(f) == ["PTL001"]
+        assert "time.time_ns()" in f[0].message
+
+    def test_fires_on_aliased_import(self):
+        src = "import time as _t\n\ndef f():\n    return _t.time()\n"
+        assert codes(lint.lint_sources({"patrol_tpu/x.py": src})) == ["PTL001"]
+
+    def test_fires_on_argless_datetime_now(self):
+        src = (
+            "from datetime import datetime\n\n"
+            "def stamp():\n    return datetime.now()\n"
+        )
+        assert codes(lint.lint_sources({"patrol_tpu/x.py": src})) == ["PTL001"]
+
+    def test_silent_on_declared_seam_function(self):
+        # runtime/bucket.py::system_clock is the configured clock seam.
+        src = "import time\n\ndef system_clock():\n    return time.time_ns()\n"
+        assert lint.lint_sources({"patrol_tpu/runtime/bucket.py": src}) == []
+
+    def test_silent_with_inline_seam_marker(self):
+        src = (
+            "import time\n\ndef uptime():\n"
+            "    return time.time()  # patrol-lint: clock-seam (uptime)\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def test_silent_on_injected_clock(self):
+        src = "def take(clock):\n    return clock()\n"
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def test_silent_on_zoned_datetime_now(self):
+        # now(tz) is explicit about its domain; only the argless form is
+        # the footgun the check exists for.
+        src = (
+            "from datetime import datetime, timezone\n\n"
+            "def stamp():\n    return datetime.now(timezone.utc)\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+
+JIT_VIOLATION = """
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _gather(state, rows):
+    return np.asarray(state)[rows]
+
+
+def kernel(state, rows):
+    return _gather(state, rows) + jnp.int64(1)
+
+
+kernel_jit = partial(jax.jit, donate_argnums=0)(kernel)
+"""
+
+JIT_FIXED = JIT_VIOLATION.replace("np.asarray(state)[rows]", "state[rows]")
+
+
+class TestJitSync:
+    def test_fires_through_the_call_graph(self):
+        f = lint.lint_sources({"patrol_tpu/ops/k.py": JIT_VIOLATION})
+        assert codes(f) == ["PTL002"]
+        assert "_gather" in f[0].message
+
+    def test_silent_on_fixed_kernel(self):
+        assert lint.lint_sources({"patrol_tpu/ops/k.py": JIT_FIXED}) == []
+
+    def test_fires_on_decorated_root_item_call(self):
+        src = (
+            "import jax\n\n@jax.jit\ndef kernel(x):\n"
+            "    return x.sum().item()\n"
+        )
+        f = lint.lint_sources({"patrol_tpu/ops/k.py": src})
+        assert codes(f) == ["PTL002"]
+
+    def test_fires_across_modules(self):
+        helper = "import numpy as np\n\ndef pull(x):\n    return np.asarray(x)\n"
+        kern = (
+            "import jax\nfrom patrol_tpu.ops.helper import pull\n\n"
+            "@jax.jit\ndef kernel(x):\n    return pull(x)\n"
+        )
+        f = lint.lint_sources(
+            {"patrol_tpu/ops/helper.py": helper, "patrol_tpu/ops/kern.py": kern}
+        )
+        assert codes(f) == ["PTL002"]
+        assert f[0].path == "patrol_tpu/ops/helper.py"
+
+    def test_silent_when_sync_is_not_reachable(self):
+        # Host-side completion code may sync freely: it is not called
+        # from any jitted root.
+        src = (
+            "import jax\nimport numpy as np\n\n"
+            "@jax.jit\ndef kernel(x):\n    return x + 1\n\n"
+            "def complete(x):\n    return np.asarray(x).item()\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/ops/k.py": src}) == []
+
+
+LOCK_VIOLATION = """
+class Engine:
+    def bad(self):
+        with self._state_mu:
+            with self._host_mu:
+                pass
+"""
+
+LOCK_FIXED = """
+class Engine:
+    def good(self):
+        with self._host_mu:
+            with self._state_mu:
+                pass
+"""
+
+
+class TestLockOrder:
+    def test_fires_on_inverted_nesting(self):
+        f = lint.lint_sources({"patrol_tpu/runtime/e.py": LOCK_VIOLATION})
+        assert codes(f) == ["PTL003"]
+        assert "_host_mu while holding _state_mu" in f[0].message
+
+    def test_silent_on_declared_order(self):
+        assert lint.lint_sources({"patrol_tpu/runtime/e.py": LOCK_FIXED}) == []
+
+    def test_fires_on_acquire_call_under_state_mu(self):
+        src = (
+            "class E:\n    def bad(self):\n"
+            "        with self._state_mu:\n"
+            "            self._host_mu.acquire()\n"
+        )
+        assert codes(lint.lint_sources({"patrol_tpu/runtime/e.py": src})) == [
+            "PTL003"
+        ]
+
+    def test_fires_on_self_deadlock(self):
+        src = (
+            "class E:\n    def bad(self):\n"
+            "        with self._host_mu:\n"
+            "            with self._host_mu:\n                pass\n"
+        )
+        f = lint.lint_sources({"patrol_tpu/runtime/e.py": src})
+        assert codes(f) == ["PTL003"]
+        assert "re-acquiring" in f[0].message
+
+    def test_closure_body_is_a_fresh_scope(self):
+        # A function DEFINED under a with-block does not RUN there.
+        src = (
+            "class E:\n    def ok(self):\n"
+            "        with self._state_mu:\n"
+            "            def later():\n"
+            "                with self._host_mu:\n                    pass\n"
+            "            return later\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/runtime/e.py": src}) == []
+
+
+class TestDtypeDiscipline:
+    def test_fires_on_float_literal_in_merge(self):
+        src = "def merge(a):\n    return a * 1.5\n"
+        f = lint.lint_sources({"patrol_tpu/ops/merge.py": src})
+        assert codes(f) == ["PTL004"]
+
+    def test_fires_on_true_division(self):
+        src = "NANO = 10 ** 9\n\ndef to_tokens(nt):\n    return nt / NANO\n"
+        assert codes(lint.lint_sources({"patrol_tpu/ops/wire.py": src})) == [
+            "PTL004"
+        ]
+
+    def test_fires_on_float_dtype_and_bare_ctor(self):
+        src = (
+            "import jax.numpy as jnp\n\n"
+            "def pad(k):\n"
+            "    a = jnp.zeros(k, jnp.float64)\n"
+            "    b = jnp.arange(k)\n"
+            "    return a, b\n"
+        )
+        f = lint.lint_sources({"patrol_tpu/ops/merge.py": src})
+        assert codes(f) == ["PTL004", "PTL004"]
+
+    def test_silent_on_nanotoken_dtypes(self):
+        src = (
+            "import jax.numpy as jnp\n\n"
+            "def pad(k):\n"
+            "    return jnp.zeros(k, jnp.int64) + jnp.arange(k, dtype=jnp.int32)\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/ops/merge.py": src}) == []
+
+    def test_silent_in_declared_boundary(self):
+        # wire.py's from_nanotokens IS the declared f64 conversion seam.
+        src = "NANO = 10 ** 9\n\ndef from_nanotokens(nt):\n    return nt / NANO\n"
+        assert lint.lint_sources({"patrol_tpu/ops/wire.py": src}) == []
+
+    def test_silent_with_wire_marker(self):
+        src = (
+            "def f(nt):\n"
+            "    return nt / 7  # patrol-lint: wire-f64 (wire is float64)\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/ops/wire.py": src}) == []
+
+    def test_out_of_scope_files_unchecked(self):
+        # The float64 refill grant in ops/take.py is a DOCUMENTED seam
+        # (bucket.go:130-143 parity); the dtype check scopes to wire/merge.
+        src = "def grant(d, i):\n    return d / i\n"
+        assert lint.lint_sources({"patrol_tpu/ops/take.py": src}) == []
+
+
+class TestGenericSuppression:
+    def test_disable_directive_names_codes(self):
+        src = (
+            "import time\n\ndef f():\n"
+            "    return time.time()  # patrol-lint: disable=PTL001\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def test_disable_of_other_code_does_not_mask(self):
+        src = (
+            "import time\n\ndef f():\n"
+            "    return time.time()  # patrol-lint: disable=PTL004\n"
+        )
+        assert codes(lint.lint_sources({"patrol_tpu/x.py": src})) == ["PTL001"]
+
+
+class TestRepoIsClean:
+    def test_repo_lints_clean(self):
+        """The gate's contract: zero findings on the shipped tree."""
+        findings = lint.lint_repo(REPO_ROOT)
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_repo_jit_roots_are_discovered(self):
+        """Guard against a vacuously-clean PTL002: the real kernels must
+        be visible as jit roots or the reachability check means nothing."""
+        srcs = lint.repo_sources(REPO_ROOT)
+        mods = [lint.Module(rp, s) for rp, s in sorted(srcs.items())]
+        roots = lint._jit_roots(mods, lint._FuncIndex(mods))
+        assert ("patrol_tpu/ops/take.py", "take_batch") in roots
+        assert ("patrol_tpu/ops/merge.py", "merge_batch") in roots
+        assert ("patrol_tpu/ops/merge.py", "merge_dense") in roots

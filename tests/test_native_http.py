@@ -137,6 +137,38 @@ class TestConnectionHandling:
             got = self._roundtrip(s, req * 2, 2)
         assert [g[0] for g in got] == [200, 200]
 
+    def test_oversized_content_length_rejected(self, front):
+        """A 20+-digit Content-Length used to wrap size_t to a small
+        value: the body was under-skipped and its bytes re-parsed as
+        pipelined requests (request-smuggling/desync surface, ADVICE r5).
+        Now the parse saturates and the request gets a 400 + close; the
+        smuggled 'request' in the body is never answered."""
+        with socket.create_connection(("127.0.0.1", front.port), timeout=5) as s:
+            smuggled = b"GET /smuggled HTTP/1.1\r\nHost: x\r\n\r\n"
+            s.sendall(
+                b"POST /take/ovcl?rate=5:1s HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: 99999999999999999999999\r\n\r\n" + smuggled
+            )
+            data = b""
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        assert data.split(b" ", 2)[1] == b"400"
+        assert data.count(b"HTTP/1.1 ") == 1  # nothing answered the body bytes
+
+    def test_large_but_sane_content_length_unaffected(self, front):
+        """Below the bound the body-drain path is unchanged."""
+        body = b"z" * 70000
+        with socket.create_connection(("127.0.0.1", front.port), timeout=5) as s:
+            req = (
+                b"POST /take/bigbody?rate=5:1h HTTP/1.1\r\nHost: x\r\n"
+                b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+            )
+            got = self._roundtrip(s, req * 2, 2)
+        assert [g[0] for g in got] == [200, 200]
+
     def test_h2c_preface_answered_natively(self, front):
         """A prior-knowledge h2 preface gets a native h2 handshake (r5):
         the server's SETTINGS frame, then an ACK of ours — not an h1 400
@@ -219,6 +251,44 @@ class TestConnectionHandling:
         assert 0 < int(out[1]) <= int(out[2])  # p50 <= p99
         assert int(out[3]) + int(out[4]) == int(out[0])  # all 200/429
         assert int(out[3]) > 0
+
+    def test_promotion_bypasses_drain_cadence(self, monkeypatch):
+        """ADVICE r5: a take-pressure promote event that wakes pt_http_poll
+        early must trigger a promotions-only drain instead of waiting out
+        the adaptive broadcast cadence. Timing-tolerant: asserts the
+        promotion lands within a generous deadline, driven only by inline
+        native takes (no cadence-scale traffic keeping the pump busy)."""
+        import http.client
+        import time
+
+        from patrol_tpu.runtime import hoststore
+
+        monkeypatch.setattr(hoststore, "NATIVE_PROMOTE_TAKES", 8)
+        engine = DeviceEngine(
+            LimiterConfig(buckets=64, nodes=4), node_slot=0, native_host=True
+        )
+        repo = TPURepo(engine)
+        api = API(repo, stats=lambda: {})
+        from patrol_tpu.net.native_http import NativeHTTPFront
+
+        f = NativeHTTPFront(api, "127.0.0.1", 0)
+        try:
+            if engine._native_store is None:
+                pytest.skip("native host store unavailable")
+            conn = http.client.HTTPConnection("127.0.0.1", f.port, timeout=5)
+            # First take binds + hosts the bucket via the ring; the rest
+            # are served in-front and cross the promote threshold.
+            for _ in range(16):
+                conn.request("POST", "/take/promote-me?rate=1000000:1s")
+                conn.getresponse().read()
+            conn.close()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and engine._promotions == 0:
+                time.sleep(0.01)
+            assert engine._promotions >= 1, "promote event never drained"
+        finally:
+            f.close()
+            engine.stop()
 
     def test_blast_client_end_to_end(self, front):
         """The benchmark's C++ load client against the real front."""

@@ -5,6 +5,9 @@ import math
 import struct
 
 import pytest
+pytest.importorskip(
+    "hypothesis", reason="property suite needs hypothesis (not in this image)"
+)
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
