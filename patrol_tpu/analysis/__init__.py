@@ -7,6 +7,11 @@ cannot see (clock seams, jit-reachability sync discipline, lock order,
 nanotoken dtype discipline) encoded as AST checks over the sources.
 
 Entry points: :func:`patrol_tpu.analysis.lint.lint_repo` (used by
-``scripts/lint_repo.py`` and the ``pytest -m lint`` suite) and
-:func:`patrol_tpu.analysis.lint.lint_sources` (fixture-driven self-tests).
+``scripts/lint_repo.py`` and the ``pytest -m lint`` suite),
+:func:`patrol_tpu.analysis.lint.lint_sources` (fixture-driven
+self-tests), and :func:`patrol_tpu.analysis.prove.prove_repo` — the
+jaxpr-level CRDT invariant prover (``scripts/prove_repo.py``, ``pytest
+-m prove``), which drops below the AST to the traced IR and
+machine-checks the join algebra the kernels' docstrings only assert
+(see the ``PROVE_ROOTS`` registry in ``patrol_tpu/ops/obligations.py``).
 """

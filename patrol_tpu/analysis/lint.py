@@ -248,8 +248,14 @@ def _module_to_relpath(dotted: str) -> str:
 
 
 class _FuncIndex:
-    """(relpath, bare function name) → FunctionDef, plus per-module import
-    resolution for cross-module call-graph edges."""
+    """(relpath, qualified function name) → FunctionDef, plus per-module
+    import resolution for cross-module call-graph edges.
+
+    Module-level functions are keyed by bare name; methods by
+    ``"Class.method"`` (one class level). ``attr_funcs`` records functions
+    stored on instance attributes in ``__init__`` (``self._fn = fn``) so
+    ``self._fn(...)`` call sites resolve — the attribute-chain resolution
+    PTL002 needs for kernels dispatched through instance state."""
 
     def __init__(self, mods: Sequence[Module]):
         self.funcs: Dict[Tuple[str, str], ast.AST] = {}
@@ -257,14 +263,14 @@ class _FuncIndex:
         self.imports: Dict[str, Dict[str, Tuple[str, str]]] = {}
         # relpath → {alias: module relpath} for `import pkg.mod as alias`
         self.mod_aliases: Dict[str, Dict[str, str]] = {}
+        # (relpath, class name) → {attr: resolved (relpath, func key)}
+        self.attr_funcs: Dict[Tuple[str, str], Dict[str, Tuple[str, str]]] = {}
         self.relpaths = {m.relpath for m in mods}
         for m in mods:
             imap: Dict[str, Tuple[str, str]] = {}
             amap: Dict[str, str] = {}
             for node in ast.walk(m.tree):
-                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    self.funcs[(m.relpath, node.name)] = node
-                elif isinstance(node, ast.ImportFrom) and node.module:
+                if isinstance(node, ast.ImportFrom) and node.module:
                     rel = _module_to_relpath(node.module)
                     for a in node.names:
                         if rel in self.relpaths:
@@ -280,14 +286,93 @@ class _FuncIndex:
                             amap[a.asname or a.name] = rel
             self.imports[m.relpath] = imap
             self.mod_aliases[m.relpath] = amap
+        for m in mods:
+            self._collect_funcs(m.relpath, m.tree, None)
+        for m in mods:
+            self._collect_attr_funcs(m)
 
-    def resolve(self, relpath: str, call: ast.Call) -> Optional[Tuple[str, str]]:
+    def _collect_funcs(self, rel: str, node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{cls}.{child.name}" if cls else child.name
+                self.funcs[(rel, key)] = child
+                # Nested defs register under their bare names, as before.
+                self._collect_funcs(rel, child, None)
+            elif isinstance(child, ast.ClassDef):
+                self._collect_funcs(rel, child, child.name)
+            else:
+                self._collect_funcs(rel, child, cls)
+
+    def _collect_attr_funcs(self, m: Module) -> None:
+        rel = m.relpath
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            init = self.funcs.get((rel, f"{node.name}.__init__"))
+            if init is None:
+                continue
+            amap: Dict[str, Tuple[str, str]] = {}
+            for stmt in ast.walk(init):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                value = stmt.value
+                if value is None:
+                    continue
+                tgt = self._resolve_value(rel, node.name, value)
+                if tgt is None:
+                    continue
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        amap[t.attr] = tgt
+            if amap:
+                self.attr_funcs[(rel, node.name)] = amap
+
+    def _resolve_value(
+        self, rel: str, cls: str, v: ast.AST
+    ) -> Optional[Tuple[str, str]]:
+        """A value expression naming a known function (module-level, imported,
+        module-attribute, or a sibling method) → its funcs key."""
+        if isinstance(v, ast.Name):
+            if (rel, v.id) in self.funcs:
+                return (rel, v.id)
+            imp = self.imports.get(rel, {}).get(v.id)
+            if imp and imp in self.funcs:
+                return imp
+        elif isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name):
+            if v.value.id == "self":
+                mkey = (rel, f"{cls}.{v.attr}")
+                if mkey in self.funcs:
+                    return mkey
+            tgt = self.mod_aliases.get(rel, {}).get(v.value.id)
+            if tgt and (tgt, v.attr) in self.funcs:
+                return (tgt, v.attr)
+        return None
+
+    def resolve(
+        self,
+        relpath: str,
+        call: ast.Call,
+        caller: Optional[Tuple[str, str]] = None,
+    ) -> Optional[Tuple[str, str]]:
         f = call.func
         if isinstance(f, ast.Name):
             if (relpath, f.id) in self.funcs:
                 return (relpath, f.id)
             return self.imports.get(relpath, {}).get(f.id)
         if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id == "self" and caller is not None and "." in caller[1]:
+                cname = caller[1].split(".", 1)[0]
+                mkey = (caller[0], f"{cname}.{f.attr}")
+                if mkey in self.funcs:
+                    return mkey
+                tgt = self.attr_funcs.get((caller[0], cname), {}).get(f.attr)
+                if tgt is not None:
+                    return tgt
             target = self.mod_aliases.get(relpath, {}).get(f.value.id)
             if target and (target, f.attr) in self.funcs:
                 return (target, f.attr)
@@ -312,12 +397,14 @@ def _jit_roots(mods: Sequence[Module], index: _FuncIndex) -> Set[Tuple[str, str]
         return False
 
     roots: Set[Tuple[str, str]] = set()
+    # Decorated defs (including methods, keyed "Class.method"): the index
+    # already holds every def under its qualified key.
+    for key, node in index.funcs.items():
+        if any(is_jit(d) for d in node.decorator_list):
+            roots.add(key)
     for m in mods:
         for node in ast.walk(m.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if any(is_jit(d) for d in node.decorator_list):
-                    roots.add((m.relpath, node.name))
-            elif isinstance(node, ast.Call) and is_jit(node.func):
+            if isinstance(node, ast.Call) and is_jit(node.func):
                 for arg in node.args:
                     target = index.resolve(
                         m.relpath, ast.Call(func=arg, args=[], keywords=[])
@@ -357,7 +444,7 @@ def check_jit_sync(mods: Sequence[Module]) -> List[Finding]:
         fn = index.funcs[key]
         for node in ast.walk(fn):
             if isinstance(node, ast.Call):
-                target = index.resolve(key[0], node)
+                target = index.resolve(key[0], node, caller=key)
                 if target and target in index.funcs and target not in seen:
                     reach_from[target] = key
                     frontier.append(target)

@@ -24,7 +24,12 @@ PATH_MAX = 2048  # kPathMax in patrol_http.cpp
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "patrol_host.cpp")
 _SRC_HTTP = os.path.join(_HERE, "patrol_http.cpp")
-_LIB = os.path.join(_HERE, "libpatrolhost.so")
+# PATROL_NATIVE_LIB points the ctypes seam at a prebuilt library instead of
+# the cached in-tree build — the check.sh asan-py stage uses it to load an
+# ASan-instrumented build under LD_PRELOAD=libasan without dirtying the
+# packaged .so.
+_LIB_OVERRIDE = os.environ.get("PATROL_NATIVE_LIB")
+_LIB = _LIB_OVERRIDE or os.path.join(_HERE, "libpatrolhost.so")
 
 _mu = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -40,6 +45,10 @@ _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 
 
 def _build() -> bool:
+    if _LIB_OVERRIDE:
+        # Caller supplied the binary (possibly sanitizer-instrumented);
+        # never overwrite it with a plain build.
+        return os.path.exists(_LIB)
     srcs = [_SRC, _SRC_HTTP]
     if os.path.exists(_LIB) and all(
         os.path.getmtime(_LIB) >= os.path.getmtime(s) for s in srcs

@@ -127,6 +127,105 @@ class TestJitSync:
         assert lint.lint_sources({"patrol_tpu/ops/k.py": src}) == []
 
 
+ATTR_VIOLATION = """
+from functools import partial
+
+import jax
+import numpy as np
+
+
+def pull(x):
+    return np.asarray(x)
+
+
+class Engine:
+    def __init__(self):
+        self._pull = pull
+
+    @partial(jax.jit, static_argnums=0)
+    def step(self, x):
+        return self._pull(x)
+"""
+
+ATTR_FIXED = ATTR_VIOLATION.replace("np.asarray(x)", "x")
+
+
+class TestJitSyncAttrChain:
+    """PTL002 attribute-chain resolution: `self._fn(...)` through instance
+    attributes assigned in __init__, and direct self-method calls."""
+
+    def test_fires_through_instance_attribute(self):
+        f = lint.lint_sources({"patrol_tpu/runtime/e.py": ATTR_VIOLATION})
+        assert codes(f) == ["PTL002"]
+        assert "pull" in f[0].message
+
+    def test_silent_on_fixed_attribute_target(self):
+        assert lint.lint_sources({"patrol_tpu/runtime/e.py": ATTR_FIXED}) == []
+
+    def test_fires_through_self_method_call(self):
+        src = (
+            "from functools import partial\n\nimport jax\n\n\n"
+            "class Engine:\n"
+            "    def _gather(self, x):\n"
+            "        return x.sum().item()\n\n"
+            "    @partial(jax.jit, static_argnums=0)\n"
+            "    def step(self, x):\n"
+            "        return self._gather(x)\n"
+        )
+        f = lint.lint_sources({"patrol_tpu/runtime/e.py": src})
+        assert codes(f) == ["PTL002"]
+        assert "Engine._gather" in f[0].message
+
+    def test_fires_on_imported_function_stored_on_attr(self):
+        helper = "import numpy as np\n\ndef pull(x):\n    return np.asarray(x)\n"
+        eng = (
+            "from functools import partial\n\nimport jax\n"
+            "from patrol_tpu.ops.helper import pull\n\n\n"
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self._fn = pull\n\n"
+            "    @partial(jax.jit, static_argnums=0)\n"
+            "    def step(self, x):\n"
+            "        return self._fn(x)\n"
+        )
+        f = lint.lint_sources(
+            {"patrol_tpu/ops/helper.py": helper, "patrol_tpu/runtime/e.py": eng}
+        )
+        assert codes(f) == ["PTL002"]
+        assert f[0].path == "patrol_tpu/ops/helper.py"
+
+    def test_silent_on_unresolvable_injected_callable(self):
+        # `self.clock = clock` stores a *parameter*: statically unknowable,
+        # must not be guessed into a finding.
+        src = (
+            "from functools import partial\n\nimport jax\n\n\n"
+            "class Engine:\n"
+            "    def __init__(self, clock):\n"
+            "        self.clock = clock\n\n"
+            "    @partial(jax.jit, static_argnums=0)\n"
+            "    def step(self, x):\n"
+            "        return self.clock(x)\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/runtime/e.py": src}) == []
+
+    def test_same_method_name_in_two_classes_distinct(self):
+        # Qualified method keys: a clean class must not inherit findings
+        # from an identically-named method of another class.
+        src = (
+            "from functools import partial\n\nimport jax\n\n\n"
+            "class Dirty:\n"
+            "    def helper(self, x):\n"
+            "        return x.item()\n\n"
+            "class Clean:\n"
+            "    def helper(self, x):\n"
+            "        return x\n\n"
+            "    @partial(jax.jit, static_argnums=0)\n"
+            "    def step(self, x):\n"
+            "        return self.helper(x)\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/runtime/e.py": src}) == []
+
+
 LOCK_VIOLATION = """
 class Engine:
     def bad(self):

@@ -1,0 +1,330 @@
+"""patrol-prove self-tests (PTP001-PTP005).
+
+Every obligation is proven BOTH ways: it fires on a seeded broken kernel
+and stays silent on the shipped ones. The mutation test at the bottom is
+the gate's reason to exist: monkeypatch `merge_dense`'s max into an add —
+the historically-likely refactor mistake — and both prover passes must
+reject it. `TestRepoIsProven` is the `pytest -m prove` slice of the
+scripts/check.sh stage-4 contract.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from patrol_tpu.analysis import prove
+from patrol_tpu.models.limiter import LimiterState
+from patrol_tpu.ops import take as take_mod
+from patrol_tpu.ops.merge import MergeBatch
+from patrol_tpu.ops.obligations import PROVE_ROOTS
+
+pytestmark = pytest.mark.prove
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ROOTS = {r.attr: r for r in PROVE_ROOTS}
+
+
+def codes(findings):
+    return sorted({f.check for f in findings})
+
+
+def scoped(base, *obligations, model="keep"):
+    """A copy of a registry root narrowed to specific obligations (so a
+    fixture isolates exactly one PTP code)."""
+    return dataclasses.replace(
+        base,
+        obligations=tuple(obligations),
+        model=base.model if model == "keep" else model,
+    )
+
+
+# --- seeded broken kernels -------------------------------------------------
+
+
+def add_merge_dense(a, b):
+    """The classic refactor mistake: + where max belongs."""
+    return LimiterState(
+        pn=a.pn + b.pn, elapsed=jnp.maximum(a.elapsed, b.elapsed)
+    )
+
+
+def set_merge_batch(state, batch):
+    """Last-write-wins scatter: order-dependent, non-monotone."""
+    pair = jnp.stack([batch.added_nt, batch.taken_nt], axis=-1)
+    pn = state.pn.at[batch.rows, batch.slots].set(pair)
+    elapsed = state.elapsed.at[batch.rows].max(batch.elapsed_ns)
+    return LimiterState(pn=pn, elapsed=elapsed)
+
+
+def f32_merge_dense(a, b):
+    """f32 creeping into the pn planes."""
+    pn = jnp.maximum(a.pn.astype(jnp.float32), b.pn.astype(jnp.float32))
+    return LimiterState(pn=pn, elapsed=jnp.maximum(a.elapsed, b.elapsed))
+
+
+def narrowed_merge_dense(a, b):
+    """Integer but narrowed: silent truncation at 2^31 nanotokens."""
+    return LimiterState(
+        pn=jnp.maximum(a.pn, b.pn).astype(jnp.int32),
+        elapsed=jnp.maximum(a.elapsed, b.elapsed),
+    )
+
+
+def min_merge_dense(a, b):
+    """Commutative, associative, idempotent — and NOT monotone: the one
+    lattice property min gets wrong (it is the meet, not the join)."""
+    return LimiterState(
+        pn=jnp.minimum(a.pn, b.pn), elapsed=jnp.minimum(a.elapsed, b.elapsed)
+    )
+
+
+def first_wins_merge_dense(a, b):
+    """Keep a's value wherever nonzero: idempotent but not commutative."""
+    pn = jnp.where(a.pn > 0, a.pn, b.pn)
+    elapsed = jnp.where(a.elapsed > 0, a.elapsed, b.elapsed)
+    return LimiterState(pn=pn, elapsed=elapsed)
+
+
+def callback_take(state, req, node_slot):
+    jax.debug.callback(lambda x: None, req.rows)
+    return take_mod.take_batch(state, req, node_slot)
+
+
+def leaky_take(state, req, node_slot):
+    """Writes a lane that is not its own (node_slot+1): a correctness
+    disaster under PN-sum semantics."""
+    out, res = take_mod.take_batch(state, req, node_slot)
+    pair = jnp.stack([req.count_nt, req.count_nt], axis=-1)
+    pn = out.pn.at[req.rows, node_slot + 1].add(pair)
+    return LimiterState(pn=pn, elapsed=out.elapsed), res
+
+
+# --- PTP001: structural lattice / callback pass ----------------------------
+
+
+class TestStructuralPass:
+    def test_fires_on_add_on_merged_plane(self):
+        root = scoped(ROOTS["merge_dense"], "PTP001", model=None)
+        f = prove.prove_root(root, fn=add_merge_dense)
+        assert codes(f) == ["PTP001"]
+        assert "'add'" in f[0].message
+
+    def test_fires_on_overwrite_scatter(self):
+        root = scoped(ROOTS["merge_batch"], "PTP001", model=None)
+        f = prove.prove_root(root, fn=set_merge_batch)
+        assert codes(f) == ["PTP001"]
+        assert "scatter" in f[0].message
+
+    def test_fires_on_float_cast_of_state_plane(self):
+        root = scoped(ROOTS["merge_dense"], "PTP001", model=None)
+        f = prove.prove_root(root, fn=f32_merge_dense)
+        assert codes(f) == ["PTP001"]
+        assert "float cast" in f[0].message
+
+    def test_fires_on_callback_primitive(self):
+        root = scoped(ROOTS["take_batch"], "PTP001", model=None)
+        f = prove.prove_root(root, fn=callback_take)
+        assert codes(f) == ["PTP001"]
+        assert "callback" in f[0].message
+
+    def test_silent_on_shipped_joins(self):
+        for attr in ("merge_batch", "merge_batch_folded", "merge_rows_dense",
+                     "merge_dense", "read_rows"):
+            root = scoped(ROOTS[attr], "PTP001", model=None)
+            assert prove.prove_root(root) == [], attr
+
+    def test_take_local_adds_are_not_flagged(self):
+        # The delta-side profile: take's scatter-add is the point, not a
+        # violation — only callbacks are structural findings there.
+        root = scoped(ROOTS["take_batch"], "PTP001", model=None)
+        assert prove.prove_root(root) == []
+
+    def test_index_math_is_not_tainted(self):
+        # merge_batch's jaxpr contains add/select_n on the *row indices*
+        # (negative-index normalization); taint tracking must not confuse
+        # index math with state-plane math.
+        root = scoped(ROOTS["merge_batch"], "PTP001", model=None)
+        assert prove.prove_root(root) == []
+
+
+# --- PTP002/PTP003/PTP004: the small-domain model checker ------------------
+
+
+class TestModelChecker:
+    def test_commutativity_fires_on_first_wins_join(self):
+        root = scoped(ROOTS["merge_dense"], "PTP002")
+        f = prove.prove_root(root, fn=first_wins_merge_dense)
+        assert "PTP002" in codes(f)
+
+    def test_commutativity_fires_on_overwrite_scatter(self):
+        root = scoped(ROOTS["merge_batch"], "PTP002")
+        f = prove.prove_root(root, fn=set_merge_batch)
+        assert codes(f) == ["PTP002"]
+
+    def test_idempotence_fires_on_add_join(self):
+        root = scoped(ROOTS["merge_dense"], "PTP003")
+        f = prove.prove_root(root, fn=add_merge_dense)
+        assert codes(f) == ["PTP003"]
+        assert "idempotent" in f[0].message
+
+    def test_monotonicity_fires_on_meet_join(self):
+        # min commutes, associates, and is idempotent — the model checker
+        # must still reject it on monotonicity alone.
+        root = scoped(ROOTS["merge_dense"], "PTP004")
+        f = prove.prove_root(root, fn=min_merge_dense)
+        assert codes(f) == ["PTP004"]
+
+    def test_take_monotonicity_fires_on_foreign_lane_write(self):
+        root = scoped(ROOTS["take_batch"], "PTP004")
+        f = prove.prove_root(root, fn=leaky_take)
+        assert codes(f) == ["PTP004"]
+        assert "lane" in f[0].message
+
+    def test_silent_on_shipped_kernels(self):
+        for attr in ("merge_batch", "merge_batch_folded", "merge_rows_dense",
+                     "merge_dense"):
+            root = scoped(ROOTS[attr], "PTP002", "PTP003", "PTP004")
+            assert prove.prove_root(root) == [], attr
+        assert prove.prove_root(scoped(ROOTS["take_batch"], "PTP004")) == []
+
+
+# --- PTP005: dtype/shape stability under jit -------------------------------
+
+
+class TestDtypeStability:
+    def test_fires_on_integer_narrowing(self):
+        # int32 output is NOT a float leak (PTP001 stays silent) but IS a
+        # dtype instability — the two codes separate cleanly.
+        root = scoped(ROOTS["merge_dense"], "PTP005", model=None)
+        f = prove.prove_root(root, fn=narrowed_merge_dense)
+        assert codes(f) == ["PTP005"]
+
+    def test_fires_on_float_output(self):
+        root = scoped(ROOTS["merge_dense"], "PTP005", model=None)
+        f = prove.prove_root(root, fn=f32_merge_dense)
+        assert codes(f) == ["PTP005"]
+        assert "float" in f[0].message
+
+    def test_silent_on_shipped_kernels(self):
+        for attr in ("merge_batch", "merge_batch_folded", "merge_rows_dense",
+                     "merge_dense", "merge_scalar_batch", "read_rows",
+                     "take_batch"):
+            root = scoped(ROOTS[attr], "PTP005", model=None)
+            assert prove.prove_root(root) == [], attr
+
+
+# --- the mutation gate (ISSUE 3 satellite): max -> add on merge_dense ------
+
+
+class TestMutationGate:
+    def test_max_to_add_mutation_is_rejected_by_both_passes(self, monkeypatch):
+        """The historically-likely refactor mistake, end to end: mutate the
+        *registered* kernel and run the root exactly as prove_repo would.
+        The structural pass must flag the add on the merged plane AND the
+        model checker must catch the idempotence break — two independent
+        tripwires for the same bug."""
+        import patrol_tpu.ops.merge as merge_mod
+
+        monkeypatch.setattr(merge_mod, "merge_dense", add_merge_dense)
+        f = prove.prove_root(ROOTS["merge_dense"])  # resolves dynamically
+        got = codes(f)
+        assert "PTP001" in got, f  # pass 1: structural lattice check
+        assert "PTP003" in got, f  # pass 2: small-domain model check
+
+    def test_registry_resolution_is_dynamic(self, monkeypatch):
+        # The registry stores (module, attr), not a function object — the
+        # gate checks what the engine would actually import.
+        import patrol_tpu.ops.merge as merge_mod
+
+        monkeypatch.setattr(merge_mod, "merge_dense", min_merge_dense)
+        f = prove.prove_root(ROOTS["merge_dense"])
+        assert "PTP004" in codes(f)
+
+
+# --- pallas interpret path -------------------------------------------------
+
+
+class TestPallasModel:
+    def test_shipped_pallas_merge_is_silent(self):
+        from patrol_tpu.ops import pallas_merge
+
+        if not pallas_merge.available():
+            pytest.skip("pallas unavailable")
+        assert prove.prove_root(ROOTS["merge_batch_pallas"]) == []
+
+
+# --- suppression + drivers -------------------------------------------------
+
+
+class TestSuppression:
+    def test_ptp_codes_ride_the_lint_directive(self):
+        from patrol_tpu.analysis.lint import Module
+
+        mod = Module(
+            "patrol_tpu/ops/x.py",
+            "a = 1  # patrol-lint: disable=PTP001,PTP004\n",
+        )
+        assert mod.suppressed("PTP001", 1)
+        assert mod.suppressed("PTP004", 1)
+        assert not mod.suppressed("PTP002", 1)
+
+    def test_prove_repo_filters_suppressed_findings(self, tmp_path, monkeypatch):
+        from patrol_tpu.analysis.lint import Finding
+
+        src = tmp_path / "patrol_tpu" / "ops"
+        src.mkdir(parents=True)
+        (src / "fake.py").write_text(
+            "x = 1\ny = 2  # patrol-lint: disable=PTP001\n"
+        )
+        crafted = [
+            Finding("PTP001", "patrol_tpu/ops/fake.py", 1, "kept"),
+            Finding("PTP001", "patrol_tpu/ops/fake.py", 2, "suppressed"),
+        ]
+        monkeypatch.setattr(prove, "prove_all", lambda roots=None: crafted)
+        out = prove.prove_repo(str(tmp_path))
+        assert [f.line for f in out] == [1]
+
+
+class TestRepoIsProven:
+    def test_repo_proves_clean(self):
+        """The stage-4 contract: zero findings on the shipped kernels."""
+        findings = prove.prove_repo(REPO_ROOT)
+        assert findings == [], "\n".join(str(f) for f in findings)
+
+    def test_registry_covers_the_kernel_roots(self):
+        """Guard against a vacuously-clean prover: the CRDT-critical roots
+        must be registered with their full obligation sets."""
+        names = {r.name for r in PROVE_ROOTS}
+        for required in (
+            "ops.merge.merge_batch",
+            "ops.merge.merge_dense",
+            "ops.merge.merge_batch_folded",
+            "ops.merge.merge_rows_dense",
+            "ops.merge.read_rows",
+            "ops.take.take_batch",
+            "ops.rate",
+            "ops.wire.codec",
+            "ops.pallas_merge.merge_batch_pallas",
+        ):
+            assert required in names, required
+        full = set(ROOTS["merge_batch"].obligations)
+        assert full == {"PTP001", "PTP002", "PTP003", "PTP004", "PTP005"}
+
+    def test_every_code_is_declared_somewhere(self):
+        declared = set()
+        for r in PROVE_ROOTS:
+            declared.update(r.obligations)
+        assert declared == set(prove.ALL_CODES)
+
+    def test_scalar_merge_declares_no_join_algebra(self):
+        """merge_scalar_batch is deliberately lossy (deficit attribution):
+        the registry must record that design decision by NOT declaring
+        commutativity/idempotence for it."""
+        obl = set(ROOTS["merge_scalar_batch"].obligations)
+        assert "PTP002" not in obl and "PTP003" not in obl
+        assert "PTP004" in obl
