@@ -99,6 +99,45 @@ SYNC_JAX_FUNCS = {"block_until_ready", "device_get"}
 
 _DIRECTIVE_RE = re.compile(r"#\s*patrol-lint:\s*([A-Za-z0-9=,_\- ]+)")
 
+# ---------------------------------------------------------------------------
+# Cross-boundary effects: the declared per-symbol contract of the native
+# C ABI (patrol_tpu/native/__init__.py::NATIVE_EFFECTS). PTL002 treats a
+# jit-reachable call to a symbol declared `blocks` exactly like .item();
+# PTL003 treats a call to a symbol declared `takes_host_mu` as an
+# acquisition of _host_mu. Loaded by file path so `scripts/lint_repo.py`
+# stays jax-free (importing the patrol_tpu package would pull jax in).
+
+_native_effects_cache: Optional[Dict[str, object]] = None
+
+
+def native_effects() -> Dict[str, object]:
+    """symbol → NativeEffect, from patrol_tpu/native/__init__.py. Empty on
+    any load failure (the boundary checks degrade, the rest still run)."""
+    global _native_effects_cache
+    if _native_effects_cache is not None:
+        return _native_effects_cache
+    try:
+        import sys
+
+        mod = sys.modules.get("patrol_tpu.native")
+        if mod is None:
+            import importlib.util
+
+            path = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "native",
+                "__init__.py",
+            )
+            spec = importlib.util.spec_from_file_location(
+                "_patrol_native_effects", path
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        _native_effects_cache = dict(mod.NATIVE_EFFECTS)
+    except Exception:  # pragma: no cover - numpy-less environments
+        _native_effects_cache = {}
+    return _native_effects_cache
+
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
@@ -449,6 +488,7 @@ def check_jit_sync(mods: Sequence[Module]) -> List[Finding]:
                     reach_from[target] = key
                     frontier.append(target)
 
+    effects = native_effects()
     out: List[Finding] = []
     for relpath, name in sorted(seen):
         m = mod_by_path[relpath]
@@ -462,9 +502,17 @@ def check_jit_sync(mods: Sequence[Module]) -> List[Finding]:
                 continue
             f = node.func
             hit = None
+            kind = "host-device sync"
             if isinstance(f, ast.Attribute):
                 if f.attr in SYNC_ATTRS:
                     hit = f".{f.attr}()"
+                elif f.attr in effects and getattr(effects[f.attr], "blocks"):
+                    # The ctypes boundary is no longer opaque: the native
+                    # effects table declares this symbol blocking (poll/
+                    # condvar/contended-mutex), which on a jit path is the
+                    # same per-tick stall as a forced transfer.
+                    hit = f".{f.attr}()"
+                    kind = "blocking native ABI call"
                 elif isinstance(f.value, ast.Name):
                     if f.value.id in np_aliases[relpath] and f.attr in SYNC_NP_FUNCS:
                         hit = f"{f.value.id}.{f.attr}()"
@@ -479,7 +527,7 @@ def check_jit_sync(mods: Sequence[Module]) -> List[Finding]:
                         "PTL002",
                         relpath,
                         node.lineno,
-                        f"host-device sync {hit} inside {name}(), which is "
+                        f"{kind} {hit} inside {name}(), which is "
                         f"reachable from a jitted take/merge kernel{root_note}",
                     )
                 )
@@ -501,6 +549,7 @@ def _lock_name(expr: ast.AST) -> Optional[str]:
 def check_lock_order(mod: Module) -> List[Finding]:
     out: List[Finding] = []
     rank = {name: i for i, name in enumerate(LOCK_ORDER)}
+    effects = native_effects()
 
     def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
         acquired: List[str] = []
@@ -516,6 +565,16 @@ def check_lock_order(mod: Module) -> List[Finding]:
                 name = _lock_name(f.value)
                 if name is not None:
                     _record(name, node.lineno, held)
+            elif (
+                isinstance(f, ast.Attribute)
+                and f.attr in effects
+                and getattr(effects[f.attr], "takes_host_mu")
+            ):
+                # Declared in the native effects table: this ctypes call
+                # acquires the host-lane store mutex — which IS the
+                # engine's _host_mu — inside the .so. Analyze the call
+                # site as an acquisition of _host_mu.
+                _record("_host_mu", node.lineno, held, via=f.attr)
         new_held = held + tuple(acquired)
         for child in ast.iter_child_nodes(node):
             # Nested defs start a fresh dynamic scope: a closure body does
@@ -529,17 +588,20 @@ def check_lock_order(mod: Module) -> List[Finding]:
         for child in ast.iter_child_nodes(fn):
             walk(child, ())
 
-    def _record(name: str, line: int, held: Tuple[str, ...]) -> None:
+    def _record(
+        name: str, line: int, held: Tuple[str, ...], via: Optional[str] = None
+    ) -> None:
         if mod.suppressed("PTL003", line):
             return
+        how = f" (via native {via}, declared takes_host_mu)" if via else ""
         if name in held:
             out.append(
                 Finding(
                     "PTL003",
                     mod.relpath,
                     line,
-                    f"re-acquiring non-reentrant lock {name} while already "
-                    "holding it (self-deadlock)",
+                    f"re-acquiring non-reentrant lock {name}{how} while "
+                    "already holding it (self-deadlock)",
                 )
             )
             return
@@ -550,10 +612,10 @@ def check_lock_order(mod: Module) -> List[Finding]:
                         "PTL003",
                         mod.relpath,
                         line,
-                        f"acquiring {name} while holding {h}: declared order "
-                        f"is {' -> '.join(LOCK_ORDER)} (outer first); the "
-                        "reverse nesting deadlocks the native front against "
-                        "the feeder",
+                        f"acquiring {name}{how} while holding {h}: declared "
+                        f"order is {' -> '.join(LOCK_ORDER)} (outer first); "
+                        "the reverse nesting deadlocks the native front "
+                        "against the feeder",
                     )
                 )
 
@@ -674,3 +736,29 @@ def repo_sources(root: str) -> Dict[str, str]:
 def lint_repo(root: str) -> List[Finding]:
     """Lint every Python source under <root>/patrol_tpu."""
     return lint_sources(repo_sources(root))
+
+
+def apply_suppressions(
+    findings: Sequence[Finding], repo_root: str
+) -> List[Finding]:
+    """Filter findings through the flagged files' inline ``# patrol-lint:``
+    directives — the shared back half of every repo driver (lint runs the
+    directives during the checks themselves; prove and abi produce
+    findings first and filter here). Files that cannot be read or parsed
+    (e.g. a finding anchored in a .cpp source) keep their findings: a
+    suppression that cannot be located must not silently win."""
+    mods: Dict[str, Optional[Module]] = {}
+    kept: List[Finding] = []
+    for f in findings:
+        if f.path not in mods:
+            path = os.path.join(repo_root, f.path)
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    mods[f.path] = Module(f.path, fh.read())
+            except (OSError, SyntaxError):
+                mods[f.path] = None
+        mod = mods[f.path]
+        if mod is not None and mod.suppressed(f.check, f.line):
+            continue
+        kept.append(f)
+    return kept

@@ -64,7 +64,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from patrol_tpu.analysis.lint import Finding, Module
+from patrol_tpu.analysis.lint import Finding
 
 __all__ = [
     "ProveRoot",
@@ -1081,19 +1081,6 @@ def prove_repo(repo_root: str) -> List[Finding]:
     """Prove every registered root, honoring the lint suppression
     directives in the flagged source files (``# patrol-lint:
     disable=PTP001`` — same machinery, same greppability)."""
-    findings = prove_all()
-    mods: Dict[str, Optional[Module]] = {}
-    kept: List[Finding] = []
-    for f in findings:
-        if f.path not in mods:
-            path = os.path.join(repo_root, f.path)
-            try:
-                with open(path, "r", encoding="utf-8") as fh:
-                    mods[f.path] = Module(f.path, fh.read())
-            except (OSError, SyntaxError):
-                mods[f.path] = None
-        mod = mods[f.path]
-        if mod is not None and mod.suppressed(f.check, f.line):
-            continue
-        kept.append(f)
-    return kept
+    from patrol_tpu.analysis.lint import apply_suppressions
+
+    return apply_suppressions(prove_all(), repo_root)

@@ -13,11 +13,98 @@ import logging
 import os
 import subprocess
 import threading
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 log = logging.getLogger("patrol.native")
+
+
+class NativeEffect(NamedTuple):
+    """Declared cross-boundary effects of one C ABI symbol.
+
+    The Python lint passes cannot see into the .so: a ctypes call that
+    parks the caller on a condition variable (``pt_http_poll``) or takes
+    the host-lane store mutex (``pt_hls_lock`` — the engine's
+    ``_host_mu`` IS that mutex) is invisible to PTL002's sync-in-jit walk
+    and PTL003's lock-order analysis. This table is the boundary
+    contract those passes consume; PTA005 (``analysis/abi.py``) asserts
+    every registered ``lib.pt_*`` symbol has an entry, so the table
+    cannot silently rot as the ABI grows.
+
+    * ``blocks`` — may block the calling thread for scheduling-relevant
+      time: poll/condvar waits, thread create/join, or acquiring a mutex
+      the epoll thread contends (PTL002 treats such a call inside a
+      jit-reachable function exactly like ``.item()``).
+    * ``takes_host_mu`` — acquires the host-lane store mutex internally
+      (or IS the acquisition). PTL003 treats the call site as an
+      acquisition of ``_host_mu``, so the reverse-order nesting under
+      ``_state_mu`` — and a re-acquire while already holding it, which
+      deadlocks against itself — is now a lexical finding.
+    * ``requires_host_mu`` — caller must already hold ``_host_mu`` (the
+      ``*_locked`` family and ``pt_hls_unlock``). The PTA004 schedule
+      explorer uses this to judge lock-protocol legality.
+    * ``callback_safe`` — pure compute on caller-owned buffers: no
+      locks, no syscalls that block, safe from a jax host callback.
+    """
+
+    blocks: bool
+    takes_host_mu: bool
+    requires_host_mu: bool
+    callback_safe: bool
+
+
+_E = NativeEffect
+
+# One entry per ctypes symbol registered in load() below. PTA005
+# (scripts/abi_repo.py, check.sh --stage abi) diffs this table against
+# the argtypes registrations, both ways.
+NATIVE_EFFECTS: Dict[str, NativeEffect] = {
+    # -- UDP replication plane (patrol_host.cpp) --
+    "pt_udp_open": _E(False, False, False, False),
+    "pt_udp_port": _E(False, False, False, False),
+    "pt_udp_close": _E(False, False, False, False),
+    "pt_recv_batch": _E(True, False, False, False),   # poll(timeout_ms)
+    "pt_send_fanout": _E(True, False, False, False),  # POLLOUT stall wait
+    "pt_decode_batch": _E(False, False, False, True),
+    "pt_encode_batch": _E(False, False, False, True),
+    # -- directory / rx fast path --
+    "pt_dir_create": _E(False, False, False, False),
+    "pt_dir_insert": _E(False, False, False, False),
+    "pt_dir_insert_batch": _E(False, False, False, False),
+    "pt_dir_delete": _E(False, False, False, False),
+    "pt_dir_resolve": _E(False, False, False, False),   # needs py dir lock
+    "pt_dir_resolve_rt": _E(False, False, False, False),
+    "pt_rx_classify": _E(False, False, False, False),   # needs py dir lock
+    "pt_dir_destroy": _E(False, False, False, False),
+    "pt_fold_hybrid": _E(True, False, False, False),    # thread fan-out/join
+    # -- HTTP front (patrol_http.cpp) --
+    "pt_http_start": _E(True, False, False, False),     # spawns epoll thread
+    "pt_http_port": _E(False, False, False, False),
+    "pt_http_poll": _E(True, False, False, False),      # condvar wait
+    "pt_http_complete_takes": _E(False, False, False, False),
+    "pt_http_complete_other": _E(False, False, False, False),
+    "pt_http_stats": _E(False, False, False, False),
+    "pt_http_set_h2_backend": _E(False, False, False, False),
+    "pt_http_stop": _E(True, False, False, False),      # joins epoll thread
+    "pt_http_attach_host": _E(True, False, False, False),  # server mu
+    "pt_http_blast": _E(True, False, False, False),
+    "pt_http_blast_h2": _E(True, False, False, False),
+    # -- host-lane store (the engine's _host_mu lives here) --
+    "pt_hls_create": _E(False, False, False, False),
+    "pt_hls_destroy": _E(False, False, False, False),
+    "pt_hls_lock": _E(True, True, False, False),
+    "pt_hls_unlock": _E(False, False, True, False),
+    "pt_hls_host_locked": _E(False, False, True, False),
+    "pt_hls_unhost_locked": _E(False, False, True, False),
+    "pt_hls_drain_locked": _E(False, False, True, False),
+    "pt_hls_stats": _E(True, True, False, False),       # lock_guard st->mu
+    "pt_hls_events": _E(False, False, False, True),     # relaxed atomic read
+    "pt_hls_take_probe": _E(True, True, False, False),  # lock_guard st->mu
+    # -- pure parsing helpers --
+    "pt_parse_rate": _E(False, False, False, True),
+    "pt_parse_duration": _E(False, False, False, True),
+}
 
 PACKET = 256
 PATH_MAX = 2048  # kPathMax in patrol_http.cpp

@@ -33,6 +33,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from patrol_tpu.analysis.abi import AbiObligation
 from patrol_tpu.analysis.prove import JOIN_BATCH_ADAPTERS, ProveRoot, Trace
 from patrol_tpu.models.limiter import LimiterState
 from patrol_tpu.ops.merge import FoldedMergeBatch, MergeBatch, RowDenseBatch
@@ -221,5 +222,38 @@ PROVE_ROOTS: Tuple[ProveRoot, ...] = (
         "ops.pallas_merge.merge_batch_pallas", "patrol_tpu.ops.pallas_merge",
         "merge_batch_pallas", ("PTP002", "PTP003"),
         model="pallas_interpret",
+    ),
+)
+
+
+# --- patrol-abi (stage 5): the NATIVE re-implementations of the joins
+# above, checked through the C ABI itself (analysis/abi.py). Declared
+# HERE for the same reason PROVE_ROOTS is: adding a native fast path
+# without declaring its conformance twin — or dropping a law — is a diff
+# on this file. ``twins`` name the PROVE_ROOTS entries the symbol must
+# stay bit-exact against (resolved dynamically, so a mutated kernel is
+# what gets compared).
+
+ABI_OBLIGATIONS: Tuple[AbiObligation, ...] = (
+    AbiObligation(
+        "native.pt_fold_hybrid", "pt_fold_hybrid",
+        ("PTA001", "PTA002", "PTA003"), "fold_conformance",
+        twins=(
+            "ops.merge.merge_batch",
+            "ops.merge.merge_batch_folded",
+            "ops.merge.merge_rows_dense",
+        ),
+    ),
+    AbiObligation(
+        "native.pt_rx_classify", "pt_rx_classify",
+        ("PTA001", "PTA002", "PTA003"), "classify_conformance",
+        twins=("ops.wire.codec",),
+    ),
+    AbiObligation(
+        "native.hls_schedules", "pt_hls_take_probe", ("PTA004",),
+        "hls_interleavings",
+    ),
+    AbiObligation(
+        "native.effects_table", None, ("PTA005",), "effects_table",
     ),
 )

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # patrol-check: the repo-wide static-analysis + sanitizer + prover gate.
 #
-# One command, one pass/fail exit code, four stages (plus one opt-in):
+# One command, one pass/fail exit code, five stages (plus one opt-in):
 #
 #   lint    — repo-specific AST checks over patrol_tpu/ (clock seams,
 #             jit-reachable sync primitives, lock order, nanotoken dtype
@@ -21,6 +21,15 @@
 #             structural lattice check + exhaustive small-domain model
 #             check over every registered kernel root, plus the
 #             pytest -m prove fixture self-tests.
+#   abi     — patrol-abi: the native-ABI conformance prover + cross-
+#             boundary concurrency lint (patrol_tpu/analysis/abi.py,
+#             scripts/abi_repo.py): pt_fold_hybrid / pt_rx_classify
+#             driven through ctypes over the prove lattice domains and
+#             checked bit-exact against the registered jax kernel roots
+#             (incl. the merge laws on the native side), the host-lane
+#             store schedule explorer, and the NATIVE_EFFECTS
+#             completeness check; plus the pytest -m abi self-tests.
+#             Skips LOUDLY (exit 77) when libpatrolhost cannot build.
 #   asan-py — OPT-IN (never in the default set; select explicitly with
 #             --stage): the ctypes-facing pytest subset under
 #             LD_PRELOAD=libasan with an ASan-instrumented
@@ -32,24 +41,24 @@
 # Stage selection:   check.sh --stage lint,prove     # <10 s fast path
 #                    check.sh --stage asan-py        # the opt-in seam check
 # The final line is machine-readable so an outer CI can assert that no
-# stage silently skipped:
-#                    PATROL_CHECK stages=4 pass=3 skip=1 fail=0 skipped=tidy failed=-
+# stage silently skipped (scripts/ci_gate.sh does exactly that):
+#                    PATROL_CHECK stages=5 pass=4 skip=1 fail=0 skipped=tidy failed=-
 #
 # Prereqs and the lint/prove suppression format are documented in
 # README.md ("patrol-check").
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-DEFAULT_STAGES="lint,tidy,san,prove"
+DEFAULT_STAGES="lint,tidy,san,prove,abi"
 STAGES="$DEFAULT_STAGES"
 while [[ $# -gt 0 ]]; do
   case "$1" in
     --stage|--stages) STAGES="$2"; shift 2 ;;
     --stage=*|--stages=*) STAGES="${1#*=}"; shift ;;
     -h|--help)
-      sed -n '2,40p' "$0" | sed 's/^# \{0,1\}//'
+      sed -n '2,52p' "$0" | sed 's/^# \{0,1\}//'
       exit 0 ;;
-    *) echo "unknown argument: $1 (try --stage lint,tidy,san,prove,asan-py)" >&2
+    *) echo "unknown argument: $1 (try --stage lint,tidy,san,prove,abi,asan-py)" >&2
        exit 2 ;;
   esac
 done
@@ -129,6 +138,20 @@ stage_prove() (
   fi
 )
 
+stage_abi() (
+  set -euo pipefail
+  echo "== patrol-check [abi] native-ABI conformance prover =="
+  # abi_repo.py exits 77 itself when libpatrolhost cannot load — the
+  # stage skips LOUDLY instead of vacuously passing.
+  python scripts/abi_repo.py
+  if have_pytest; then
+    env JAX_PLATFORMS=cpu python -m pytest tests/test_abi.py -q -m abi \
+      -p no:cacheprovider
+  else
+    echo "pytest unavailable: abi self-tests skipped (prover itself ran)"
+  fi
+)
+
 stage_asan_py() (
   set -euo pipefail
   echo "== patrol-check [asan-py] ctypes seam under LD_PRELOAD=libasan =="
@@ -192,11 +215,11 @@ run_stage() {
 IFS=',' read -r -a SELECTED <<<"$STAGES"
 for s in "${SELECTED[@]}"; do
   case "$s" in
-    lint|tidy|san|prove|asan-py) ;;
-    *) echo "unknown stage: '$s' (valid: lint tidy san prove asan-py)" >&2; exit 2 ;;
+    lint|tidy|san|prove|abi|asan-py) ;;
+    *) echo "unknown stage: '$s' (valid: lint tidy san prove abi asan-py)" >&2; exit 2 ;;
   esac
 done
-for s in lint tidy san prove asan-py; do
+for s in lint tidy san prove abi asan-py; do
   for sel in "${SELECTED[@]}"; do
     if [[ "$sel" == "$s" ]]; then
       case "$s" in
@@ -204,6 +227,7 @@ for s in lint tidy san prove asan-py; do
         tidy)    run_stage tidy    stage_tidy ;;
         san)     run_stage san     stage_san ;;
         prove)   run_stage prove   stage_prove ;;
+        abi)     run_stage abi     stage_abi ;;
         asan-py) run_stage asan-py stage_asan_py ;;
       esac
     fi

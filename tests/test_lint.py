@@ -226,6 +226,115 @@ class TestJitSyncAttrChain:
         assert lint.lint_sources({"patrol_tpu/runtime/e.py": src}) == []
 
 
+NATIVE_BLOCK_VIOLATION = """
+import jax
+
+from patrol_tpu import native
+
+lib = native.load()
+
+
+@jax.jit
+def kernel(x):
+    lib.pt_http_poll(0)
+    return x + 1
+"""
+
+
+class TestJitSyncNativeBoundary:
+    """The effects-table closure of the ctypes boundary gap (ROADMAP:
+    'a ctypes call that blocks is invisible'): a jit-reachable function
+    calling a symbol declared blocks=True in NATIVE_EFFECTS now produces
+    a PTL002 finding."""
+
+    def test_fires_on_blocking_native_call_in_jit_root(self):
+        f = lint.lint_sources({"patrol_tpu/ops/k.py": NATIVE_BLOCK_VIOLATION})
+        assert codes(f) == ["PTL002"]
+        assert "pt_http_poll" in f[0].message
+        assert "blocking native ABI call" in f[0].message
+
+    def test_fires_through_the_call_graph(self):
+        src = (
+            "import jax\n\nfrom patrol_tpu import native\n\n"
+            "lib = native.load()\n\n\n"
+            "def poll_front(h):\n"
+            "    return lib.pt_http_poll(h)\n\n\n"
+            "@jax.jit\ndef kernel(x):\n"
+            "    poll_front(0)\n    return x\n"
+        )
+        f = lint.lint_sources({"patrol_tpu/ops/k.py": src})
+        assert codes(f) == ["PTL002"]
+        assert "poll_front" in f[0].message
+
+    def test_silent_on_nonblocking_native_call(self):
+        # pt_hls_events is a relaxed atomic read (blocks=False): the
+        # boundary check must consume the declared effect, not pattern-
+        # match every pt_* call into a finding.
+        src = (
+            "import jax\n\nfrom patrol_tpu import native\n\n"
+            "lib = native.load()\n\n\n"
+            "@jax.jit\ndef kernel(x):\n"
+            "    lib.pt_hls_events(0)\n    return x\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/ops/k.py": src}) == []
+
+    def test_silent_outside_jit_reachability(self):
+        # The pump may block on pt_http_poll freely: it is host-side code.
+        src = (
+            "from patrol_tpu import native\n\n"
+            "lib = native.load()\n\n\n"
+            "def pump(h):\n"
+            "    return lib.pt_http_poll(h)\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/net/k.py": src}) == []
+
+
+class TestLockOrderNativeBoundary:
+    """PTL003 through the boundary: symbols declared takes_host_mu are
+    acquisitions of _host_mu at the call site."""
+
+    def test_fires_on_native_lock_under_state_mu(self):
+        src = (
+            "class E:\n    def bad(self):\n"
+            "        with self._state_mu:\n"
+            "            self.lib.pt_hls_lock(self.h)\n"
+        )
+        f = lint.lint_sources({"patrol_tpu/runtime/e.py": src})
+        assert codes(f) == ["PTL003"]
+        assert "pt_hls_lock" in f[0].message
+        assert "_host_mu" in f[0].message
+
+    def test_fires_on_native_stats_while_holding_host_mu(self):
+        # pt_hls_stats takes the SAME st->mu the engine's _host_mu wraps:
+        # calling it under `with self._host_mu` deadlocks against itself.
+        src = (
+            "class E:\n    def bad(self):\n"
+            "        with self._host_mu:\n"
+            "            self.lib.pt_hls_stats(self.h, self.buf)\n"
+        )
+        f = lint.lint_sources({"patrol_tpu/runtime/e.py": src})
+        assert codes(f) == ["PTL003"]
+        assert "re-acquiring" in f[0].message
+
+    def test_silent_on_locked_family_under_host_mu(self):
+        # The *_locked family REQUIRES the held mutex (requires_host_mu,
+        # not takes_host_mu): the legitimate pattern must stay clean.
+        src = (
+            "class E:\n    def good(self):\n"
+            "        with self._host_mu:\n"
+            "            self.lib.pt_hls_drain_locked(self.h)\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/runtime/e.py": src}) == []
+
+    def test_silent_on_bare_native_lock(self):
+        # NativeHostMutex.__enter__'s own pt_hls_lock call holds nothing.
+        src = (
+            "class M:\n    def __enter__(self):\n"
+            "        self._lib.pt_hls_lock(self._h)\n        return self\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/runtime/m.py": src}) == []
+
+
 LOCK_VIOLATION = """
 class Engine:
     def bad(self):

@@ -95,6 +95,38 @@ def callback_take(state, req, node_slot):
     return take_mod.take_batch(state, req, node_slot)
 
 
+def scan_add_merge_dense(a, b):
+    """The add hides inside a lax.scan body: the taint walk's conservative
+    control-flow handling (taint every sub-jaxpr input) must still see it."""
+    def body(pn, xs):
+        return pn + xs, jnp.int64(0)
+
+    pn, _ = jax.lax.scan(body, a.pn, b.pn[None])
+    return LimiterState(pn=pn, elapsed=jnp.maximum(a.elapsed, b.elapsed))
+
+
+def while_add_merge_dense(a, b):
+    """Same, through lax.while_loop: one trip whose body accumulates."""
+    def cond(c):
+        return c[1] < 1
+
+    def body(c):
+        return (c[0] + b.pn, c[1] + 1)
+
+    pn, _ = jax.lax.while_loop(cond, body, (a.pn, jnp.int64(0)))
+    return LimiterState(pn=pn, elapsed=jnp.maximum(a.elapsed, b.elapsed))
+
+
+def scan_max_merge_dense(a, b):
+    """Control flow whose body stays on the join allowlist: conservative
+    must not mean trigger-happy."""
+    def body(pn, xs):
+        return jnp.maximum(pn, xs), jnp.int64(0)
+
+    pn, _ = jax.lax.scan(body, a.pn, b.pn[None])
+    return LimiterState(pn=pn, elapsed=jnp.maximum(a.elapsed, b.elapsed))
+
+
 def leaky_take(state, req, node_slot):
     """Writes a lane that is not its own (node_slot+1): a correctness
     disaster under PN-sum semantics."""
@@ -150,6 +182,28 @@ class TestStructuralPass:
         # index math with state-plane math.
         root = scoped(ROOTS["merge_batch"], "PTP001", model=None)
         assert prove.prove_root(root) == []
+
+
+class TestConservativeControlFlow:
+    """ROADMAP gap closed: PTP001's scan/while handling (taint the whole
+    sub-jaxpr) finally has fixtures exercising it — a disallowed primitive
+    INSIDE a loop body carrying a state plane must fire, and an
+    allowlisted body must not."""
+
+    def test_fires_on_add_inside_scan_body(self):
+        root = scoped(ROOTS["merge_dense"], "PTP001", model=None)
+        f = prove.prove_root(root, fn=scan_add_merge_dense)
+        assert codes(f) == ["PTP001"]
+        assert any("'add'" in x.message for x in f)
+
+    def test_fires_on_add_inside_while_body(self):
+        root = scoped(ROOTS["merge_dense"], "PTP001", model=None)
+        f = prove.prove_root(root, fn=while_add_merge_dense)
+        assert codes(f) == ["PTP001"]
+
+    def test_silent_on_max_only_scan_body(self):
+        root = scoped(ROOTS["merge_dense"], "PTP001", model=None)
+        assert prove.prove_root(root, fn=scan_max_merge_dense) == []
 
 
 # --- PTP002/PTP003/PTP004: the small-domain model checker ------------------
