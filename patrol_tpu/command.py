@@ -156,6 +156,8 @@ class Command:
         )
 
         def stats() -> dict:
+            from patrol_tpu.utils import profiling
+
             return {
                 "engine_ticks": engine.ticks,
                 "engine_evictions": engine.evictions,
@@ -167,6 +169,9 @@ class Command:
                 "engine_demotions": engine.demotions,
                 "buckets": len(engine.directory),
                 "node_slot": slots.self_slot,
+                # Device-commit pipeline counters (staging reuse, commit
+                # coalescing, dispatch-ahead depth, rx staging).
+                **profiling.COUNTERS.snapshot(),
                 **replicator.stats(),
             }
 

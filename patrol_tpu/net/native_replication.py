@@ -27,6 +27,7 @@ import numpy as np
 from patrol_tpu import native
 from patrol_tpu.ops import wire
 from patrol_tpu.net.replication import ReplyGate, SlotTable, parse_addr, _resolve
+from patrol_tpu.utils import profiling
 
 log = logging.getLogger("patrol.native-replication")
 
@@ -78,10 +79,33 @@ class NativeReplicator:
         # to/from that peer (partition simulation). Settable at runtime.
         self.drop_addr = None
         self._stopped = threading.Event()
+        # Reused rx staging (device-commit pipeline): the slot/flag planes
+        # the engine's ingest consumes are refilled into per-replicator
+        # buffers instead of fresh per-batch allocations — safe because
+        # every ingest path copies out of them (fancy-indexed chunk
+        # slices) before queueing, and this thread is their only writer.
+        self._slots_staging = np.empty(1024, np.int64)
+        self._nt_staging = np.empty(1024, bool)
         self._rx_thread = threading.Thread(
             target=self._rx_loop, name="patrol-native-rx", daemon=True
         )
         self._rx_thread.start()
+
+    def _stage_slots(self, n: int, raw_slots: np.ndarray) -> np.ndarray:
+        """Fill the reused int64 slot staging plane from the decoder's
+        raw slot column; grows (rarely — recv batches are ≤512) by
+        doubling. Returns the live [:n] view."""
+        if self._slots_staging.shape[0] < n:
+            size = self._slots_staging.shape[0]
+            while size < n:
+                size <<= 1
+            self._slots_staging = np.empty(size, np.int64)
+            self._nt_staging = np.empty(size, bool)
+        else:
+            profiling.COUNTERS.inc("rx_staging_reuse_hits")
+        slots = self._slots_staging[:n]
+        np.copyto(slots, raw_slots[:n], casting="unsafe")
+        return slots
 
     # -- receive path -------------------------------------------------------
 
@@ -132,8 +156,10 @@ class NativeReplicator:
             # Slot resolution: a valid trailer carries the slot; otherwise
             # (v1 reference peer) resolve by sender address — per unique
             # address, peers are few. Unresolvable ⇒ dropped (slot −1).
-            slots = dbuf.slots[:n].astype(np.int64)
-            no_trailer = slots < 0
+            # Both planes live in reused staging, not fresh arrays: the
+            # engine hands copies to its queue, never these views.
+            slots = self._stage_slots(n, dbuf.slots)
+            no_trailer = np.less(slots, 0, out=self._nt_staging[:n])
             need = deltas & (
                 no_trailer | (slots >= self.slots.max_slots)
             )
@@ -148,7 +174,7 @@ class NativeReplicator:
             slots[~deltas] = -1  # the classify keep-filter drops these
             if deltas.any():
                 self.repo.engine.ingest_wire_batch(
-                    dbuf, n, slots, no_trailer.astype(np.uint8)
+                    dbuf, n, slots, no_trailer.view(np.uint8)
                 )
             if multi2.any():
                 for i in np.flatnonzero(multi2):

@@ -1,0 +1,135 @@
+"""Coalesced device-commit kernel — the device half of the commit
+pipeline between the engine's drain threads and device state.
+
+The r05 bench showed the host fold pipeline sustaining 6.6M deltas/s
+while end-to-end ingest collapsed to 375k/s: the CRDT join was never the
+wall, the host→device commit path was — one blocking transfer plus one
+dispatch per drained block (~5 MB/s effective on a remote-execute
+transport). Delta-state CRDTs exist precisely so joins can be batched
+and shipped lazily (Almeida et al., arXiv:1410.2803); this module is the
+kernel that cashes that in: K pending delta blocks fold into ONE
+donated, fixed-shape dispatch instead of K, exploiting the join
+commutativity/idempotence patrol-prove certifies (PTP002/PTP003 on
+``ops.commit.commit_blocks`` in ``ops/obligations.py::PROVE_ROOTS``).
+
+Shape discipline: a commit is an int64[6, J, K] **block ring** — J
+blocks of K = ``MAX_MERGE_ROWS`` folded pairs each, the flattened view
+lexicographically sorted and unique with out-of-bounds sentinel padding
+(the exact :class:`patrol_tpu.ops.merge.FoldedMergeBatch` contract,
+extended across blocks). J is padded to a power of two so the jit
+variant count stays logarithmic, and the host side packs into reusable
+staging buffers (engine.StagingPool) shipped with ``jax.device_put``
+*before* the state lock, so transfer overlaps the previous tick's
+compute instead of serializing inside the jit call.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from patrol_tpu.models.limiter import LimiterState
+from patrol_tpu.ops.merge import FOLD_PAD_ROW
+
+
+class CommitBlocks(NamedTuple):
+    """J fixed-shape blocks of host-folded merge pairs, committed in one
+    dispatch. Invariants maintained by :func:`pack_commit_blocks`:
+
+    * the FLATTENED (row, slot) keys are lexicographically sorted and
+      strictly unique (live pairs are one cross-block fold's output;
+      padding keys are out-of-bounds sentinels appended after the live
+      span), so the scatter asserts ``unique_indices`` +
+      ``indices_are_sorted`` truthfully — same contract as
+      :class:`patrol_tpu.ops.merge.FoldedMergeBatch`, per block ring;
+    * ``erows``/``elapsed_ns`` carry the per-unique-row elapsed fold
+      under the same discipline;
+    * padding rows are ≥ ``FOLD_PAD_ROW`` and dropped by ``mode="drop"``.
+    """
+
+    rows: jax.Array  # int32[J, K] flattened-sorted
+    slots: jax.Array  # int32[J, K]
+    added_nt: jax.Array  # int64[J, K]
+    taken_nt: jax.Array  # int64[J, K]
+    erows: jax.Array  # int32[J, K] flattened-sorted, unique-per-live-row
+    elapsed_ns: jax.Array  # int64[J, K]
+
+
+def commit_blocks(state: LimiterState, blocks: CommitBlocks) -> LimiterState:
+    """Fold a whole block ring into state as ONE pair of flagged
+    scatter-max updates — the padded-superbatch form of K sequential
+    ``merge_batch`` dispatches, exact because the join is commutative
+    and idempotent (delivery order across blocks cannot matter)."""
+    rows = blocks.rows.reshape(-1)
+    slots = blocks.slots.reshape(-1)
+    pair = jnp.stack(
+        [blocks.added_nt.reshape(-1), blocks.taken_nt.reshape(-1)], axis=-1
+    )
+    pn = state.pn.at[rows, slots].max(
+        pair, unique_indices=True, indices_are_sorted=True, mode="drop"
+    )
+    elapsed = state.elapsed.at[blocks.erows.reshape(-1)].max(
+        blocks.elapsed_ns.reshape(-1),
+        unique_indices=True,
+        indices_are_sorted=True,
+        mode="drop",
+    )
+    return LimiterState(pn=pn, elapsed=elapsed)
+
+
+commit_blocks_jit = partial(jax.jit, donate_argnums=0)(commit_blocks)
+
+
+def commit_shape(n_pairs: int, block_rows: int) -> Tuple[int, int, int]:
+    """The staging-buffer shape for a fold of ``n_pairs`` pairs: (6, J,
+    block_rows) with J the smallest power of two whose ring holds the
+    fold — the shape key the engine's StagingPool recycles on."""
+    j = 1
+    while j * block_rows < n_pairs:
+        j <<= 1
+    return (6, j, block_rows)
+
+
+def pack_commit_blocks(
+    ur: np.ndarray,
+    us: np.ndarray,
+    ua: np.ndarray,
+    ut: np.ndarray,
+    er: np.ndarray,
+    e: np.ndarray,
+    block_rows: int,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Pack one cross-block fold (sorted unique pairs + per-row elapsed,
+    engine._fold_core's output) into the int64[6, J, K] commit matrix.
+    ``out``, when given, is a staging buffer of exactly
+    :func:`commit_shape`'s shape (leased from the engine pool and
+    refilled in place). Sentinel tail mirrors engine._pack_folded: rows
+    above every live row keep the flattened keys sorted, distinct
+    slots/rows keep them unique, ``mode="drop"`` discards them."""
+    n, ne = len(ur), len(er)
+    if out is None:
+        out = np.empty(commit_shape(n, block_rows), dtype=np.int64)
+    elif out.shape[0] != 6 or out.shape[1] * out.shape[2] < n:
+        raise ValueError(
+            f"staging buffer shape {tuple(out.shape)} cannot hold {n} pairs"
+        )
+    k = out.shape[1] * out.shape[2]
+    flat = out.reshape(6, k)
+    flat[0, :n] = ur
+    flat[1, :n] = us
+    flat[2, :n] = ua
+    flat[3, :n] = ut
+    flat[0, n:] = FOLD_PAD_ROW
+    flat[1, n:] = np.arange(k - n)
+    flat[2, n:] = 0
+    flat[3, n:] = 0
+    flat[4, :ne] = er
+    flat[5, :ne] = e
+    flat[4, ne:] = FOLD_PAD_ROW + np.arange(k - ne)
+    flat[5, ne:] = 0
+    return out

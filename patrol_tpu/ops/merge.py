@@ -32,6 +32,13 @@ from jax import lax
 
 from patrol_tpu.models.limiter import ADDED, TAKEN, LimiterState
 
+# Sentinel row for fold/commit padding, shared by the engine's tick fold
+# (FoldedMergeBatch / RowDenseBatch) and the coalesced commit ring
+# (ops/commit.py): far above any bucket row (pools are ≤ ~2^24 rows) yet
+# int32-safe after a +arange uniquifier; every scatter that sees it runs
+# with ``mode="drop"``.
+FOLD_PAD_ROW = 1 << 30
+
 
 class MergeBatch(NamedTuple):
     """K replication deltas. Padding rows use (row=0, slot=0, zeros): state

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from patrol_tpu.analysis.abi import AbiObligation
 from patrol_tpu.analysis.prove import JOIN_BATCH_ADAPTERS, ProveRoot, Trace
 from patrol_tpu.models.limiter import LimiterState
+from patrol_tpu.ops.commit import CommitBlocks
 from patrol_tpu.ops.merge import FoldedMergeBatch, MergeBatch, RowDenseBatch
 
 _S = jax.ShapeDtypeStruct
@@ -83,6 +84,24 @@ def _trace_merge_batch_folded(fn) -> Trace:
         elapsed_ns=_vec(jnp.int64),
     )
     return _mk_trace(fn, _state(), batch)
+
+
+def _trace_commit_blocks(fn) -> Trace:
+    # Two-block ring: the commit kernel's shape class is [J, K], and a
+    # J > 1 trace pins the flatten-then-scatter structure the block ring
+    # relies on (a J=1 trace would also pass for a per-block loop).
+    def _mat(dtype):
+        return _S((2, _K), dtype)
+
+    blocks = CommitBlocks(
+        rows=_mat(jnp.int32),
+        slots=_mat(jnp.int32),
+        added_nt=_mat(jnp.int64),
+        taken_nt=_mat(jnp.int64),
+        erows=_mat(jnp.int32),
+        elapsed_ns=_mat(jnp.int64),
+    )
+    return _mk_trace(fn, _state(), blocks)
 
 
 def _trace_merge_rows_dense(fn) -> Trace:
@@ -155,6 +174,20 @@ def _as_folded_batch(d) -> FoldedMergeBatch:
     )
 
 
+def _as_commit_blocks(d) -> CommitBlocks:
+    # J=1, K=1 ring: the asserted sorted/unique flags are trivially true,
+    # and the model checker's order/duplication grids become exactly the
+    # cross-block coalesce-order question (blocks are delta sets).
+    return CommitBlocks(
+        rows=d[0].astype(jnp.int32)[None, None],
+        slots=d[1].astype(jnp.int32)[None, None],
+        added_nt=d[2][None, None],
+        taken_nt=d[3][None, None],
+        erows=d[0].astype(jnp.int32)[None, None],
+        elapsed_ns=d[4][None, None],
+    )
+
+
 def _as_rows_dense_batch(d) -> RowDenseBatch:
     # One-hot lane window: the delta's (added, taken) in its slot, zeros —
     # the join identity on the non-negative domain — everywhere else.
@@ -170,6 +203,7 @@ JOIN_BATCH_ADAPTERS.update(
     merge_batch=_as_merge_batch,
     folded=_as_folded_batch,
     rows_dense=_as_rows_dense_batch,
+    commit_blocks=_as_commit_blocks,
 )
 
 _ALL = ("PTP001", "PTP002", "PTP003", "PTP004", "PTP005")
@@ -189,6 +223,11 @@ PROVE_ROOTS: Tuple[ProveRoot, ...] = (
         "ops.merge.merge_rows_dense", "patrol_tpu.ops.merge",
         "merge_rows_dense", _ALL, structural="join",
         model="join_batch:rows_dense", tracer=_trace_merge_rows_dense,
+    ),
+    ProveRoot(
+        "ops.commit.commit_blocks", "patrol_tpu.ops.commit",
+        "commit_blocks", _ALL, structural="join",
+        model="join_batch:commit_blocks", tracer=_trace_commit_blocks,
     ),
     ProveRoot(
         "ops.merge.merge_dense", "patrol_tpu.ops.merge", "merge_dense",

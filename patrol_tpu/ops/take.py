@@ -33,6 +33,16 @@ from patrol_tpu.models.limiter import ADDED, TAKEN, NANO, LimiterState
 # applied after, in exact int64).
 _GRANT_CLIP = float(2**62)
 
+# Packed-transfer layout of one take tick (engine._apply_takes ↔
+# engine._jit_take_packed): the host ships ONE int64[TAKE_PACK_ROWS, K]
+# request matrix (rows, now_ns, freq, per_ns, count_nt, nreq,
+# cap_base_nt, created_ns) and receives ONE int64[TAKE_RESULT_ROWS, K]
+# result matrix (have, admitted, own_added, own_taken, elapsed,
+# sum_added, sum_taken). Fixed shapes per padded K, so the engine's
+# StagingPool recycles the exact request buffers across ticks.
+TAKE_PACK_ROWS = 8
+TAKE_RESULT_ROWS = 7
+
 
 class TakeRequest(NamedTuple):
     """A microbatch of K take requests. All arrays have leading dim K.

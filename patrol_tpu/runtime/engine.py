@@ -42,6 +42,7 @@ import numpy as np
 
 from patrol_tpu.models.limiter import NANO, LimiterConfig, LimiterState, init_state
 from patrol_tpu.utils import profiling
+from patrol_tpu.ops import commit as commit_mod
 from patrol_tpu.ops import merge as merge_mod
 from patrol_tpu.ops import wire
 from patrol_tpu.ops.merge import (
@@ -52,7 +53,12 @@ from patrol_tpu.ops.merge import (
     zero_rows_jit,
 )
 from patrol_tpu.ops.rate import Rate
-from patrol_tpu.ops.take import TakeRequest, take_batch, remaining_for_request
+from patrol_tpu.ops.take import (
+    TAKE_PACK_ROWS,
+    TakeRequest,
+    take_batch,
+    remaining_for_request,
+)
 from patrol_tpu.runtime.bucket import ClockFn, system_clock
 from patrol_tpu.runtime.directory import BucketDirectory, DirectoryFullError
 
@@ -67,8 +73,60 @@ MAX_TAKE_ROWS = 4096
 # variant per power-of-two up to the cap; the env knob lets the replay
 # bench trade warmup variants for tick size without forking the engine.
 MAX_MERGE_ROWS = int(os.environ.get("PATROL_MAX_MERGE_ROWS", 8192))
+# Device-commit pipeline (r6): how many MAX_MERGE_ROWS blocks one engine
+# tick may drain and fold into a SINGLE donated commit dispatch
+# (ops/commit.py). The r05 drain paid one transfer + one dispatch per
+# block (~5 MB/s effective on the remote-execute transport, 18.5 s of
+# ingest_device_drain_ms for 10M deltas); coalescing K blocks into one
+# dispatch divides the per-dispatch constant by K and lets the staged
+# transfer overlap the previous tick's compute.
+COMMIT_BLOCKS = max(1, int(os.environ.get("PATROL_COMMIT_BLOCKS", 4)))
+# In-flight device ticks the feeder may dispatch ahead of the completer
+# (the completion-queue bound). > 1 keeps a tick queued on the device
+# while the completer blocks reading the previous tick's results; the
+# bound back-pressures the feeder so a slow completer can't buffer
+# device results without limit.
+DISPATCH_AHEAD = max(2, int(os.environ.get("PATROL_DISPATCH_AHEAD", 8)))
 
 BroadcastFn = Callable[[List[wire.WireState]], None]
+
+
+class StagingPool:
+    """Shape-bucketed reusable host staging buffers for packed device
+    commits (the pinned-buffer half of the device-commit pipeline).
+
+    ``lease()`` pops a recycled int64 buffer for a shape (or allocates on
+    miss); ``release()`` returns it. The release contract is the caller's:
+    a buffer may only come back once its shipped transfer is READY —
+    ``jax.block_until_ready`` on the ``device_put`` result for merge
+    commits (device_put copies, it never aliases the host source, so
+    operand readiness means the host bytes are refillable), or the
+    result readback for take ticks (compute done ⇒ operand consumed on
+    any backend). Bounded per shape so a burst can't pin unbounded host
+    memory."""
+
+    __slots__ = ("_free", "_mu", "_max_per_shape")
+
+    def __init__(self, max_per_shape: int = 8):
+        self._free: Dict[tuple, list] = {}
+        self._mu = threading.Lock()
+        self._max_per_shape = max_per_shape
+
+    def lease(self, shape) -> np.ndarray:
+        key = tuple(shape)
+        with self._mu:
+            stack = self._free.get(key)
+            if stack:
+                profiling.COUNTERS.inc("staging_reuse_hits")
+                return stack.pop()
+        profiling.COUNTERS.inc("staging_leases_fresh")
+        return np.empty(key, dtype=np.int64)
+
+    def release(self, buf: np.ndarray) -> None:
+        with self._mu:
+            stack = self._free.setdefault(buf.shape, [])
+            if len(stack) < self._max_per_shape:
+                stack.append(buf)
 
 # Host fast path (SURVEY §7 hard-part #1; VERDICT r3 item 1): serve
 # cold/low-QPS buckets from an in-process scalar-lane model — µs-class, no
@@ -305,10 +363,10 @@ class DeltaArrays(NamedTuple):
         return len(self.rows)
 
 
-# Sentinel row for fold-padding: far above any bucket row (pools are
-# ≤ ~2^24 rows) yet int32-safe after the +arange(k) uniquifier. Scatters
-# drop it via mode="drop" (ops/merge.py merge_batch_folded).
-_FOLD_PAD_ROW = 1 << 30
+# Sentinel row for fold-padding — canonical definition lives with the
+# kernels (ops/merge.py FOLD_PAD_ROW, shared with ops/commit.py); the
+# underscore alias keeps this module's historical name importable.
+_FOLD_PAD_ROW = merge_mod.FOLD_PAD_ROW
 
 # Fold-to-dense hybrid: a tick row touching at least this many lanes
 # commits its full lane plane as ONE row-window scatter update instead of
@@ -547,6 +605,27 @@ def _jit_merge_packed_folded():
 
 
 @lru_cache(maxsize=8)
+def _jit_commit_packed():
+    """Coalesced block-ring commit (ops/commit.py): one int64[6, J, K]
+    staged matrix → one donated dispatch folding every block. Only valid
+    for matrices prepared by :func:`patrol_tpu.ops.commit.pack_commit_blocks`
+    (flattened-sorted unique keys, sentinel padding)."""
+
+    def step(state, packed):
+        blocks = commit_mod.CommitBlocks(
+            rows=packed[0].astype(jnp.int32),
+            slots=packed[1].astype(jnp.int32),
+            added_nt=packed[2],
+            taken_nt=packed[3],
+            erows=packed[4].astype(jnp.int32),
+            elapsed_ns=packed[5],
+        )
+        return commit_mod.commit_blocks(state, blocks)
+
+    return jax.jit(step, donate_argnums=0)
+
+
+@lru_cache(maxsize=8)
 def _jit_merge_rows_dense():
     """Row-window scatter-max — the dense half of the fold-to-dense
     hybrid (one update per row, full lane plane per window)."""
@@ -691,6 +770,12 @@ class DeviceEngine:
         self._pending: deque = deque()
         self._completing = False
         self._feeder_done = False
+        # Device-commit pipeline: reusable staging buffers for the packed
+        # commit/take matrices (shipped with jax.device_put BEFORE the
+        # state lock so transfer overlaps the previous tick's compute),
+        # and the dispatch-ahead bound on in-flight device ticks.
+        self._staging = StagingPool()
+        self._dispatch_ahead = DISPATCH_AHEAD
         self._completer = threading.Thread(
             target=self._complete_loop, name="patrol-engine-complete", daemon=True
         )
@@ -1106,6 +1191,11 @@ class DeviceEngine:
     # True on the single-device engine; MeshEngine opts out (its state is
     # sharded — the per-row gather/zero pair is unmeasured there).
     _demotion_capable = True
+
+    # Delta blocks one tick may drain and coalesce into a single commit
+    # dispatch; MeshEngine opts down to 1 (its fused shard_map step has
+    # its own per-block routing and no commit-ring kernel).
+    _commit_blocks = COMMIT_BLOCKS
 
     def _maybe_demote(self, tickets, deltas) -> None:
         """Feeder-only: at demote-window rollover, return quiet promoted
@@ -2108,6 +2198,23 @@ class DeviceEngine:
                         jnp.zeros((size,), jnp.int64),
                     )
                 size <<= 1
+            # Coalesced commit ring (device-commit pipeline): one variant
+            # per power-of-two block count the drain can coalesce, so the
+            # first multi-block burst doesn't compile mid-serve.
+            j = 2
+            while j <= self._commit_blocks:
+                warm = commit_mod.pack_commit_blocks(
+                    np.empty(0, np.int64), np.empty(0, np.int64),
+                    np.empty(0, np.int64), np.empty(0, np.int64),
+                    np.empty(0, np.int64), np.empty(0, np.int64),
+                    MAX_MERGE_ROWS,
+                    out=np.empty((6, j, MAX_MERGE_ROWS), np.int64),
+                )
+                with self._state_mu:
+                    self.state = _jit_commit_packed()(
+                        self.state, jnp.asarray(warm)
+                    )
+                j <<= 1
         size = 1
         while size <= 1024:  # snapshot/introspection gathers
             self.read_rows(np.zeros(size, np.int32))
@@ -2177,10 +2284,12 @@ class DeviceEngine:
         without limit."""
         tickets = [t for key in keys for t in groups[key]]
         with self._pcond:
-            while len(self._pending) >= 64 and not self._stopped:
+            while len(self._pending) >= self._dispatch_ahead and not self._stopped:
                 self._pcond.wait()
             self._pending.append((thunk, tickets))
+            depth = len(self._pending) + (1 if self._completing else 0)
             self._pcond.notify_all()
+        profiling.COUNTERS.set_max("dispatch_ahead_depth", depth)
 
     def _complete_loop(self) -> None:
         while True:
@@ -2289,7 +2398,13 @@ class DeviceEngine:
                     self._cond.wait()
                 if self._stopped and not (self._takes or self._deltas):
                     return
-                deltas = self._drain_deltas(MAX_MERGE_ROWS)
+                # Drain up to _commit_blocks blocks per tick: everything
+                # past one block's budget coalesces into a single commit
+                # dispatch (_commit_coalesced) instead of riding extra
+                # ticks — one transfer + one dispatch either way.
+                deltas = self._drain_deltas(
+                    MAX_MERGE_ROWS * self._commit_blocks
+                )
                 tickets = self._drain(self._takes, MAX_TAKE_ROWS)
                 # Clear the re-queue marker at drain time, not in
                 # _group_tickets: if the tick dies before grouping runs, a
@@ -2518,6 +2633,13 @@ class DeviceEngine:
     def _apply_lane_merges(self, deltas: DeltaArrays) -> None:
         if not len(deltas):  # a zero-length chunk is a no-op tick
             return
+        # Device-commit pipeline: a drain wider than one block's budget
+        # (the feeder pulls up to _commit_blocks blocks per tick) folds
+        # across ALL its blocks and commits in ONE donated dispatch —
+        # every per-block kernel below is shape-capped at MAX_MERGE_ROWS.
+        if len(deltas) > MAX_MERGE_ROWS:
+            self._commit_coalesced(deltas)
+            return
         # Merge-kernel selection: "scatter" (XLA, default), "pallas" (the
         # block-sparse TPU kernel whenever it can run natively), or "auto"
         # (per-batch heuristic: pallas iff the batch is block-sparse,
@@ -2557,18 +2679,27 @@ class DeviceEngine:
         fold_default = "0" if jax.default_backend() == "cpu" else "1"
         if os.environ.get("PATROL_TICK_FOLD", fold_default) != "0":
             packed, dense = self._fold_hybrid(deltas)
+            # Stage the operands on device BEFORE the state lock: the
+            # H2D transfer then overlaps the previous tick's compute
+            # instead of serializing inside the jit call (device-commit
+            # pipeline; the fold buffers are freshly allocated per tick,
+            # so jax owns them until the async transfer completes).
+            dense_dev = (
+                tuple(jax.device_put(x) for x in dense)
+                if dense is not None
+                else None
+            )
+            packed_dev = (
+                jax.device_put(packed) if packed is not None else None
+            )
             with self._state_mu:
-                if dense is not None:
-                    rows_p, upd_p, el_p = dense
+                if dense_dev is not None:
                     self.state = _jit_merge_rows_dense()(
-                        self.state,
-                        jnp.asarray(rows_p),
-                        jnp.asarray(upd_p),
-                        jnp.asarray(el_p),
+                        self.state, *dense_dev
                     )
-                if packed is not None:
+                if packed_dev is not None:
                     self.state = _jit_merge_packed_folded()(
-                        self.state, jnp.asarray(packed)
+                        self.state, packed_dev
                     )
             self._ticks += 1
             return
@@ -2580,9 +2711,62 @@ class DeviceEngine:
         packed[2, :n] = deltas.added_nt
         packed[3, :n] = deltas.taken_nt
         packed[4, :n] = deltas.elapsed_ns
+        packed_dev = jax.device_put(packed)  # staged ahead of the lock
         with self._state_mu:
-            self.state = _jit_merge_packed()(self.state, jnp.asarray(packed))
+            self.state = _jit_merge_packed()(self.state, packed_dev)
         self._ticks += 1
+
+    def _commit_coalesced(self, deltas: DeltaArrays) -> None:
+        """Device-commit pipeline: fold a multi-block drain ONCE across
+        all its blocks and commit it as a single donated fixed-shape
+        dispatch (ops/commit.py) instead of one dispatch per block —
+        exact because the join is commutative/idempotent (patrol-prove
+        PTP002/PTP003 on the registered commit root), so cross-block
+        fold order cannot matter. The packed matrix fills a reusable
+        staging buffer and ships via ``jax.device_put`` before the state
+        lock (transfer overlaps the previous tick's compute); the buffer
+        returns to the pool on the completer thread once the transfer is
+        ready, which also keeps pipeline depth bounded."""
+        blocks_in = -(-len(deltas) // MAX_MERGE_ROWS)  # ceil
+        ur, us, ua, ut, er, e = self._fold_core(deltas)
+        if len(ur) <= MAX_MERGE_ROWS:
+            # The fold collapsed the drain into one block (hot keys /
+            # cross-block duplicates): the single-block folded kernel is
+            # the cheaper dispatch, and the coalescing already happened
+            # on host.
+            packed = self._pack_folded(ur, us, ua, ut, er, e)
+            packed_dev = jax.device_put(packed)
+            with self._state_mu:
+                self.state = _jit_merge_packed_folded()(
+                    self.state, packed_dev
+                )
+        else:
+            shape = commit_mod.commit_shape(len(ur), MAX_MERGE_ROWS)
+            buf = self._staging.lease(shape)
+            commit_mod.pack_commit_blocks(
+                ur, us, ua, ut, er, e, MAX_MERGE_ROWS, out=buf
+            )
+            dev = jax.device_put(buf)
+            with self._state_mu:
+                self.state = _jit_commit_packed()(self.state, dev)
+            self._release_when_shipped(dev, buf)
+        self._ticks += 1
+        profiling.COUNTERS.inc("commit_blocks_coalesced", blocks_in)
+        profiling.COUNTERS.inc("commit_dispatches")
+
+    def _release_when_shipped(self, dev, buf: np.ndarray) -> None:
+        """Queue a transfer completion: return the staging buffer to the
+        pool once the shipped operand is READY on device (device_put
+        copies — it never aliases the host source — so readiness means
+        the host bytes are free to refill). Rides the completion
+        pipeline, so the feeder keeps dispatching ahead while the
+        completer waits out the transfer."""
+
+        def done() -> None:
+            jax.block_until_ready(dev)
+            self._staging.release(buf)
+
+        self._enqueue_completion(done, (), {})
 
     @staticmethod
     def _fold_lane_merges(deltas: DeltaArrays) -> np.ndarray:
@@ -2687,7 +2871,8 @@ class DeviceEngine:
     def _apply_takes(self, tickets: Sequence[TakeTicket]) -> None:
         keys, groups = self._group_tickets(tickets)
         k = _pad_size(len(keys), hi=MAX_TAKE_ROWS)
-        packed = np.zeros((8, k), dtype=np.int64)
+        packed = self._staging.lease((TAKE_PACK_ROWS, k))
+        packed[:] = 0  # padding rows must stay nreq=0 no-ops
         for i, key in enumerate(keys):
             ts = groups[key]
             first = ts[0]
@@ -2702,14 +2887,18 @@ class DeviceEngine:
             packed[6, i] = self.directory.cap_base_nt[first.row]
             packed[7, i] = self.directory.created_ns[first.row]
 
+        packed_dev = jax.device_put(packed)  # staged ahead of the lock
         with self._state_mu:
             self.state, out = _jit_take_packed(self.node_slot)(
-                self.state, jnp.asarray(packed)
+                self.state, packed_dev
             )
         self._ticks += 1
 
         def complete() -> None:
             res = np.asarray(out)  # one D2H transfer; blocks until device done
+            # Device done ⇒ the staged request matrix is consumed on any
+            # backend: recycle it.
+            self._staging.release(packed)
             have, admitted, own_a, own_t, elapsed, sum_a, sum_t = res
             self._complete_groups(
                 keys, groups, have, admitted, own_a, own_t, elapsed, sum_a, sum_t

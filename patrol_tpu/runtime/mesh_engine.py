@@ -55,6 +55,11 @@ class MeshEngine(DeviceEngine):
     # promoted rows remain device-resident as in r4.
     _demotion_capable = False
 
+    # The coalesced commit ring is a single-device kernel; the fused
+    # shard_map step routes per block itself, so one tick drains exactly
+    # one block's budget here (the r5 behavior).
+    _commit_blocks = 1
+
     def __init__(
         self,
         config: LimiterConfig,

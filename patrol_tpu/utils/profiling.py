@@ -201,6 +201,65 @@ class ContentionRegistry:
 REGISTRY = ContentionRegistry()
 
 
+class CounterRegistry:
+    """Process-wide transfer/dispatch counters for the device-commit
+    pipeline, surfaced verbatim in ``/debug/vars`` (pt-stats) next to the
+    engine stats and snapshotted by bench.py's ingest stages:
+
+    * ``staging_reuse_hits`` / ``staging_leases_fresh`` — how often a
+      packed commit matrix refilled a recycled pinned staging buffer
+      instead of allocating (engine.StagingPool);
+    * ``commit_blocks_coalesced`` / ``commit_dispatches`` — drained delta
+      blocks folded into single donated commit dispatches (ops/commit.py)
+      and how many such dispatches ran;
+    * ``dispatch_ahead_depth`` — high-water count of device ticks in
+      flight ahead of the completer (the pipeline's achieved depth);
+    * ``rx_staging_reuse_hits`` — native rx batches served from the
+      replicator's reused slot/flag staging planes.
+
+    Monotonic counts + high-water gauges only; all call sites are
+    per-tick/per-batch (kHz), so one mutex is noise-level overhead."""
+
+    _KNOWN = (
+        "staging_reuse_hits",
+        "staging_leases_fresh",
+        "commit_blocks_coalesced",
+        "commit_dispatches",
+        "dispatch_ahead_depth",
+        "rx_staging_reuse_hits",
+    )
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._vals: Dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._mu:
+            self._vals[name] = self._vals.get(name, 0) + n
+
+    def set_max(self, name: str, value: int) -> None:
+        """High-water gauge: keep the largest value ever observed."""
+        with self._mu:
+            if value > self._vals.get(name, 0):
+                self._vals[name] = value
+
+    def get(self, name: str) -> int:
+        with self._mu:
+            return self._vals.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        """Every known counter (zero-filled) plus any ad-hoc ones — the
+        stable field set /debug/vars readers can rely on."""
+        with self._mu:
+            out = {k: self._vals.get(k, 0) for k in self._KNOWN}
+            for k, v in self._vals.items():
+                out.setdefault(k, v)
+            return out
+
+
+COUNTERS = CounterRegistry()
+
+
 class ProfiledLock:
     """``threading.Lock`` wrapper recording contended-acquire wait time
     into :data:`REGISTRY`. The uncontended fast path is one extra

@@ -1,0 +1,352 @@
+"""Device-commit pipeline tests: the coalesced block-ring commit kernel
+(ops/commit.py) is bit-exact against sequential per-block joins, the
+engine's multi-block drain commits through it identically, staging
+buffers recycle, dispatch-ahead depth > 1 keeps ticket results and
+``_ticks`` accounting intact, and patrol-prove rejects a seeded
+coalesce-order mutation."""
+
+import dataclasses
+import threading
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from patrol_tpu.models.limiter import NANO, LimiterConfig, init_state
+from patrol_tpu.ops import commit as commit_mod
+from patrol_tpu.ops.merge import FOLD_PAD_ROW, MergeBatch, merge_batch
+from patrol_tpu.ops.rate import Rate
+from patrol_tpu.runtime import engine as engine_mod
+from patrol_tpu.runtime.engine import (
+    DeltaArrays,
+    DeviceEngine,
+    MAX_MERGE_ROWS,
+    StagingPool,
+)
+from patrol_tpu.utils import profiling
+
+
+def _rand_deltas(rng, n, buckets, nodes):
+    return DeltaArrays(
+        rows=rng.integers(0, buckets, n),
+        slots=rng.integers(0, nodes, n),
+        added_nt=rng.integers(0, 1 << 50, n),
+        taken_nt=rng.integers(0, 1 << 50, n),
+        elapsed_ns=rng.integers(0, 1 << 50, n),
+        scalar=np.zeros(n, bool),
+    )
+
+
+def _ref_join(cfg, deltas):
+    """Sequential reference: per-block merge_batch applications, exactly
+    the r05 per-block commit path."""
+    state = init_state(cfg)
+    for lo in range(0, len(deltas.rows), MAX_MERGE_ROWS):
+        hi = lo + MAX_MERGE_ROWS
+        state = merge_batch(
+            state,
+            MergeBatch(
+                rows=jnp.asarray(deltas.rows[lo:hi], jnp.int32),
+                slots=jnp.asarray(deltas.slots[lo:hi], jnp.int32),
+                added_nt=jnp.asarray(deltas.added_nt[lo:hi]),
+                taken_nt=jnp.asarray(deltas.taken_nt[lo:hi]),
+                elapsed_ns=jnp.asarray(deltas.elapsed_ns[lo:hi]),
+            ),
+        )
+    return state
+
+
+class TestCommitKernel:
+    """ops/commit.py in isolation: the padded-superbatch block ring."""
+
+    @pytest.mark.parametrize(
+        "seed,n,buckets,nodes",
+        [
+            (0, 3 * MAX_MERGE_ROWS + 257, 4096, 8),  # multi-block, spread
+            (1, 2 * MAX_MERGE_ROWS + 1, 64, 8),  # heavy cross-block dupes
+            (2, MAX_MERGE_ROWS + 3, 8, 4),  # hot rows, many lanes each
+            (3, 517, 32, 4),  # single partial block
+        ],
+    )
+    def test_commit_blocks_matches_sequential_merge_batch(
+        self, seed, n, buckets, nodes
+    ):
+        """Property: ONE coalesced K-block commit == K sequential
+        merge_batch applications, bit-exact — including duplicate
+        (row, slot) pairs across blocks and hot rows touching every
+        lane (the folded/dense shapes both reduce to this join)."""
+        rng = np.random.default_rng(seed)
+        deltas = _rand_deltas(rng, n, buckets, nodes)
+        cfg = LimiterConfig(buckets=buckets, nodes=nodes)
+
+        ur, us, ua, ut, er, e = DeviceEngine._fold_core(deltas)
+        packed = commit_mod.pack_commit_blocks(
+            ur, us, ua, ut, er, e, MAX_MERGE_ROWS
+        )
+        got = commit_mod.commit_blocks(
+            init_state(cfg),
+            commit_mod.CommitBlocks(
+                rows=jnp.asarray(packed[0], jnp.int32),
+                slots=jnp.asarray(packed[1], jnp.int32),
+                added_nt=jnp.asarray(packed[2]),
+                taken_nt=jnp.asarray(packed[3]),
+                erows=jnp.asarray(packed[4], jnp.int32),
+                elapsed_ns=jnp.asarray(packed[5]),
+            ),
+        )
+        ref = _ref_join(cfg, deltas)
+        assert np.array_equal(np.asarray(ref.pn), np.asarray(got.pn))
+        assert np.array_equal(np.asarray(ref.elapsed), np.asarray(got.elapsed))
+
+    def test_pack_invariants(self):
+        """The asserted scatter flags must be literally true on the
+        FLATTENED ring: keys strictly sorted and unique across blocks,
+        padding out-of-bounds, J a power of two."""
+        rng = np.random.default_rng(11)
+        deltas = _rand_deltas(rng, 2 * MAX_MERGE_ROWS + 77, 512, 4)
+        ur, us, ua, ut, er, e = DeviceEngine._fold_core(deltas)
+        packed = commit_mod.pack_commit_blocks(
+            ur, us, ua, ut, er, e, MAX_MERGE_ROWS
+        )
+        assert packed.shape[0] == 6
+        j = packed.shape[1]
+        assert j & (j - 1) == 0 and j * packed.shape[2] >= len(ur)
+        flat = packed.reshape(6, -1)
+        key = flat[0] * 100000 + flat[1]
+        assert (np.diff(key) > 0).all(), "pair keys not sorted/unique"
+        live = flat[0] < FOLD_PAD_ROW
+        assert int(live.sum()) == len(ur)
+        assert (flat[0][~live] >= 512).all(), "padding keys must be OOB"
+        assert (np.diff(flat[4]) > 0).all(), "elapsed rows not sorted/unique"
+        elive = flat[4] < FOLD_PAD_ROW
+        assert int(elive.sum()) == len(er)
+
+    def test_pack_rejects_undersized_staging_buffer(self):
+        one = np.zeros(1, np.int64)
+        with pytest.raises(ValueError):
+            commit_mod.pack_commit_blocks(
+                np.zeros(9, np.int64), one[:0], one[:0], one[:0], one[:0],
+                one[:0], 4, out=np.empty((6, 1, 4), np.int64),
+            )
+
+
+class TestEngineCoalescedCommit:
+    """The engine's multi-block drain path (_commit_coalesced)."""
+
+    def _engine(self, buckets=512, nodes=4):
+        return DeviceEngine(
+            LimiterConfig(buckets=buckets, nodes=nodes), node_slot=0
+        )
+
+    def test_multi_block_apply_is_one_dispatch_and_bit_exact(self):
+        rng = np.random.default_rng(5)
+        n = 2 * MAX_MERGE_ROWS + 901
+        deltas = _rand_deltas(rng, n, 512, 4)
+        eng = self._engine()
+        try:
+            ticks0 = eng.ticks
+            d0 = profiling.COUNTERS.get("commit_dispatches")
+            b0 = profiling.COUNTERS.get("commit_blocks_coalesced")
+            eng._apply_lane_merges(deltas)
+            assert eng.flush(timeout=30)
+            assert eng.ticks == ticks0 + 1, "coalesced commit must be ONE tick"
+            assert profiling.COUNTERS.get("commit_dispatches") == d0 + 1
+            assert profiling.COUNTERS.get("commit_blocks_coalesced") == b0 + 3
+            ref = _ref_join(LimiterConfig(buckets=512, nodes=4), deltas)
+            pn, el = eng.read_rows(np.arange(512))
+            assert np.array_equal(np.asarray(ref.pn), pn)
+            assert np.array_equal(np.asarray(ref.elapsed), el)
+        finally:
+            eng.stop()
+
+    def test_hot_key_multi_block_drain_collapses_to_one_block(self):
+        """A hot-key mega-drain folds below one block's budget: the
+        commit path must take the cheaper single-block folded dispatch
+        and stay bit-exact."""
+        rng = np.random.default_rng(6)
+        n = MAX_MERGE_ROWS + 4001
+        deltas = _rand_deltas(rng, n, 3, 4)  # 3 rows × 4 lanes = 12 keys
+        eng = self._engine(buckets=8)
+        try:
+            ticks0 = eng.ticks
+            eng._apply_lane_merges(deltas)
+            assert eng.flush(timeout=30)
+            assert eng.ticks == ticks0 + 1
+            ref = _ref_join(LimiterConfig(buckets=8, nodes=4), deltas)
+            pn, el = eng.read_rows(np.arange(8))
+            assert np.array_equal(np.asarray(ref.pn), pn)
+            assert np.array_equal(np.asarray(ref.elapsed), el)
+        finally:
+            eng.stop()
+
+    def test_end_to_end_ingest_matches_reference(self):
+        """>1 block of deltas through the public bulk-ingest path: the
+        final device state must equal the host-side max-fold reference
+        no matter how the feeder groups the drains into ticks."""
+        rng = np.random.default_rng(7)
+        n = 2 * MAX_MERGE_ROWS + 333
+        nbuckets, nodes = 96, 4
+        names = [f"b{int(i)}" for i in rng.integers(0, nbuckets, n)]
+        slots = rng.integers(0, nodes, n)
+        added = rng.integers(0, 1 << 50, n)
+        taken = rng.integers(0, 1 << 50, n)
+        elapsed = rng.integers(0, 1 << 50, n)
+        eng = self._engine(buckets=256, nodes=nodes)
+        try:
+            eng.ingest_deltas_batch(
+                names, slots.astype(np.int64), added, taken, elapsed
+            )
+            assert eng.flush(timeout=60)
+            # Host reference fold, keyed by bucket name.
+            ref_pn = {}
+            ref_el = {}
+            for i, name in enumerate(names):
+                pn = ref_pn.setdefault(name, np.zeros((nodes, 2), np.int64))
+                s = int(slots[i])
+                pn[s, 0] = max(pn[s, 0], added[i])
+                pn[s, 1] = max(pn[s, 1], taken[i])
+                ref_el[name] = max(ref_el.get(name, 0), int(elapsed[i]))
+            for name, want_pn in ref_pn.items():
+                row = eng.directory.lookup(name)
+                assert row is not None
+                pn, el = eng.read_rows([row])
+                assert np.array_equal(pn[0], want_pn), name
+                assert int(el[0]) == ref_el[name], name
+        finally:
+            eng.stop()
+
+
+class TestDispatchAhead:
+    def test_depth_gt_one_keeps_results_and_ticks(self, monkeypatch):
+        """Stress the feeder/completer pair at dispatch-ahead depth 3:
+        every ticket must complete with sequential-parity admission and
+        the token accounting / ``_ticks`` bookkeeping must survive the
+        pipelining (device path forced — the host fast path would absorb
+        everything in-process)."""
+        monkeypatch.setattr(engine_mod, "HOST_FASTPATH", False)
+        eng = DeviceEngine(
+            LimiterConfig(buckets=64, nodes=4), node_slot=0
+        )
+        eng._dispatch_ahead = 3
+        rate = Rate(freq=100000, per_ns=0)  # huge capacity, zero refill
+        names = [f"q{i}" for i in range(8)]
+        per_thread, n_threads = 64, 4  # divides evenly over the buckets
+        tickets = [[] for _ in range(n_threads)]
+
+        def worker(t):
+            for i in range(per_thread):
+                tk, _ = eng.submit_take(names[(t + i) % len(names)], rate, 1)
+                tickets[t].append(tk)
+
+        try:
+            threads = [
+                threading.Thread(target=worker, args=(t,))
+                for t in range(n_threads)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            for ts in tickets:
+                for tk in ts:
+                    assert tk.wait(timeout=30)
+                    assert tk.ok, "capacity is ample: every take must admit"
+            assert eng.flush(timeout=30)
+            assert eng.pending_completions == 0
+            assert eng.ticks >= 2, "the burst cannot fit one tick"
+            total = per_thread * n_threads
+            per_bucket = total // len(names)
+            for name in names:
+                assert eng.tokens(name) == 100000 - per_bucket, name
+            assert profiling.COUNTERS.get("dispatch_ahead_depth") >= 1
+        finally:
+            eng.stop()
+
+    def test_staging_pool_recycles_and_bounds(self):
+        pool = StagingPool(max_per_shape=2)
+        h0 = profiling.COUNTERS.get("staging_reuse_hits")
+        a = pool.lease((6, 2, 8))
+        b = pool.lease((6, 2, 8))
+        pool.release(a)
+        pool.release(b)
+        c = pool.lease((6, 2, 8))
+        assert c is b  # LIFO reuse of the recycled buffer
+        assert profiling.COUNTERS.get("staging_reuse_hits") == h0 + 1
+        # The per-shape bound drops overflow instead of pinning memory.
+        pool.release(c)
+        pool.release(a)
+        extra = np.empty((6, 2, 8), np.int64)
+        pool.release(extra)
+        assert len(pool._free[(6, 2, 8)]) == 2
+
+    def test_take_staging_buffers_recycle_across_ticks(self, monkeypatch):
+        """Successive device take ticks must reuse the packed request
+        matrix instead of allocating per tick."""
+        monkeypatch.setattr(engine_mod, "HOST_FASTPATH", False)
+        eng = DeviceEngine(LimiterConfig(buckets=16, nodes=4), node_slot=0)
+        rate = Rate(freq=1000, per_ns=0)
+        try:
+            h0 = profiling.COUNTERS.get("staging_reuse_hits")
+            for i in range(6):
+                remaining, ok, _ = eng.take(f"s{i % 2}", rate, 1)
+                assert ok
+            assert eng.flush(timeout=30)
+            assert profiling.COUNTERS.get("staging_reuse_hits") > h0
+        finally:
+            eng.stop()
+
+
+class TestCommitProve:
+    """The commit kernel is gated like every other root — and the gate
+    actually rejects the bug class coalescing invites."""
+
+    def test_commit_root_registered_with_full_obligations(self):
+        from patrol_tpu.ops.obligations import PROVE_ROOTS
+
+        roots = {r.name: r for r in PROVE_ROOTS}
+        root = roots["ops.commit.commit_blocks"]
+        assert root.structural == "join"
+        assert set(root.obligations) == {
+            "PTP001", "PTP002", "PTP003", "PTP004", "PTP005",
+        }
+
+    def test_shipped_commit_kernel_proves_clean(self):
+        from patrol_tpu.analysis import prove
+        from patrol_tpu.ops.obligations import PROVE_ROOTS
+
+        root = next(
+            r for r in PROVE_ROOTS if r.name == "ops.commit.commit_blocks"
+        )
+        assert prove.prove_root(root) == []
+
+    def test_coalesce_order_mutation_is_rejected(self):
+        """Seeded coalesce-order bug: later blocks OVERWRITE earlier
+        ones (scatter .set — last-writer-wins) instead of joining, so
+        the committed state depends on block arrival order. The model
+        checker must refuse it on commutativity, and on monotonicity
+        (an overwrite can shrink a plane)."""
+        from patrol_tpu.analysis import prove
+        from patrol_tpu.models.limiter import LimiterState
+        from patrol_tpu.ops.obligations import PROVE_ROOTS
+
+        def lww_commit_blocks(state, blocks):
+            rows = blocks.rows.reshape(-1)
+            slots = blocks.slots.reshape(-1)
+            pair = jnp.stack(
+                [blocks.added_nt.reshape(-1), blocks.taken_nt.reshape(-1)],
+                axis=-1,
+            )
+            pn = state.pn.at[rows, slots].set(pair, mode="drop")
+            elapsed = state.elapsed.at[blocks.erows.reshape(-1)].max(
+                blocks.elapsed_ns.reshape(-1), mode="drop"
+            )
+            return LimiterState(pn=pn, elapsed=elapsed)
+
+        root = next(
+            r for r in PROVE_ROOTS if r.name == "ops.commit.commit_blocks"
+        )
+        bad = dataclasses.replace(root, obligations=("PTP002", "PTP004"))
+        codes = {f.check for f in prove.prove_root(bad, fn=lww_commit_blocks)}
+        assert "PTP002" in codes, "order-dependent coalesce must fail PTP002"
+        assert "PTP004" in codes, "overwriting coalesce must fail PTP004"
