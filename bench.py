@@ -1364,7 +1364,162 @@ def smoke_main() -> int:
     return 0
 
 
+def chaos_main() -> int:
+    """``bench.py --chaos-smoke``: a seconds-class, CPU-safe, SEEDED chaos
+    gate for the replication resilience layer. Wires a real 2-node
+    replication plane on loopback (engines + asyncio replicators, no HTTP)
+    under a fixed-seed faultnet (drop+dup+reorder), drives a deterministic
+    take workload on frozen clocks, heals, and asserts bit-exact
+    convergence to the no-fault fixpoint via anti-entropy — emitting the
+    peer-health / faultnet / resync probe fields the satellite surfaces
+    (``peer_alive``, ``peer_backoff_ms``, ``resync_buckets``,
+    ``faultnet_active``; benchmarks/PROBES.md). Exits nonzero on
+    divergence — the one JSON line still prints either way."""
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    OUT["metric"] = "replication chaos smoke (seeded faultnet convergence gate)"
+    OUT["unit"] = "takes"
+    OUT["chaos_smoke"] = True
+    t0 = time.time()
+    try:
+        import asyncio
+        import socket as sk
+        import threading
+
+        import jax
+
+        import patrol_tpu  # noqa: F401  (enables x64)
+        from patrol_tpu.models.limiter import NANO, LimiterConfig
+        from patrol_tpu.net.faultnet import FaultNet
+        from patrol_tpu.net.replication import Replicator, SlotTable
+        from patrol_tpu.ops.rate import Rate
+        from patrol_tpu.runtime.engine import DeviceEngine
+        from patrol_tpu.runtime.repo import TPURepo
+
+        OUT["platform"] = jax.default_backend()
+        OUT["chaos_seed"] = SEED = 2026
+
+        def free_port():
+            s = sk.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        loop = asyncio.new_event_loop()
+        thread = threading.Thread(target=lambda: (
+            asyncio.set_event_loop(loop), loop.run_forever()
+        ), daemon=True)
+        thread.start()
+
+        def on_loop(coro):
+            return asyncio.run_coroutine_threadsafe(coro, loop).result(15)
+
+        ports = [free_port(), free_port()]
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        frozen = lambda: NANO  # noqa: E731 — zero grants ⇒ exact fixpoint
+        nodes = []
+        try:
+            for i in range(2):
+                slots = SlotTable(addrs[i], addrs, max_slots=4)
+                rep = on_loop(Replicator.create(addrs[i], addrs, slots))
+                rep.health.configure(
+                    probe_interval_s=0.15, alive_ttl_s=0.5, backoff_cap_s=0.4
+                )
+                rep.antientropy.min_interval_s = 0.4
+                fn = FaultNet(seed=SEED + i, self_addr=addrs[i])
+                fn.link(drop=0.3, dup=0.3, reorder=0.3)
+                rep.faultnet = fn
+                eng = DeviceEngine(
+                    LimiterConfig(buckets=64, nodes=4),
+                    node_slot=slots.self_slot,
+                    clock=frozen,
+                )
+                repo = TPURepo(eng, send_incast=rep.send_incast_request)
+                rep.repo = repo
+                eng.on_broadcast = rep.broadcast_states
+                nodes.append((rep, eng, repo, fn))
+
+            rate = Rate(freq=100, per_ns=3600 * NANO)
+            takes = 20
+            for i in range(takes):
+                _, ok = nodes[i % 2][2].take("chaos", rate, 1)
+                assert ok, "admission under chaos must not fail at 100≫20"
+                time.sleep(0.004)
+            for rep, _, _, fn in nodes:
+                fn.heal()
+                fn.link()  # quiesce: clean link, held packets still drain
+            time.sleep(0.2)
+
+            deadline = time.time() + 15
+            next_trigger = 0.0
+            converged = False
+            views = []
+            while time.time() < deadline:
+                if time.time() >= next_trigger:
+                    next_trigger = time.time() + 1.0
+                    for rep, _, _, _ in nodes:
+                        for peer in rep.peers:
+                            rep.antientropy.trigger(peer, force=True)
+                views = []
+                for _, eng, _, _ in nodes:
+                    eng.flush()
+                    row = eng.directory.lookup("chaos")
+                    if row is None:
+                        views.append(None)
+                        continue
+                    pn, elapsed = eng.row_view(row)
+                    views.append(
+                        (int(pn[:, 0].sum()), int(pn[:, 1].sum()), int(elapsed))
+                    )
+                if views and None not in views and len(set(views)) == 1:
+                    if views[0] == (0, takes * NANO, 0):
+                        converged = True
+                        break
+                time.sleep(0.05)
+
+            OUT["value"] = takes
+            OUT["chaos_converged"] = converged
+            OUT["chaos_views"] = [list(v) if v else None for v in views]
+            for i, (rep, _, _, fn) in enumerate(nodes):
+                stats = rep.stats()
+                for key in (
+                    "peer_alive", "peer_backoff_ms", "peer_probes_tx",
+                    "resync_buckets", "ae_triggers", "ae_packets_tx",
+                    "faultnet_active", "faultnet_dropped",
+                    "faultnet_duplicated", "faultnet_reordered",
+                    "replication_rx_errors",
+                ):
+                    OUT[f"chaos_n{i}_{key}"] = stats.get(key, 0)
+            assert converged, f"chaos smoke did not converge: {views}"
+            # The schedule must have actually injected faults.
+            assert sum(fn.dropped + fn.duplicated for *_, fn in nodes) > 0
+        finally:
+            for rep, eng, _, _ in nodes:
+                loop.call_soon_threadsafe(rep.close)
+                eng.stop()
+            time.sleep(0.2)  # let the cancelled health tasks unwind
+            loop.call_soon_threadsafe(loop.stop)
+            thread.join(timeout=5)
+
+        OUT["chaos_smoke_seconds"] = round(time.time() - t0, 2)
+        OUT["stages_completed"] = 1
+        OUT["stages"] = ["chaos-smoke"]
+    except BaseException as e:
+        _log(f"chaos smoke failed: {type(e).__name__}: {e}")
+        OUT["error"] = f"{type(e).__name__}: {e}"
+        OUT["chaos_converged"] = False
+        _emit()
+        if not isinstance(e, Exception):
+            raise
+        return 1
+    _emit()
+    return 0
+
+
 if __name__ == "__main__":
     if "--smoke" in sys.argv:
         sys.exit(smoke_main())
+    if "--chaos-smoke" in sys.argv:
+        sys.exit(chaos_main())
     main()

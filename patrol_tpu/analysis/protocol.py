@@ -1,0 +1,539 @@
+"""patrol-protocol — a bounded model checker for the replication protocol.
+
+The kernel-level provers (patrol-prove, PTP001-005) certify the *algebra*:
+join is a commutative/associative/idempotent/monotone lattice merge. They
+say nothing about the *protocol* built on top of it — who broadcasts what
+when, what incast/resync does, and whether the whole dance still converges
+when the network drops, duplicates, reorders, and partitions. ROADMAP
+item 5 ("Automatically Verifying Replication-aware Linearizability",
+arXiv:2502.19967) calls for machine-checking exactly that; before this
+module the only evidence was a handful of cluster tests with ad-hoc drop
+filters.
+
+This checker enumerates bounded schedules of a small cluster (2-3 nodes,
+a handful of takes, bounded fault events) against a STEP-FOR-STEP Python
+model of the protocol:
+
+* node state = per-node PN lanes ``(added[slot], taken[slot])`` over one
+  bucket with capacity ``limit`` and no refill (the algebra of
+  ops/take.py's no-grant path: admit iff
+  ``limit + Σadded − Σtaken ≥ count``, spend into the own lane);
+* every take broadcasts the taker's lanes (the full-state datagram);
+* the network is a per-link multiset of in-flight packets supporting
+  deliver / duplicate-deliver / drop / reorder (delivery order is free);
+* merge is the elementwise lattice max (CvRDT join);
+* heal-time anti-entropy = pairwise state exchange, modelling
+  net/antientropy.py's digest+fetch resync as its effect (ship the
+  divergent state, join on arrival).
+
+Machine-checked invariants, each a PTC code:
+
+====== ===============================================================
+PTC001 convergence-after-heal: after heal + full delivery + pairwise
+       anti-entropy, all replicas are identical AND equal to the join
+       of every node's state (nothing lost, nothing invented)
+PTC002 monotonicity: no replica's state ever decreases in lattice
+       order at any step of any schedule
+PTC003 AP bound: under sync-within-side delivery, total admitted takes
+       ≤ limit × partition-sides (README.md:64-76's degradation
+       contract — each side enforces the full limit independently)
+PTC004 idempotence at ingest: duplicated and reordered deliveries of
+       the same packets land on the same replica state
+====== ===============================================================
+
+Trust story (same shape as patrol-prove): the checker must also be able
+to FAIL. ``MUTATIONS`` registers seeded protocol bugs — resync that
+overwrites instead of joins, merge that sums instead of maxes, takes that
+ignore remote lanes, LWW-style assignment — and :func:`check_repo`
+asserts every one of them is rejected by at least one invariant. A
+checker that passes a mutant is itself a finding (PTC005).
+
+Pure python, no jax; exhaustive within its bounds (several thousand
+schedules in well under a second), deterministic by construction — no
+randomness anywhere, so CI failures replay exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# findings
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    check: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.check} {self.message}"
+
+
+_SELF = "patrol_tpu/analysis/protocol.py"
+
+
+# ---------------------------------------------------------------------------
+# the protocol model
+
+
+@dataclasses.dataclass(frozen=True)
+class Semantics:
+    """The model's tunable laws. The clean protocol is the default; each
+    mutation flips one law to a plausible-but-wrong alternative."""
+
+    merge: str = "join"  # "join" | "sum" | "assign"
+    resync: str = "join"  # "join" | "overwrite"
+    take: str = "global"  # "global" | "own_only"
+
+
+CLEAN = Semantics()
+
+# Seeded protocol bugs the checker must reject (name → (semantics, what a
+# correct checker reports about it)).
+MUTATIONS: Dict[str, Semantics] = {
+    "resync-overwrites-instead-of-joins": Semantics(resync="overwrite"),
+    "merge-sums-instead-of-maxes": Semantics(merge="sum"),
+    "merge-assigns-lww": Semantics(merge="assign"),
+    "take-ignores-remote-lanes": Semantics(take="own_only"),
+}
+
+
+class Node:
+    """One replica: PN lanes over a single bucket, capacity ``limit``."""
+
+    __slots__ = ("slot", "n", "limit", "added", "taken", "admitted")
+
+    def __init__(self, slot: int, n: int, limit: int):
+        self.slot = slot
+        self.n = n
+        self.limit = limit
+        self.added = [0] * n
+        self.taken = [0] * n
+        self.admitted = 0
+
+    def state(self) -> Tuple[int, ...]:
+        return tuple(self.added) + tuple(self.taken)
+
+    def take(self, sem: Semantics) -> bool:
+        if sem.take == "own_only":
+            tokens = self.limit + self.added[self.slot] - self.taken[self.slot]
+        else:
+            tokens = self.limit + sum(self.added) - sum(self.taken)
+        if tokens >= 1:
+            self.taken[self.slot] += 1
+            self.admitted += 1
+            return True
+        return False
+
+    def packet(self) -> Tuple[Tuple[int, int, int], ...]:
+        """The broadcast payload: every non-zero lane (the full-state
+        datagram carries the sender's whole view)."""
+        return tuple(
+            (s, self.added[s], self.taken[s])
+            for s in range(self.n)
+            if self.added[s] or self.taken[s]
+        )
+
+    def merge(self, lanes: Iterable[Tuple[int, int, int]], sem: Semantics) -> None:
+        mode = sem.merge
+        for s, a, t in lanes:
+            if mode == "join":
+                if a > self.added[s]:
+                    self.added[s] = a
+                if t > self.taken[s]:
+                    self.taken[s] = t
+            elif mode == "sum":
+                self.added[s] += a
+                self.taken[s] += t
+            else:  # "assign" — last writer wins
+                self.added[s] = a
+                self.taken[s] = t
+
+    def resync_from(self, other: "Node", sem: Semantics) -> None:
+        if sem.resync == "overwrite":
+            self.added = list(other.added)
+            self.taken = list(other.taken)
+        else:
+            self.merge(other.packet(), sem)
+
+
+def _ge(a: Tuple[int, ...], b: Tuple[int, ...]) -> bool:
+    return all(x >= y for x, y in zip(a, b))
+
+
+def _join(states: Sequence[Tuple[int, ...]]) -> Tuple[int, ...]:
+    return tuple(max(vals) for vals in zip(*states))
+
+
+class _Violation(Exception):
+    def __init__(self, check: str, message: str):
+        self.check = check
+        self.message = message
+        super().__init__(message)
+
+
+class Cluster:
+    """The model cluster: nodes + per-link in-flight packet lists."""
+
+    def __init__(self, n: int, limit: int, sem: Semantics):
+        self.sem = sem
+        self.nodes = [Node(i, n, limit) for i in range(n)]
+        # links[(src, dst)] = list of in-flight payloads, FIFO by append
+        # but deliverable in any order (the reorder model).
+        self.links: Dict[Tuple[int, int], List[tuple]] = {
+            (i, j): [] for i in range(n) for j in range(n) if i != j
+        }
+        self.partition: Optional[Dict[int, int]] = None  # node → side
+
+    # -- events --------------------------------------------------------------
+
+    def take(self, i: int) -> None:
+        node = self.nodes[i]
+        node.take(self.sem)
+        pkt = node.packet()
+        if pkt:
+            for j in range(len(self.nodes)):
+                if j != i:
+                    self.links[(i, j)].append(pkt)
+
+    def crosses_partition(self, i: int, j: int) -> bool:
+        return (
+            self.partition is not None
+            and self.partition.get(i) != self.partition.get(j)
+        )
+
+    def deliver(self, i: int, j: int, idx: int, dup: bool = False) -> None:
+        """Deliver in-flight packet ``idx`` on link i→j (any idx = the
+        reorder model). ``dup`` delivers without removing. A partitioned
+        link DROPS the packet instead of delivering (UDP, not TCP: the
+        datagram is gone, not queued — held-back delivery is modelled by
+        simply not choosing to deliver before heal)."""
+        q = self.links[(i, j)]
+        pkt = q[idx]
+        if not dup:
+            q.pop(idx)
+        if self.crosses_partition(i, j):
+            return
+        self._merge_checked(j, pkt)
+
+    def _merge_checked(self, j: int, pkt: tuple) -> None:
+        node = self.nodes[j]
+        before = node.state()
+        node.merge(pkt, self.sem)
+        if not _ge(node.state(), before):
+            raise _Violation(
+                "PTC002",
+                f"merge shrank node {j}'s state {before} -> {node.state()}",
+            )
+
+    def drop(self, i: int, j: int, idx: int) -> None:
+        self.links[(i, j)].pop(idx)
+
+    def deliver_all(self, within_side_only: bool = False) -> None:
+        for (i, j), q in self.links.items():
+            if self.crosses_partition(i, j):
+                if not within_side_only:
+                    q.clear()  # partition drops cross-side datagrams
+                continue
+            while q:
+                self._merge_checked(j, q.pop(0))
+
+    def set_partition(self, sides: Optional[Dict[int, int]]) -> None:
+        self.partition = sides
+        if sides is not None:
+            # In-flight cross-side datagrams are lost to the partition.
+            for (i, j), q in self.links.items():
+                if self.crosses_partition(i, j):
+                    q.clear()
+
+    def heal_and_converge(self) -> None:
+        """Heal + full delivery + pairwise anti-entropy (both directions,
+        every pair — the model of net/antientropy.py's digest+fetch)."""
+        self.set_partition(None)
+        self.deliver_all()
+        before = [n.state() for n in self.nodes]
+        for a, b in itertools.permutations(range(len(self.nodes)), 2):
+            node = self.nodes[b]
+            prev = node.state()
+            node.resync_from(self.nodes[a], self.sem)
+            if not _ge(node.state(), prev):
+                raise _Violation(
+                    "PTC002",
+                    f"anti-entropy resync shrank node {b}'s state "
+                    f"{prev} -> {node.state()}",
+                )
+        expect = _join(before)
+        states = [n.state() for n in self.nodes]
+        if any(s != states[0] for s in states):
+            raise _Violation(
+                "PTC001", f"replicas diverged after heal: {states}"
+            )
+        if states[0] != expect:
+            raise _Violation(
+                "PTC001",
+                f"converged state {states[0]} != join of replicas {expect}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# schedule enumeration
+
+
+def _partition_layouts(n: int) -> List[Optional[Dict[int, int]]]:
+    """All partitions of n nodes into ≥2 sides, plus None (no partition)."""
+    layouts: List[Optional[Dict[int, int]]] = [None]
+    if n == 2:
+        layouts.append({0: 0, 1: 1})
+    elif n == 3:
+        layouts += [
+            {0: 0, 1: 1, 2: 1},
+            {0: 0, 1: 0, 2: 1},
+            {0: 0, 1: 1, 2: 0},
+            {0: 0, 1: 1, 2: 2},
+        ]
+    return layouts
+
+
+def check_ap_bound(
+    n_nodes: int = 3, limit: int = 2, extra_takes: int = 2, sem: Semantics = CLEAN
+) -> List[Finding]:
+    """PTC003 (+ PTC001/002 at heal): under sync-within-side delivery,
+    enumerate every partition layout × every take sequence long enough to
+    exhaust every side, and check ``admitted ≤ limit × sides``. The
+    sync-within-side discipline (deliver all intra-side packets after
+    each take) is the README.md:64-76 contract's premise: replication
+    *within* a side keeps up, so each side enforces the limit exactly;
+    cross-side datagrams are dropped by the partition."""
+    findings: List[Finding] = []
+    takes_total = limit * n_nodes + extra_takes
+    for layout in _partition_layouts(n_nodes):
+        sides = 1 if layout is None else len(set(layout.values()))
+        for seq in itertools.product(range(n_nodes), repeat=takes_total):
+            c = Cluster(n_nodes, limit, sem)
+            c.set_partition(layout)
+            try:
+                for i in seq:
+                    c.take(i)
+                    c.deliver_all(within_side_only=True)
+                admitted = sum(node.admitted for node in c.nodes)
+                if admitted > limit * sides:
+                    raise _Violation(
+                        "PTC003",
+                        f"admitted {admitted} > limit {limit} × {sides} "
+                        f"side(s) (layout={layout}, takes={seq})",
+                    )
+                c.heal_and_converge()
+            except _Violation as v:
+                findings.append(Finding(v.check, _SELF, 0, v.message))
+                break  # one witness per layout is enough
+    return findings
+
+
+def check_async_schedules(
+    n_nodes: int = 2,
+    limit: int = 2,
+    takes: int = 3,
+    max_disruptions: int = 2,
+    sem: Semantics = CLEAN,
+) -> Tuple[int, List[Finding]]:
+    """PTC001/PTC002 under fully-adversarial delivery: DFS over every
+    interleaving of {take, deliver-any, duplicate-deliver, drop} within
+    the event bounds, converging each terminal schedule. Monotonicity is
+    checked at every merge; convergence-to-join at every terminal.
+    Returns (schedules explored, findings)."""
+    findings: List[Finding] = []
+    explored = 0
+    seen: set = set()
+
+    def _key(c: Cluster, takes_left: int, disrupt_left: int):
+        return (
+            tuple(n.state() + (n.admitted,) for n in c.nodes),
+            tuple(
+                (lk, tuple(map(tuple, q))) for lk, q in sorted(c.links.items())
+            ),
+            takes_left,
+            disrupt_left,
+        )
+
+    def dfs(c: Cluster, takes_left: int, disrupt_left: int, depth: int):
+        nonlocal explored
+        if findings:
+            return  # one witness is enough
+        k = _key(c, takes_left, disrupt_left)
+        if k in seen:
+            return  # schedule prefix reaches an already-checked state
+        seen.add(k)
+        inflight = [
+            (i, j, idx)
+            for (i, j), q in c.links.items()
+            for idx in range(len(q))
+        ]
+        if takes_left == 0 and not inflight:
+            explored += 1
+            final = _snapshot(c)
+            try:
+                c2 = _restore(c, final)
+                c2.heal_and_converge()
+            except _Violation as v:
+                findings.append(Finding(v.check, _SELF, 0, v.message))
+            return
+        if depth == 0:
+            # Depth cap: converge what we have (still a valid schedule).
+            explored += 1
+            try:
+                c2 = _restore(c, _snapshot(c))
+                c2.heal_and_converge()
+            except _Violation as v:
+                findings.append(Finding(v.check, _SELF, 0, v.message))
+            return
+        moves = []
+        if takes_left:
+            moves += [("take", i) for i in range(len(c.nodes))]
+        # Deliver the HEAD of each link (plus the tail when reordering is
+        # possible) — delivering only head/tail spans the reorder space
+        # for the 2-deep links these bounds produce.
+        for (i, j), q in c.links.items():
+            if q:
+                moves.append(("deliver", i, j, 0))
+                if len(q) > 1:
+                    moves.append(("deliver", i, j, len(q) - 1))
+                if disrupt_left:
+                    moves.append(("dup", i, j, 0))
+                    moves.append(("drop", i, j, 0))
+        for mv in moves:
+            snap = _snapshot(c)
+            c2 = _restore(c, snap)
+            try:
+                if mv[0] == "take":
+                    c2.take(mv[1])
+                    dfs(c2, takes_left - 1, disrupt_left, depth - 1)
+                elif mv[0] == "deliver":
+                    c2.deliver(mv[1], mv[2], mv[3])
+                    dfs(c2, takes_left, disrupt_left, depth - 1)
+                elif mv[0] == "dup":
+                    c2.deliver(mv[1], mv[2], mv[3], dup=True)
+                    dfs(c2, takes_left, disrupt_left - 1, depth - 1)
+                else:  # drop
+                    c2.drop(mv[1], mv[2], mv[3])
+                    dfs(c2, takes_left, disrupt_left - 1, depth - 1)
+            except _Violation as v:
+                findings.append(Finding(v.check, _SELF, 0, v.message))
+                return
+
+    root = Cluster(n_nodes, limit, sem)
+    dfs(root, takes, max_disruptions, depth=takes * 3 + max_disruptions + 4)
+    return explored, findings
+
+
+def _snapshot(c: Cluster):
+    return (
+        [(list(n.added), list(n.taken), n.admitted) for n in c.nodes],
+        {k: list(v) for k, v in c.links.items()},
+        None if c.partition is None else dict(c.partition),
+    )
+
+
+def _restore(template: Cluster, snap) -> Cluster:
+    nodes, links, part = snap
+    c = Cluster(len(template.nodes), template.nodes[0].limit, template.sem)
+    for node, (a, t, adm) in zip(c.nodes, nodes):
+        node.added = list(a)
+        node.taken = list(t)
+        node.admitted = adm
+    c.links = {k: list(v) for k, v in links.items()}
+    c.partition = None if part is None else dict(part)
+    return c
+
+
+def check_idempotence(
+    n_nodes: int = 2, limit: int = 3, takes: int = 3, sem: Semantics = CLEAN
+) -> List[Finding]:
+    """PTC004: for every take sequence, delivering each broadcast once, in
+    reverse order, and with every packet duplicated must all land on the
+    same replica state (dup/reorder tolerance at ingest)."""
+    findings: List[Finding] = []
+    for seq in itertools.product(range(n_nodes), repeat=takes):
+        base = Cluster(n_nodes, limit, sem)
+        for i in seq:
+            base.take(i)
+        snap = _snapshot(base)
+
+        def run(order, dup):
+            c = _restore(base, snap)
+            try:
+                for (i, j), q in c.links.items():
+                    idxs = list(range(len(q)))
+                    if order == "reversed":
+                        idxs = idxs[::-1]
+                    for idx in idxs:
+                        c._merge_checked(j, q[idx])
+                        if dup:
+                            c._merge_checked(j, q[idx])
+                    q.clear()
+            except _Violation as v:
+                findings.append(Finding(v.check, _SELF, 0, v.message))
+            return [n.state() for n in c.nodes]
+
+        once = run("fifo", dup=False)
+        rev = run("reversed", dup=False)
+        duped = run("fifo", dup=True)
+        if once != rev or once != duped:
+            findings.append(
+                Finding(
+                    "PTC004",
+                    _SELF,
+                    0,
+                    f"dup/reorder delivery diverged (takes={seq}): "
+                    f"{once} vs {rev} vs {duped}",
+                )
+            )
+            break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points
+
+
+def check_protocol(sem: Semantics = CLEAN) -> List[Finding]:
+    """Every invariant suite over one semantics. Clean → must be empty;
+    mutated → must NOT be."""
+    findings: List[Finding] = []
+    findings += check_ap_bound(n_nodes=2, limit=2, extra_takes=2, sem=sem)
+    findings += check_ap_bound(n_nodes=3, limit=1, extra_takes=1, sem=sem)
+    _, async_findings = check_async_schedules(sem=sem)
+    findings += async_findings
+    findings += check_idempotence(sem=sem)
+    # De-duplicate identical findings from overlapping suites.
+    seen = set()
+    out = []
+    for f in findings:
+        key = (f.check, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def check_repo() -> List[Finding]:
+    """The stage-6 gate: the clean protocol must satisfy every invariant,
+    and every registered mutation must be rejected by at least one."""
+    findings = list(check_protocol(CLEAN))
+    for name, sem in MUTATIONS.items():
+        caught = check_protocol(sem)
+        if not caught:
+            findings.append(
+                Finding(
+                    "PTC005",
+                    _SELF,
+                    0,
+                    f"seeded protocol mutation '{name}' was NOT rejected — "
+                    "the checker has lost its teeth",
+                )
+            )
+    return findings

@@ -242,6 +242,30 @@ class Command:
                 except Exception:  # pragma: no cover
                     log.exception("final checkpoint failed")
             log.info("shutting down")
+            # Graceful-shutdown flush: re-broadcast the final state of
+            # recently-active buckets (bounded, paced) BEFORE the transport
+            # closes, so a clean restart doesn't silently shed recent takes
+            # whose last organic broadcast was lost. Best-effort: any
+            # failure degrades to the old behavior (peers re-learn the
+            # state via incast on next contact).
+            try:
+                states = (
+                    engine.drain_dirty_states(limit=1024)
+                    if replicator.peers
+                    else []
+                )
+                for lo in range(0, len(states), 64):
+                    replicator.broadcast_states(states[lo : lo + 64])
+                    await asyncio.sleep(0.002)  # pace; lets the loop send
+                if states:
+                    from patrol_tpu.utils import profiling
+
+                    profiling.COUNTERS.inc("shutdown_flush_states", len(states))
+                    log.info(
+                        "shutdown flush", extra={"states": len(states)}
+                    )
+            except Exception:  # pragma: no cover
+                log.exception("shutdown flush failed")
             if server is not None:
                 server.close()
                 with contextlib.suppress(asyncio.TimeoutError):

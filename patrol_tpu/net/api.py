@@ -171,6 +171,12 @@ class API:
         non-UTF8 names into U+FFFD. Over-long names → the api.go:55-58
         400."""
         name = unquote(raw_name, errors="surrogateescape")
+        if name.startswith("\x00"):
+            # NUL-led names are the replication control channel (probe
+            # pings, anti-entropy digests — net/replication.py
+            # CTRL_PREFIX); a user bucket there would collide with
+            # control packets and silently fail to replicate.
+            return name, (400, b"reserved bucket name", "text/plain")
         try:
             name_bytes_len = len(name.encode("utf-8", "surrogateescape"))
         except UnicodeEncodeError:  # lone surrogates not from the escape range

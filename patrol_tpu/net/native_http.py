@@ -206,7 +206,29 @@ class NativeHTTPFront:
             Rate(freq=int(self._freqs[i]), per_ns=int(self._pers[i]))
             for i in range(nt)
         ]
-        res = repo.submit_takes_batch(names, rates, self._counts[:nt])
+        counts = self._counts[:nt]
+        reserved = [i for i in range(nt) if names[i].startswith("\x00")]
+        if reserved:
+            # NUL-led names are the replication control channel
+            # (net/replication.py CTRL_PREFIX) — not a legal bucket
+            # namespace. The python front 400s them in _decode_name;
+            # mirror that here BEFORE the engine can bind a row (the
+            # in-front C++ path only ever serves rows this pump created,
+            # so rejecting creation closes the namespace on this front).
+            sel = np.array(reserved, np.intp)
+            self.lib.pt_http_complete_takes(
+                self.h, tags[sel], streams[sel],
+                np.full(len(sel), 400, np.int32),
+                np.zeros(len(sel), np.int64), len(sel),
+            )
+            keep = [i for i in range(nt) if i not in set(reserved)]
+            if not keep:
+                return
+            ksel = np.array(keep, np.intp)
+            tags, streams, counts = tags[ksel], streams[ksel], counts[ksel]
+            names = [names[i] for i in keep]
+            rates = [rates[i] for i in keep]
+        res = repo.submit_takes_batch(names, rates, counts)
         if res is None:  # pool spent with everything pinned: rare overload
             raise RuntimeError("bucket pool spent; takes dropped")
         self._cq.put((tags, streams, [t for t, _ in res]))

@@ -26,7 +26,17 @@ import numpy as np
 
 from patrol_tpu import native
 from patrol_tpu.ops import wire
-from patrol_tpu.net.replication import ReplyGate, SlotTable, parse_addr, _resolve
+from patrol_tpu.net.replication import (
+    CTRL_PREFIX,
+    PROBE_ACK_NAME,
+    PROBE_NAME,
+    PeerHealth,
+    ReplyGate,
+    SlotTable,
+    parse_addr,
+    _is_ip,
+    _resolve,
+)
 from patrol_tpu.utils import profiling
 
 log = logging.getLogger("patrol.native-replication")
@@ -64,20 +74,48 @@ class NativeReplicator:
         # builds); "compat" = raw own-lane headers + base trailers for
         # rolling upgrades. See ops/wire.py module docs.
         self.wire_mode = wire_mode
-        peers: List[Tuple[str, int]] = [
-            _resolve(p) for p in dict.fromkeys(peer_addrs) if p != node_addr
-        ]
+        # Unresolvable peers are health-tracked for re-resolution but
+        # excluded from the fan-out arrays (inet_aton on a hostname would
+        # have crashed this constructor before the resilience layer).
+        self.health = PeerHealth()
+        peers: List[Tuple[str, int]] = []
+        for p in dict.fromkeys(peer_addrs):
+            if p == node_addr:
+                continue
+            a = _resolve(p)
+            ok = _is_ip(a[0])
+            self.health.add_peer(p, a, resolved=ok)
+            if ok:
+                peers.append(a)
+            else:
+                self.log.warning("peer %s unresolvable at startup; will retry", p)
         self.peers = peers
-        self._peer_ips = np.array([_ip_to_u32(h) for h, _ in peers], np.uint32)
-        self._peer_ports = np.array([p for _, p in peers], np.uint16)
+        self._endpoints = (
+            np.array([_ip_to_u32(h) for h, _ in peers], np.uint32),
+            np.array([p for _, p in peers], np.uint16),
+        )
         self.repo = None  # wired by the supervisor
         self.reply_gate = ReplyGate()
         self.rx_packets = 0
         self.rx_errors = 0
         self.tx_packets = 0
+        self.send_errors = 0
         # Fault injection: predicate (host, port)→bool; True drops traffic
         # to/from that peer (partition simulation). Settable at runtime.
         self.drop_addr = None
+        # Scripted fault injection (net/faultnet.py). While set, rx runs
+        # the per-packet python path (chaos is a test/debug mode; the
+        # vectorized batch path resumes the moment it is detached).
+        self.faultnet = None
+        from patrol_tpu.net.antientropy import AntiEntropy
+
+        self.antientropy = AntiEntropy(self)
+        self._probe_bytes = wire.encode(
+            wire.WireState(name=PROBE_NAME, added=0.0, taken=0.0, elapsed_ns=0)
+        )
+        self._probe_ack_bytes = wire.encode(
+            wire.WireState(name=PROBE_ACK_NAME, added=0.0, taken=0.0, elapsed_ns=0)
+        )
         self._stopped = threading.Event()
         # Reused rx staging (device-commit pipeline): the slot/flag planes
         # the engine's ingest consumes are refilled into per-replicator
@@ -120,7 +158,21 @@ class NativeReplicator:
                 self.log.warning("recv failed: %s", exc)
                 continue
             n = len(packets)
+            fn = self.faultnet
+            if fn is not None:
+                # Chaos mode: per-packet python ingestion so every fault
+                # primitive (dup/reorder/delay release) applies exactly as
+                # on the asyncio backend. Throughput is not the point here.
+                for data, addr in fn.due():
+                    self._ingest_py(data, addr)
+                for i in range(n):
+                    addr = (_u32_to_ip(int(ips[i])), int(ports[i]))
+                    for payload in fn.filter(bytes(packets[i][: sizes[i]]), addr):
+                        self._ingest_py(payload, addr)
+                self._health_tick()
+                continue
             if n == 0 or self.repo is None:
+                self._health_tick()
                 continue
             self.rx_packets += n
             # Fully vectorized wire→engine: batch C++ decode into reused
@@ -141,6 +193,14 @@ class NativeReplicator:
                     addr = (_u32_to_ip(int(k) >> 16), int(k) & 0xFFFF)
                     if self.drop_addr(addr):
                         live &= addr_key != k
+            if live.any():
+                # Liveness per unique sender; a quiet→alive transition
+                # triggers the heal-time anti-entropy exchange.
+                for k in np.unique(addr_key[live]):
+                    addr = (_u32_to_ip(int(k) >> 16), int(k) & 0xFFFF)
+                    healed = self.health.on_rx(addr)
+                    if healed is not None:
+                        self.antientropy.trigger(healed)
             # Incast requests (zero-state packets, repo.go:86-90).
             inc = (
                 live
@@ -196,18 +256,119 @@ class NativeReplicator:
                             [l[2] for l in lanes],
                         )
             if inc.any():
-                incasts = [
-                    (
-                        bytes(dbuf.names[i, : dbuf.name_lens[i]]).decode(
-                            "utf-8", "surrogateescape"
-                        ),
-                        int(ips[i]),
-                        int(ports[i]),
-                        int(dbuf.multi[i]) >= 1,  # requester's multi advert
+                incasts = []
+                for i in np.flatnonzero(inc):
+                    name = bytes(dbuf.names[i, : dbuf.name_lens[i]]).decode(
+                        "utf-8", "surrogateescape"
                     )
-                    for i in np.flatnonzero(inc)
-                ]
-                self._reply_incasts(incasts)
+                    if name.startswith(CTRL_PREFIX):
+                        # Probe pings / anti-entropy: never a bucket.
+                        self._handle_control(
+                            name, (_u32_to_ip(int(ips[i])), int(ports[i]))
+                        )
+                        continue
+                    incasts.append(
+                        (
+                            name,
+                            int(ips[i]),
+                            int(ports[i]),
+                            int(dbuf.multi[i]) >= 1,  # requester's multi advert
+                        )
+                    )
+                if incasts:
+                    self._reply_incasts(incasts)
+            self._health_tick()
+
+    def _ingest_py(self, data: bytes, addr: Tuple[str, int]) -> None:
+        """Single-packet python ingestion — the chaos-mode (faultnet) and
+        held-packet-release path. Mirrors the asyncio backend's rx logic
+        step for step so both backends converge identically under faults."""
+        if self.drop_addr is not None and self.drop_addr(addr):
+            return
+        self.rx_packets += 1
+        try:
+            state = wire.decode(data)
+        except ValueError:
+            self.rx_errors += 1
+            return
+        healed = self.health.on_rx(addr)
+        if healed is not None:
+            self.antientropy.trigger(healed)
+        if state.is_zero() and state.name.startswith(CTRL_PREFIX):
+            self._handle_control(state.name, addr)
+            return
+        if self.repo is None:
+            return
+        if state.is_zero():
+            self._reply_incasts(
+                [(state.name, _ip_to_u32(addr[0]), int(addr[1]), state.multi_ok)]
+            )
+            return
+        if state.lanes is not None:
+            for lane_slot, la, lt in state.lanes:
+                if lane_slot >= self.slots.max_slots:
+                    self.rx_errors += 1
+                    continue
+                self.repo.apply_delta(
+                    wire.WireState(
+                        name=state.name, added=state.added, taken=state.taken,
+                        elapsed_ns=state.elapsed_ns, origin_slot=lane_slot,
+                        cap_nt=state.cap_nt, lane_added_nt=la, lane_taken_nt=lt,
+                    ),
+                    lane_slot,
+                )
+            return
+        slot = (
+            state.origin_slot
+            if state.origin_slot is not None
+            and state.origin_slot < self.slots.max_slots
+            else self.slots.resolve(addr)
+        )
+        if slot is None:
+            self.rx_errors += 1
+            return
+        self.repo.apply_delta(state, slot, scalar=state.origin_slot is None)
+
+    def _handle_control(self, name: str, addr: Tuple[str, int]) -> None:
+        if name == PROBE_NAME:
+            if self.reply_gate.allow(PROBE_ACK_NAME, addr):
+                self.unicast(self._probe_ack_bytes, addr)
+        elif name == PROBE_ACK_NAME:
+            pass  # on_rx already refreshed liveness
+        elif self.antientropy is not None:
+            self.antientropy.handle(name, addr)
+
+    def _health_tick(self) -> None:
+        """Probe/backoff/re-resolution schedule, driven from the rx thread
+        (it wakes at least every recv timeout). Errors never kill rx."""
+        try:
+            probes, resolves = self.health.tick()
+            for addr in probes:
+                self.unicast(self._probe_bytes, addr)
+            for p in resolves:
+                self._reresolve_peer(p)
+        except Exception:  # pragma: no cover - rx loop must survive
+            self.log.exception("health tick failed")
+
+    def _reresolve_peer(self, p) -> None:
+        old = p.addr
+        try:
+            new = _resolve(p.addr_str)
+        except Exception:  # pragma: no cover - resolver must never raise
+            return
+        if not _is_ip(new[0]) or new == old:
+            return
+        self.slots.realias(old, new)
+        self.health.mark_resolved(p, new)
+        peers = [a for a in self.peers if a != old] + [new]
+        self.peers = peers
+        # One atomic attribute swap: the engine thread reads ips+ports as
+        # a single tuple, so it can never see a half-updated fan-out.
+        self._endpoints = (
+            np.array([_ip_to_u32(h) for h, _ in peers], np.uint32),
+            np.array([pt for _, pt in peers], np.uint16),
+        )
+        self.log.info("peer %s re-resolved to %s:%d", p.addr_str, new[0], new[1])
 
     def _encode_py(self, states):
         """Python-codec encode into the (n, 256) fan-out layout — the cold
@@ -250,15 +411,31 @@ class NativeReplicator:
 
     # -- send path ----------------------------------------------------------
 
+    def unicast(self, data: bytes, addr: Tuple[str, int]) -> None:
+        """Thread-safe single-datagram send (probes, acks, anti-entropy)."""
+        n = len(data)
+        pkts = np.zeros((1, 256), np.uint8)
+        pkts[0, :n] = np.frombuffer(data, np.uint8)
+        try:
+            self.tx_packets += self.sock.send_fanout(
+                pkts,
+                np.array([n], np.int32),
+                np.array([_ip_to_u32(addr[0])], np.uint32),
+                np.array([int(addr[1])], np.uint16),
+            )
+        except OSError:
+            self.send_errors += 1
+
     def _live_peers(self):
+        ips, ports = self._endpoints
         if self.drop_addr is None:
-            return self._peer_ips, self._peer_ports
+            return ips, ports
         keep = [
             i
-            for i, (h, p) in enumerate(self.peers)
-            if not self.drop_addr((h, p))
+            for i in range(len(ips))
+            if not self.drop_addr((_u32_to_ip(int(ips[i])), int(ports[i])))
         ]
-        return self._peer_ips[keep], self._peer_ports[keep]
+        return ips[keep], ports[keep]
 
     def _encode_states(self, states: Sequence[wire.WireState]):
         """Mode-gated C++ batch encode (see Replicator._payload_bytes for
@@ -300,7 +477,7 @@ class NativeReplicator:
     def broadcast_states(self, states: Sequence[wire.WireState]) -> None:
         """Full-state broadcast to every peer (repo.go:123-158); one
         sendmmsg per ≤1024-datagram chunk. Runs on the caller's thread."""
-        if not len(self._peer_ips) or not states:
+        if not len(self._endpoints[0]) or not states:
             return
         pkts, sizes = self._encode_states(states)
         ips, ports = self._live_peers()
@@ -328,7 +505,7 @@ class NativeReplicator:
         return pkts, sizes
 
     def send_incast_request(self, name: str) -> None:
-        if not len(self._peer_ips):
+        if not len(self._endpoints[0]):
             return
         try:
             # Base trailer with the multi-reply capability advert (0x04) —
@@ -349,18 +526,28 @@ class NativeReplicator:
 
     def close(self) -> None:
         self._stopped.set()
+        if self.antientropy is not None:
+            self.antientropy.close()
         self._rx_thread.join(timeout=2)
         self.sock.close()
 
     def stats(self) -> dict:
-        return {
+        out = {
             "replication_rx_packets": self.rx_packets,
             "replication_rx_errors": self.rx_errors,
             "replication_tx_packets": self.tx_packets,
+            "replication_send_errors": self.send_errors,
             "replication_peers": len(self.peers),
             "replication_incast_suppressed": self.reply_gate.suppressed,
             "replication_backend": 1,  # 1 = native
+            "faultnet_active": int(self.faultnet.active) if self.faultnet else 0,
         }
+        out.update(self.health.stats())
+        if self.antientropy is not None:
+            out.update(self.antientropy.stats())
+        if self.faultnet is not None:
+            out.update(self.faultnet.stats())
+        return out
 
 
 def available() -> bool:

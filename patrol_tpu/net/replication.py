@@ -17,27 +17,65 @@ Differences by design:
 * The reference resolves each peer address on every broadcast in a goroutine
   per peer (repo.go:142-151) — and checks a shadowed error, attempting sends
   with a nil address on resolve failure (known bug, SURVEY §2). Here peers
-  are resolved once at startup and sends are synchronous nonblocking
-  ``sendto`` calls on the event loop.
+  are resolved at startup, unresolvable peers are *excluded from the send
+  list and re-resolved with backoff* (never sent to with a junk address,
+  never allowed to crash the broadcast loop), and sends are synchronous
+  nonblocking ``sendto`` calls on the event loop.
+
+Resilience layer (this module + net/antientropy.py + net/faultnet.py):
+
+* :class:`PeerHealth` — per-peer liveness from rx traffic plus lightweight
+  probe pings on a reserved-name control channel, exponential backoff with
+  jitter on unanswered probes, and DNS re-resolution scheduling for
+  unresolvable/unreachable peers. Shared by both backends.
+* Control channel: zero-state packets whose name starts with
+  ``CTRL_PREFIX`` (``\\x00pt!``). On the wire they are ordinary v1 incast
+  requests for names no real bucket can have (the API rejects ``\\x00``
+  names long before the directory) — a reference node looks the bucket up,
+  misses, and stays silent, so the channel is invisible to v1 peers.
+  Carried over it: probe pings/acks (liveness) and the anti-entropy
+  digest/fetch exchange (net/antientropy.py).
+* Fault injection: an optional :class:`patrol_tpu.net.faultnet.FaultNet`
+  filters every received datagram (deterministic seeded drop / dup /
+  reorder / delay / corrupt + timed partition schedules). The legacy
+  ``drop_addr`` predicate is kept for the simple symmetric-partition case.
 """
 
 from __future__ import annotations
 
 import asyncio
 import itertools
+import random
 import socket
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from patrol_tpu.ops import wire
+from patrol_tpu.utils import profiling
 
 Addr = Tuple[str, int]
+
+# Reserved-name control channel. No legal bucket name starts with NUL
+# (net/api.py rejects control bytes in names), so these never collide
+# with user buckets; on v1 peers they read as incast requests for unknown
+# buckets and are silently ignored.
+CTRL_PREFIX = "\x00pt!"
+PROBE_NAME = CTRL_PREFIX + "probe"
+PROBE_ACK_NAME = CTRL_PREFIX + "probe-ack"
 
 
 def parse_addr(addr: str) -> Addr:
     host, _, port = addr.rpartition(":")
     return (host or "127.0.0.1", int(port))
+
+
+def _is_ip(host: str) -> bool:
+    try:
+        socket.inet_aton(host)
+        return True
+    except OSError:
+        return False
 
 
 def _resolve(addr: str) -> Addr:
@@ -47,6 +85,208 @@ def _resolve(addr: str) -> Addr:
         return infos[0][4][:2]
     except socket.gaierror:
         return (host, port)
+
+
+class _Peer:
+    __slots__ = (
+        "addr_str", "addr", "resolved", "last_rx", "ever_heard",
+        "probes_sent", "failures", "next_probe_at", "backoff_s",
+        "reresolves", "next_resolve_at",
+    )
+
+    def __init__(self, addr_str: str, addr: Addr, resolved: bool):
+        self.addr_str = addr_str
+        self.addr = addr
+        self.resolved = resolved
+        self.last_rx = 0.0
+        self.ever_heard = False
+        self.probes_sent = 0
+        self.failures = 0  # consecutive probes (or resolves) unanswered
+        self.next_probe_at = 0.0
+        self.backoff_s = 0.0
+        self.reresolves = 0
+        self.next_resolve_at = 0.0
+
+
+class PeerHealth:
+    """Per-peer replication health, shared by both backends.
+
+    Liveness is passive-first: ANY datagram from a peer marks it alive for
+    ``alive_ttl_s``. When a peer has been silent past ``probe_interval_s``
+    the owner backend sends a probe ping (a reserved-name zero-state
+    packet, one datagram; patrol peers ack, reference peers ignore it);
+    consecutive unanswered probes back off exponentially with jitter up to
+    ``backoff_cap_s``, so a dead peer costs O(log) traffic, not a steady
+    ping stream. Unresolvable peers (startup resolve failure, or repeated
+    probe failure on a hostname peer) are scheduled for re-resolution on
+    the same backoff — the reference's shadowed-error resolve bug class
+    (SURVEY §2) made nil-address *sends*; here the peer simply drops out
+    of the fan-out until DNS answers, and is reported via ``stats()``.
+
+    Liveness NEVER gates data broadcasts: a reference (v1) peer answers no
+    probes yet must keep receiving state. Only unresolved peers are
+    excluded from the fan-out (there is no address to send to).
+
+    Thread-safety: mutated by the owner backend's single rx/health
+    context; ``stats()`` readers take the same lock.
+    """
+
+    def __init__(
+        self,
+        clock=time.monotonic,
+        seed: int = 0,
+        probe_interval_s: float = 1.0,
+        alive_ttl_s: float = 3.0,
+        backoff_cap_s: float = 15.0,
+        reresolve_after: int = 2,
+    ):
+        self.clock = clock
+        self.probe_interval_s = probe_interval_s
+        self.alive_ttl_s = alive_ttl_s
+        self.backoff_cap_s = backoff_cap_s
+        self.reresolve_after = reresolve_after
+        self._rng = random.Random(seed)
+        self._mu = threading.Lock()
+        self.peers: Dict[Addr, _Peer] = {}
+        self.rx_from_peers = 0
+        self.heals = 0  # dead→alive transitions observed
+
+    def add_peer(self, addr_str: str, addr: Addr, resolved: bool) -> _Peer:
+        p = _Peer(addr_str, addr, resolved)
+        with self._mu:
+            self.peers[addr] = p
+        return p
+
+    def configure(
+        self,
+        probe_interval_s: Optional[float] = None,
+        alive_ttl_s: Optional[float] = None,
+        backoff_cap_s: Optional[float] = None,
+    ) -> None:
+        """Re-tune intervals at runtime (chaos tests shrink them); resets
+        every peer's probe schedule so the new cadence applies now."""
+        with self._mu:
+            if probe_interval_s is not None:
+                self.probe_interval_s = probe_interval_s
+            if alive_ttl_s is not None:
+                self.alive_ttl_s = alive_ttl_s
+            if backoff_cap_s is not None:
+                self.backoff_cap_s = backoff_cap_s
+            for p in self.peers.values():
+                p.next_probe_at = 0.0
+                p.backoff_s = min(p.backoff_s, self.backoff_cap_s)
+
+    def on_rx(self, addr: Addr) -> Optional[Addr]:
+        """Record traffic from ``addr``. Returns the address when the peer
+        transitioned quiet→alive (first contact, or silence past the
+        alive TTL) — the caller's anti-entropy trigger."""
+        with self._mu:
+            p = self.peers.get(addr)
+            if p is None:
+                return None
+            now = self.clock()
+            was_dead = (not p.ever_heard) or (now - p.last_rx > self.alive_ttl_s)
+            p.last_rx = now
+            p.ever_heard = True
+            p.failures = 0
+            p.backoff_s = 0.0
+            p.next_probe_at = now + self.probe_interval_s
+            self.rx_from_peers += 1
+            if was_dead:
+                self.heals += 1
+                return addr
+            return None
+
+    def tick(self) -> Tuple[List[Addr], List[_Peer]]:
+        """Advance the probe/backoff schedule. Returns (addresses to probe
+        now, peers whose address should be re-resolved now). The caller
+        sends the probes / runs the resolves — this class never touches
+        sockets or DNS itself."""
+        probes: List[Addr] = []
+        resolves: List[_Peer] = []
+        with self._mu:
+            now = self.clock()
+            for p in self.peers.values():
+                if not p.resolved:
+                    if now >= p.next_resolve_at:
+                        p.failures += 1
+                        p.backoff_s = self._backoff(p.failures)
+                        p.next_resolve_at = now + p.backoff_s
+                        resolves.append(p)
+                    continue
+                if now - p.last_rx <= self.probe_interval_s:
+                    continue  # recently heard; no probe needed
+                if now < p.next_probe_at:
+                    continue
+                p.probes_sent += 1
+                p.failures += 1
+                p.backoff_s = self._backoff(p.failures)
+                p.next_probe_at = now + p.backoff_s
+                probes.append(p.addr)
+                if (
+                    p.failures >= self.reresolve_after
+                    and not _is_ip(parse_addr(p.addr_str)[0])
+                ):
+                    resolves.append(p)
+        if probes:
+            profiling.COUNTERS.inc("peer_probes_tx", len(probes))
+        return probes, resolves
+
+    def _backoff(self, failures: int) -> float:
+        """Exponential with jitter: base × 2^(n−1), jittered ×[0.75, 1.25],
+        capped. Jitter keeps a cluster's probes to a dead peer from
+        synchronizing into bursts."""
+        base = self.probe_interval_s * (2 ** min(failures - 1, 8))
+        return min(base, self.backoff_cap_s) * (0.75 + 0.5 * self._rng.random())
+
+    def mark_resolved(self, p: _Peer, new_addr: Addr) -> None:
+        """Adopt a (re)resolved address for a peer: re-key the map, reset
+        the failure schedule. Caller updates slot tables / fan-out lists."""
+        with self._mu:
+            self.peers.pop(p.addr, None)
+            p.addr = new_addr
+            p.resolved = True
+            p.failures = 0
+            p.backoff_s = 0.0
+            p.next_probe_at = 0.0
+            p.reresolves += 1
+            self.peers[new_addr] = p
+        profiling.COUNTERS.inc("peer_reresolves")
+
+    def alive_count(self) -> int:
+        with self._mu:
+            now = self.clock()
+            return sum(
+                1
+                for p in self.peers.values()
+                if p.ever_heard and now - p.last_rx <= self.alive_ttl_s
+            )
+
+    def stats(self) -> dict:
+        with self._mu:
+            now = self.clock()
+            alive = 0
+            backoff_ms = 0
+            unresolved = 0
+            probes = 0
+            reresolves = 0
+            for p in self.peers.values():
+                probes += p.probes_sent
+                reresolves += p.reresolves
+                if not p.resolved:
+                    unresolved += 1
+                if p.ever_heard and now - p.last_rx <= self.alive_ttl_s:
+                    alive += 1
+                else:
+                    backoff_ms = max(backoff_ms, int(p.backoff_s * 1000))
+        return {
+            "peer_alive": alive,
+            "peer_backoff_ms": backoff_ms,
+            "peer_unresolved": unresolved,
+            "peer_probes_tx": probes,
+            "peer_reresolves": reresolves,
+            "peer_heals": self.heals,
+        }
 
 
 def _encode_with_fallback(st: wire.WireState) -> bytes:
@@ -150,6 +390,18 @@ class SlotTable:
             self.slot_of[addr] = slot
             return slot
 
+    def realias(self, old: Addr, new: Addr) -> None:
+        """A member's address re-resolved to a new endpoint (DNS moved, or
+        a hostname finally resolved): the NEW address must map to the SAME
+        lane — a fresh dynamic slot would fork the peer's PN lane and
+        permanently double its contribution after the old lane's state
+        re-merges. The old alias is kept: late packets from the previous
+        address still attribute correctly."""
+        with self._mu:
+            slot = self.slot_of.get(old)
+            if slot is not None and new not in self.slot_of:
+                self.slot_of[new] = slot
+
 
 class Replicator(asyncio.DatagramProtocol):
     """One UDP socket for send + receive, like the reference's single
@@ -170,10 +422,6 @@ class Replicator(asyncio.DatagramProtocol):
         wire_mode: str = "aggregate",
     ):
         self.node_addr = node_addr
-        # Self-filtering peer list (repo.go:36-41).
-        self.peers: List[Addr] = [
-            _resolve(p) for p in dict.fromkeys(peer_addrs) if p != node_addr
-        ]
         self.slots = slots
         self.log = log
         if wire_mode not in ("aggregate", "compat"):
@@ -186,10 +434,41 @@ class Replicator(asyncio.DatagramProtocol):
         self.rx_packets = 0
         self.rx_errors = 0
         self.tx_packets = 0
+        self.send_errors = 0  # OSErrors surfaced by the transport
+        # Self-filtering peer list (repo.go:36-41); unresolvable peers are
+        # health-tracked for re-resolution but EXCLUDED from the fan-out —
+        # the reference's shadowed-error resolve bug attempted sends with
+        # a nil address (SURVEY §2); we degrade gracefully instead.
+        self.health = PeerHealth()
+        self.peers: List[Addr] = []
+        for p in dict.fromkeys(peer_addrs):
+            if p == node_addr:
+                continue
+            a = _resolve(p)
+            ok = _is_ip(a[0])
+            self.health.add_peer(p, a, resolved=ok)
+            if ok:
+                self.peers.append(a)
+            elif log:
+                log.warning("peer %s unresolvable at startup; will retry", p)
         # Fault injection (the network-layer sibling of -clock-offset,
         # main.go:30): a predicate addr→bool; True drops traffic to/from
         # that address, simulating a partition. Settable at runtime.
         self.drop_addr: Optional[callable] = None
+        # Scripted fault injection (net/faultnet.py): filters every
+        # received datagram when set. Settable at runtime.
+        self.faultnet = None
+        from patrol_tpu.net.antientropy import AntiEntropy
+
+        self.antientropy = AntiEntropy(self)
+        self._health_task: Optional[asyncio.Task] = None
+        self._health_tick_s = 0.1
+        self._probe_bytes = wire.encode(
+            wire.WireState(name=PROBE_NAME, added=0.0, taken=0.0, elapsed_ns=0)
+        )
+        self._probe_ack_bytes = wire.encode(
+            wire.WireState(name=PROBE_ACK_NAME, added=0.0, taken=0.0, elapsed_ns=0)
+        )
 
     @classmethod
     async def create(
@@ -205,14 +484,84 @@ class Replicator(asyncio.DatagramProtocol):
         self.loop = loop
         host, port = parse_addr(node_addr)
         await loop.create_datagram_endpoint(lambda: self, local_addr=(host, port))
+        self._health_task = asyncio.ensure_future(self._health_loop())
         return self
 
     def connection_made(self, transport) -> None:
         self.transport = transport
 
+    def error_received(self, exc: OSError) -> None:
+        # Unconnected-UDP send errors (ICMP unreachable, EAI failures from
+        # a junk address) surface here without peer attribution; counted,
+        # never fatal — the broadcast loop must survive any peer state.
+        self.send_errors += 1
+        if self.log:
+            self.log.debug("transport error: %s", exc)
+
+    # -- peer health / control channel --------------------------------------
+
+    async def _health_loop(self) -> None:
+        """Periodic: release faultnet-held packets, advance the probe /
+        backoff / re-resolution schedule. Errors are logged, never fatal."""
+        while True:
+            await asyncio.sleep(self._health_tick_s)
+            try:
+                if self.faultnet is not None:
+                    for data, addr in self.faultnet.due():
+                        self._ingest(data, addr)
+                probes, resolves = self.health.tick()
+                for addr in probes:
+                    self._send(self._probe_bytes, addr)
+                for p in resolves:
+                    await self._reresolve_peer(p)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                if self.log:
+                    self.log.exception("health tick failed")
+
+    async def _reresolve_peer(self, p) -> None:
+        """Re-run DNS for a peer off the event loop; adopt a changed
+        address atomically across peer list, slot table, and health."""
+        assert self.loop is not None
+        old = p.addr
+        try:
+            new = await self.loop.run_in_executor(None, _resolve, p.addr_str)
+        except Exception:
+            return
+        if not _is_ip(new[0]) or new == old:
+            return
+        self.slots.realias(old, new)
+        self.health.mark_resolved(p, new)
+        self.peers = [a for a in self.peers if a != old] + [new]
+        if self.log:
+            self.log.info(
+                "peer re-resolved", extra={"peer": p.addr_str, "addr": f"{new[0]}:{new[1]}"}
+            )
+
+    def _handle_control(self, name: str, addr: Addr) -> None:
+        """Reserved-name zero-state packets: probe pings/acks and the
+        anti-entropy exchange. Never creates buckets, never incast-replies."""
+        if name == PROBE_NAME:
+            # Ack so the prober sees liveness even on an idle link; the
+            # reply gate bounds hostile probe floods like incast storms.
+            if self.reply_gate.allow(PROBE_ACK_NAME, addr):
+                self._send(self._probe_ack_bytes, addr)
+        elif name == PROBE_ACK_NAME:
+            pass  # on_rx already refreshed liveness
+        elif self.antientropy is not None:
+            self.antientropy.handle(name, addr)
+
     # -- receive path (repo.go:54-92) ---------------------------------------
 
     def datagram_received(self, data: bytes, addr: Addr) -> None:
+        if self.faultnet is not None:
+            for payload in self.faultnet.filter(data, addr):
+                self._ingest(payload, addr)
+        else:
+            self._ingest(data, addr)
+
+    def _ingest(self, data: bytes, addr: Addr) -> None:
         if self.drop_addr is not None and self.drop_addr(addr):
             return
         self.rx_packets += 1
@@ -222,6 +571,14 @@ class Replicator(asyncio.DatagramProtocol):
             self.rx_errors += 1
             if self.log:
                 self.log.debug("bad packet", extra={"peer": f"{addr[0]}:{addr[1]}"})
+            return
+        healed = self.health.on_rx(addr)
+        if healed is not None and self.antientropy is not None:
+            # Peer (re)joined or a partition healed: reconcile divergent
+            # buckets by digest instead of waiting for organic takes.
+            self.antientropy.trigger(healed)
+        if state.is_zero() and state.name.startswith(CTRL_PREFIX):
+            self._handle_control(state.name, addr)
             return
         if self.repo is None:
             return
@@ -300,8 +657,19 @@ class Replicator(asyncio.DatagramProtocol):
         if self.drop_addr is not None and self.drop_addr(addr):
             return
         if self.transport is not None and not self.transport.is_closing():
-            self.transport.sendto(data, addr)
+            try:
+                self.transport.sendto(data, addr)
+            except OSError:
+                # A peer's address going bad mid-run must degrade to a
+                # counted error, never crash the broadcast loop.
+                self.send_errors += 1
+                return
             self.tx_packets += 1
+
+    def unicast(self, data: bytes, addr: Addr) -> None:
+        """Thread-safe single-datagram send (anti-entropy worker)."""
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(self._send, data, addr)
 
     def _broadcast_now(self, payloads: List[bytes]) -> None:
         for data in payloads:
@@ -358,14 +726,27 @@ class Replicator(asyncio.DatagramProtocol):
             self.loop.call_soon_threadsafe(self._broadcast_now, [data])
 
     def close(self) -> None:
+        if self._health_task is not None:
+            self._health_task.cancel()
+            self._health_task = None
+        if self.antientropy is not None:
+            self.antientropy.close()
         if self.transport is not None:
             self.transport.close()
 
     def stats(self) -> dict:
-        return {
+        out = {
             "replication_rx_packets": self.rx_packets,
             "replication_rx_errors": self.rx_errors,
             "replication_tx_packets": self.tx_packets,
+            "replication_send_errors": self.send_errors,
             "replication_peers": len(self.peers),
             "replication_incast_suppressed": self.reply_gate.suppressed,
+            "faultnet_active": int(self.faultnet.active) if self.faultnet else 0,
         }
+        out.update(self.health.stats())
+        if self.antientropy is not None:
+            out.update(self.antientropy.stats())
+        if self.faultnet is not None:
+            out.update(self.faultnet.stats())
+        return out

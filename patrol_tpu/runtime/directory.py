@@ -616,6 +616,19 @@ class BucketDirectory:
     def name_of(self, row: int) -> Optional[str]:
         return self._names[row]
 
+    def bound_names(self, limit: Optional[int] = None) -> list:
+        """Names currently bound, most-recently-used first, capped at
+        ``limit`` — the anti-entropy digest working set (and the
+        shutdown-flush candidate list). MRU-first means a cap on a huge
+        directory covers the buckets most likely to hold fresh spend."""
+        with self._mu:
+            rows = np.flatnonzero(self._bound)
+            if limit is not None and len(rows) > limit:
+                part = np.argpartition(-self.last_used_ns[rows], limit - 1)[:limit]
+                rows = rows[part]
+            order = np.argsort(-self.last_used_ns[rows], kind="stable")
+            return [self._names[int(r)] for r in rows[order]]
+
     def init_cap_base(self, row: int, cap_nt: int) -> int:
         """Lazily pin the capacity base for a row: first non-zero capacity
         wins, committed even when the take that carried it fails

@@ -739,6 +739,15 @@ class DeviceEngine:
         self._host_takes = 0  # takes served by the fast path
         self._promotions = 0  # host→device residency transitions
         self._demotions = 0  # device→host residency transitions (idle)
+        # Recently-broadcast bucket names (insertion-ordered, bounded):
+        # the graceful-shutdown flush re-broadcasts these buckets' FINAL
+        # state so a lost last-broadcast datagram doesn't silently shed a
+        # stopping node's most recent takes (tests/test_cluster.py
+        # TestShutdownFlush). Names, not rows — a row may be recycled
+        # between the broadcast and the flush.
+        self._dirty_mu = threading.Lock()
+        self._dirty_names: Dict[str, None] = {}
+        self._dirty_cap = 4096
         # Idle-demotion bookkeeping (feeder-driven): rows promoted to the
         # device path and still bound, their device-take counts in the
         # current demote window, and the window's start. Set mutations run
@@ -995,11 +1004,40 @@ class DeviceEngine:
         return True
 
     def _emit_broadcasts(self, broadcasts: List[wire.WireState]) -> None:
-        if broadcasts and self.on_broadcast is not None:
+        if not broadcasts:
+            return
+        self._note_dirty(broadcasts)
+        if self.on_broadcast is not None:
             try:
                 self.on_broadcast(broadcasts)
             except Exception:  # pragma: no cover
                 log.exception("broadcast hook failed")
+
+    def _note_dirty(self, broadcasts: List[wire.WireState]) -> None:
+        """Remember which buckets this node broadcast state for (bounded,
+        newest kept) — the shutdown-flush working set."""
+        with self._dirty_mu:
+            d = self._dirty_names
+            for st in broadcasts:
+                d.pop(st.name, None)  # move-to-back keeps recency order
+                d[st.name] = None
+            while len(d) > self._dirty_cap:
+                d.pop(next(iter(d)))
+
+    def drain_dirty_states(self, limit: int = 1024) -> List[wire.WireState]:
+        """Snapshot the most recently broadcast buckets' CURRENT full lane
+        state and clear the dirty set — the graceful-shutdown flush
+        payload. Bounded by ``limit`` buckets (newest first); per-lane
+        states, so both replication backends ship them on the normal
+        broadcast path."""
+        with self._dirty_mu:
+            names = list(self._dirty_names)[-limit:]
+            self._dirty_names.clear()
+        out: List[wire.WireState] = []
+        for lo in range(0, len(names), 64):
+            for states in self.snapshot_many(names[lo : lo + 64]).values():
+                out.extend(states)
+        return out
 
     def _promote_locked(self, row: int) -> None:
         """Mark a bucket for promotion to device residency. The row KEEPS
@@ -2603,11 +2641,7 @@ class DeviceEngine:
                 )
         if unpin:
             self.directory.unpin_rows(unpin)
-        if broadcasts and self.on_broadcast is not None:
-            try:
-                self.on_broadcast(broadcasts)
-            except Exception:  # pragma: no cover
-                log.exception("broadcast hook failed")
+        self._emit_broadcasts(broadcasts)
 
     def _apply_merges(self, deltas: DeltaArrays) -> None:
         # Scalar-semantics (reference-peer) deltas go through the

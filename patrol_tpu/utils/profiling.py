@@ -215,7 +215,14 @@ class CounterRegistry:
     * ``dispatch_ahead_depth`` — high-water count of device ticks in
       flight ahead of the completer (the pipeline's achieved depth);
     * ``rx_staging_reuse_hits`` — native rx batches served from the
-      replicator's reused slot/flag staging planes.
+      replicator's reused slot/flag staging planes;
+    * ``peer_probes_tx`` / ``peer_reresolves`` — replication peer-health
+      probe pings sent and DNS re-resolution attempts (net/replication.py
+      ``PeerHealth``);
+    * ``ae_resync_buckets`` / ``ae_packets_tx`` — buckets re-synced and
+      packets sent by heal-time anti-entropy (net/antientropy.py);
+    * ``shutdown_flush_states`` — final dirty bucket states broadcast by
+      the graceful-shutdown flush (command.py).
 
     Monotonic counts + high-water gauges only; all call sites are
     per-tick/per-batch (kHz), so one mutex is noise-level overhead."""
@@ -227,6 +234,11 @@ class CounterRegistry:
         "commit_dispatches",
         "dispatch_ahead_depth",
         "rx_staging_reuse_hits",
+        "peer_probes_tx",
+        "peer_reresolves",
+        "ae_resync_buckets",
+        "ae_packets_tx",
+        "shutdown_flush_states",
     )
 
     def __init__(self):

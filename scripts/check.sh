@@ -30,6 +30,17 @@
 #             store schedule explorer, and the NATIVE_EFFECTS
 #             completeness check; plus the pytest -m abi self-tests.
 #             Skips LOUDLY (exit 77) when libpatrolhost cannot build.
+#   protocol— patrol-protocol: the bounded replication-protocol model
+#             checker (patrol_tpu/analysis/protocol.py,
+#             scripts/protocol_repo.py): enumerates bounded 2-3 node
+#             cluster schedules (takes × drop/dup/reorder/partition/heal)
+#             against a step-for-step protocol model and machine-checks
+#             convergence-after-heal, state monotonicity, the AP bound
+#             admitted <= limit × partition_sides, and dup/reorder
+#             idempotence (PTC001-004) — with seeded protocol mutations
+#             (e.g. resync-overwrites-instead-of-joins) demonstrably
+#             rejected (PTC005); plus the pytest -m protocol self-tests.
+#             Pure python, never skips.
 #   asan-py — OPT-IN (never in the default set; select explicitly with
 #             --stage): the ctypes-facing pytest subset under
 #             LD_PRELOAD=libasan with an ASan-instrumented
@@ -42,23 +53,23 @@
 #                    check.sh --stage asan-py        # the opt-in seam check
 # The final line is machine-readable so an outer CI can assert that no
 # stage silently skipped (scripts/ci_gate.sh does exactly that):
-#                    PATROL_CHECK stages=5 pass=4 skip=1 fail=0 skipped=tidy failed=-
+#                    PATROL_CHECK stages=6 pass=5 skip=1 fail=0 skipped=tidy failed=-
 #
 # Prereqs and the lint/prove suppression format are documented in
 # README.md ("patrol-check").
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-DEFAULT_STAGES="lint,tidy,san,prove,abi"
+DEFAULT_STAGES="lint,tidy,san,prove,abi,protocol"
 STAGES="$DEFAULT_STAGES"
 while [[ $# -gt 0 ]]; do
   case "$1" in
     --stage|--stages) STAGES="$2"; shift 2 ;;
     --stage=*|--stages=*) STAGES="${1#*=}"; shift ;;
     -h|--help)
-      sed -n '2,52p' "$0" | sed 's/^# \{0,1\}//'
+      sed -n '2,59p' "$0" | sed 's/^# \{0,1\}//'
       exit 0 ;;
-    *) echo "unknown argument: $1 (try --stage lint,tidy,san,prove,abi,asan-py)" >&2
+    *) echo "unknown argument: $1 (try --stage lint,tidy,san,prove,abi,protocol,asan-py)" >&2
        exit 2 ;;
   esac
 done
@@ -152,6 +163,18 @@ stage_abi() (
   fi
 )
 
+stage_protocol() (
+  set -euo pipefail
+  echo "== patrol-check [protocol] bounded replication-protocol model checker =="
+  python scripts/protocol_repo.py
+  if have_pytest; then
+    env JAX_PLATFORMS=cpu python -m pytest tests/test_protocol.py -q -m protocol \
+      -p no:cacheprovider
+  else
+    echo "pytest unavailable: protocol self-tests skipped (checker itself ran)"
+  fi
+)
+
 stage_asan_py() (
   set -euo pipefail
   echo "== patrol-check [asan-py] ctypes seam under LD_PRELOAD=libasan =="
@@ -215,11 +238,11 @@ run_stage() {
 IFS=',' read -r -a SELECTED <<<"$STAGES"
 for s in "${SELECTED[@]}"; do
   case "$s" in
-    lint|tidy|san|prove|abi|asan-py) ;;
-    *) echo "unknown stage: '$s' (valid: lint tidy san prove abi asan-py)" >&2; exit 2 ;;
+    lint|tidy|san|prove|abi|protocol|asan-py) ;;
+    *) echo "unknown stage: '$s' (valid: lint tidy san prove abi protocol asan-py)" >&2; exit 2 ;;
   esac
 done
-for s in lint tidy san prove abi asan-py; do
+for s in lint tidy san prove abi protocol asan-py; do
   for sel in "${SELECTED[@]}"; do
     if [[ "$sel" == "$s" ]]; then
       case "$s" in
@@ -228,6 +251,7 @@ for s in lint tidy san prove abi asan-py; do
         san)     run_stage san     stage_san ;;
         prove)   run_stage prove   stage_prove ;;
         abi)     run_stage abi     stage_abi ;;
+        protocol) run_stage protocol stage_protocol ;;
         asan-py) run_stage asan-py stage_asan_py ;;
       esac
     fi

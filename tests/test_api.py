@@ -114,6 +114,17 @@ class TestTakeRoute:
         assert status == 400
         assert "bucket name larger than 231" in body
 
+    def test_reserved_control_channel_name_400(self, srv):
+        """NUL-led names are the replication control channel (probe pings,
+        anti-entropy digests — net/replication.py CTRL_PREFIX); a user
+        bucket there would collide with control packets and silently fail
+        to replicate. The native front rejects them too
+        (tests/test_native_http.py)."""
+        status, _ = srv.request("POST", "/take/%00pt!probe?rate=1:1s")
+        assert status == 400  # (the native front's body is the bare "0")
+        status, _ = srv.request("GET", "/tokens/%00pt!aed")
+        assert status == 400
+
     def test_non_utf8_percent_name_is_one_raw_byte_bucket(self, srv):
         """%FF must decode to the raw byte 0xFF (reference names are raw
         bytes, bucket.go:64-88) identically on BOTH fronts: the limit

@@ -1,9 +1,17 @@
-"""Fault-tolerance tests: checkpoint/resume and partition-heal.
+"""Fault-tolerance tests: checkpoint/resume, partition-heal, and the
+seeded fault-injection (faultnet) convergence suite.
 
 The reference's fault story is by-construction (SURVEY §5): CvRDT state
 tolerates loss/duplication; partitions degrade to per-side enforcement
 (README.md:64-76); recovery is incast. These tests pin those properties
-down explicitly — plus checkpoint/resume, which the reference lacks.
+down explicitly — plus checkpoint/resume, which the reference lacks, and
+the resilience layer's guarantees: every seeded fault schedule (drop /
+dup / reorder / delay / corrupt / partition+heal) converges BIT-EXACTLY
+to the no-fault fixpoint, and heal-time anti-entropy reconverges a
+partitioned cluster with zero take traffic inside a bounded packet
+budget. Chaos clusters run on FROZEN clocks: with now == created the
+refill grant is exactly zero, so the converged lane planes are fully
+deterministic and the fixpoint can be asserted bit-for-bit.
 """
 
 import asyncio
@@ -14,6 +22,7 @@ import time
 import pytest
 
 from patrol_tpu.models.limiter import NANO, LimiterConfig
+from patrol_tpu.net.faultnet import FaultNet
 from patrol_tpu.ops.rate import Rate
 from patrol_tpu.runtime.directory import BucketDirectory
 from patrol_tpu.runtime.engine import DeviceEngine
@@ -264,3 +273,306 @@ class TestPartitionHeal:
             _heal(cluster)
             for cl in clients:
                 cl.close()
+
+
+# ---------------------------------------------------------------------------
+# seeded fault-injection (faultnet) suite
+
+
+def _frozen_clock_fn(i):
+    # Frozen at 1s: now == created forever, so the refill grant is zero on
+    # every take and the converged state is bit-deterministic.
+    return lambda: NANO
+
+
+def _attach_faultnets(cluster, seed, **faults):
+    nets = []
+    for i, cmd in enumerate(cluster.commands):
+        fn = FaultNet(seed=seed + i, self_addr=cmd.node_addr)
+        if faults:
+            fn.link(**faults)
+        cmd.replicator.faultnet = fn
+        nets.append(fn)
+    return nets
+
+
+def _quiesce_faultnets(cluster):
+    """Stop injecting faults but keep nets attached so held (delayed /
+    reorder-stranded) packets still release through due()."""
+    for cmd in cluster.commands:
+        fn = cmd.replicator.faultnet
+        if fn is not None:
+            fn.heal()
+            fn.link()  # default link config back to clean
+
+
+def _detach_faultnets(cluster):
+    for cmd in cluster.commands:
+        cmd.replicator.faultnet = None
+
+
+def _fast_health(cluster, probe=0.15, ttl=0.5, cap=0.4, ae_min=0.5):
+    for cmd in cluster.commands:
+        cmd.replicator.health.configure(
+            probe_interval_s=probe, alive_ttl_s=ttl, backoff_cap_s=cap
+        )
+        cmd.replicator.antientropy.min_interval_s = ae_min
+
+
+def _converged_views(cluster, name, deadline_s=10.0, retrigger=False):
+    """Poll until every node's scalar view of ``name`` is identical;
+    returns the converged (added_nt, taken_nt, elapsed_ns) tuple.
+    ``retrigger``: force a fresh anti-entropy round every ~1.5s while
+    waiting (an operator hammering resync), so a digest exchange that
+    raced the last in-flight merges cannot leave a stable residue."""
+    deadline = time.time() + deadline_s
+    next_trigger = 0.0
+    views = []
+    while time.time() < deadline:
+        if retrigger and time.time() >= next_trigger:
+            next_trigger = time.time() + 1.5
+            for cmd in cluster.commands:
+                for peer in cmd.replicator.peers:
+                    cmd.replicator.antientropy.trigger(peer, force=True)
+        views = []
+        for cmd in cluster.commands:
+            cmd.engine.flush()
+            row = cmd.engine.directory.lookup(name)
+            if row is None:
+                views.append(None)
+                continue
+            pn, elapsed = cmd.engine.row_view(row)
+            base = int(cmd.engine.directory.cap_base_nt[row])
+            views.append(
+                (base + int(pn[:, 0].sum()), int(pn[:, 1].sum()), int(elapsed))
+            )
+        if None not in views and len(set(views)) == 1:
+            return views[0]
+        time.sleep(0.05)
+    raise AssertionError(f"views did not converge: {views}")
+
+
+def _lane_planes(cluster, name):
+    out = []
+    for cmd in cluster.commands:
+        row = cmd.engine.directory.lookup(name)
+        pn, elapsed = cmd.engine.row_view(row)
+        out.append((pn.copy(), int(elapsed)))
+    return out
+
+
+SCHEDULES = {
+    "drop": dict(drop=0.4),
+    "dup": dict(dup=0.5),
+    "reorder": dict(reorder=0.5),
+    "delay": dict(delay_s=0.05),
+    "corrupt": dict(corrupt=0.4),
+}
+
+
+@pytest.fixture(scope="module", params=BACKEND_PARAMS)
+def chaos_cluster(request):
+    # python HTTP front: the native front's epoll thread takes time from
+    # CLOCK_REALTIME, which would re-introduce wall-clock refill grants
+    # and break the bit-exact fixpoint assertions.
+    c = Cluster(
+        3,
+        udp_backend=request.param,
+        clock_fn=_frozen_clock_fn,
+        http_front="python",
+    )
+    _fast_health(c)
+    yield c
+    c.close()
+
+
+@pytest.mark.chaos
+class TestSeededFaultSchedules:
+    """Acceptance: every seeded fault schedule converges bit-exactly to
+    the no-fault fixpoint after heal. The workload is one fault-free
+    priming take per node followed by 12 chaos-phase takes round-robin
+    against a 100-token bucket — every take is admitted regardless of
+    fault interleaving, and with frozen clocks the no-fault fixpoint is
+    exactly: added lanes all zero, taken lane of node i = 5·NANO,
+    elapsed 0, aggregate (100·NANO, 15·NANO, 0)."""
+
+    @pytest.mark.parametrize("kind", sorted(SCHEDULES))
+    def test_schedule_converges_to_no_fault_fixpoint(self, chaos_cluster, kind):
+        cluster = chaos_cluster
+        bucket = f"chaos-{kind}"
+        clients = [KeepAliveClient(p) for p in cluster.api_ports]
+        # Prime: one fault-free take per node, converged, BEFORE injecting
+        # faults. Bucket creation has a documented sub-µs residency race
+        # (engine._host_serve_ticket: an rx echo concurrent with the very
+        # first take can strand one delta in the device plane) that is
+        # accepted by design and orthogonal to what this suite pins down —
+        # the chaos phase must run against established buckets.
+        for cl in clients:
+            status, _ = cl.take(bucket, "100:1h")
+            assert status == 200
+        assert _converged_views(cluster, bucket) == (100 * NANO, 3 * NANO, 0)
+        nets = _attach_faultnets(cluster, seed=42, **SCHEDULES[kind])
+        try:
+            for i in range(12):
+                status, _ = clients[i % 3].take(bucket, "100:1h")
+                assert status == 200  # 100 ≫ 15: always admitted
+                time.sleep(0.005)
+            _quiesce_faultnets(cluster)
+            time.sleep(0.2)  # let queued (undropped) merges settle
+            # Heal-time reconciliation, explicitly force-triggered while
+            # polling (the drop/dup class keeps peers alive throughout, so
+            # there is no dead→alive edge to auto-trigger on — that path
+            # is covered by TestPartitionHealAntiEntropy).
+            view = _converged_views(cluster, bucket, retrigger=True)
+            assert view == (100 * NANO, 15 * NANO, 0)
+            # Bit-exact lane planes on every node: the no-fault fixpoint
+            # (1 prime take + 4 chaos takes per node, no grants, elapsed 0).
+            slots = [cmd.replicator.slots.self_slot for cmd in cluster.commands]
+            for pn, elapsed in _lane_planes(cluster, bucket):
+                assert elapsed == 0
+                assert int(pn[:, 0].sum()) == 0  # frozen clock: no grants
+                for node_i, slot in enumerate(slots):
+                    assert pn[slot, 1] == 5 * NANO, (
+                        f"{kind}: node {node_i} lane lost takes"
+                    )
+            # The schedule actually injected its fault class.
+            total = {k: sum(fn.stats()[f"faultnet_{k}"] for fn in nets)
+                     for k in ("dropped", "duplicated", "reordered", "delayed",
+                               "corrupted")}
+            key = {"drop": "dropped", "dup": "duplicated",
+                   "reorder": "reordered", "delay": "delayed",
+                   "corrupt": "corrupted"}[kind]
+            assert total[key] > 0, f"schedule {kind} injected nothing"
+            if kind == "corrupt":
+                # Corrupt packets must be REJECTED at decode, not merged.
+                assert sum(
+                    cmd.replicator.rx_errors for cmd in cluster.commands
+                ) > 0
+        finally:
+            _detach_faultnets(cluster)
+            for cl in clients:
+                cl.close()
+
+
+@pytest.mark.chaos
+class TestPartitionHealAntiEntropy:
+    """Acceptance: heal-time anti-entropy reconverges a 3-node cluster
+    after a timed partition WITHOUT take traffic — digests + targeted
+    incast only, inside an asserted packet budget."""
+
+    def test_heal_reconverges_without_takes_within_packet_budget(
+        self, chaos_cluster
+    ):
+        cluster = chaos_cluster
+        nets = _attach_faultnets(cluster, seed=7)
+        clients = [KeepAliveClient(p) for p in cluster.api_ports]
+        try:
+            # A pre-synced control bucket: converged BEFORE the partition,
+            # so the heal exchange must not re-ship it (targeting proof).
+            for _ in range(2):
+                clients[0].take("ae-stable", "50:1h")
+            _converged_views(cluster, "ae-stable")
+            # Prime the divergence bucket fault-free too (the engine's
+            # documented bucket-creation residency race is out of scope).
+            for cl in clients:
+                cl.take("ae-heal", "100:1h")
+            _converged_views(cluster, "ae-heal")
+
+            addrs = [cmd.node_addr for cmd in cluster.commands]
+            for fn in nets:
+                fn.partition([addrs[0]], [addrs[1], addrs[2]])
+            time.sleep(0.8)  # > alive_ttl: cross-side peers go dead
+            # Divergent spend on both sides, then total silence.
+            for _ in range(3):
+                clients[0].take("ae-heal", "100:1h")
+            for i in range(4):
+                clients[1 + i % 2].take("ae-heal", "100:1h")
+            time.sleep(0.3)  # let intra-side replication settle
+            # Counters are cumulative over the module-scoped cluster:
+            # assert DELTAS across the heal window.
+            before = [cmd.replicator.stats() for cmd in cluster.commands]
+            tx_before = sum(s["replication_tx_packets"] for s in before)
+            for fn in nets:
+                fn.heal()
+            # NO take traffic from here: probes revive the dead links,
+            # the dead→alive edge auto-triggers the digest exchange, and
+            # only the divergent bucket is fetched/pushed.
+            view = _converged_views(cluster, "ae-heal")
+            assert view == (100 * NANO, 10 * NANO, 0)
+            tx_spent = sum(
+                cmd.replicator.stats()["replication_tx_packets"]
+                for cmd in cluster.commands
+            ) - tx_before
+            # Budget: probes + acks + digests + fetches + pushes for ONE
+            # divergent bucket across 4 healed directed pairs. An
+            # untargeted resync (or a storm) blows well past this.
+            assert tx_spent <= 250, f"heal cost {tx_spent} packets"
+            after = [cmd.replicator.stats() for cmd in cluster.commands]
+
+            def delta(key):
+                return sum(a[key] - b[key] for a, b in zip(after, before))
+
+            assert delta("ae_triggers") >= 1
+            assert delta("resync_buckets") >= 1
+            # Targeting: only the divergent bucket is fetched — never the
+            # pre-synced one. Each healed directed pair fetches ≤ 1 bucket
+            # per digest round; damping bounds rounds inside the window.
+            assert 1 <= delta("ae_fetches_tx") <= 16
+            assert delta("peer_heals") >= 2
+        finally:
+            _detach_faultnets(cluster)
+            for cl in clients:
+                cl.close()
+
+
+@pytest.mark.chaos
+class TestIngestIdempotence:
+    """Satellite: reordered/duplicated wire packets are idempotent at
+    ingest — the same packet set lands on the same bit-exact planes in any
+    order, any multiplicity, through the real codec."""
+
+    def test_reordered_duplicated_wire_packets_land_identically(self):
+        from patrol_tpu.ops import wire
+
+        cfg = LimiterConfig(buckets=16, nodes=4)
+        # A realistic broadcast history: three nodes' successive
+        # full-state packets for one bucket, each later packet subsuming
+        # the earlier (monotone lanes), interleaved across senders.
+        packets = []
+        for step in range(1, 5):
+            for slot in range(3):
+                packets.append(
+                    wire.encode(
+                        wire.from_nanotokens(
+                            "idem", (10 + step) * NANO, step * NANO,
+                            step * 10, origin_slot=slot, cap_nt=10 * NANO,
+                            lane_added_nt=step * NANO // 2,
+                            lane_taken_nt=step * NANO,
+                        )
+                    )
+                )
+
+        def apply(sequence):
+            eng = DeviceEngine(cfg, node_slot=3, clock=lambda: NANO)
+            try:
+                for data in sequence:
+                    st = wire.decode(data)
+                    eng.ingest_delta(st, st.origin_slot)
+                assert eng.flush(timeout=30)
+                row = eng.directory.lookup("idem")
+                pn, elapsed = eng.read_rows([row])
+                return pn[0].copy(), int(elapsed[0])
+            finally:
+                eng.stop()
+
+        import random as _r
+
+        shuffled = list(packets)
+        _r.Random(13).shuffle(shuffled)
+        baseline = apply(packets)
+        reordered = apply(list(reversed(packets)))
+        duplicated = apply([p for p in packets for _ in range(2)])
+        shuffled_dup = apply(shuffled + shuffled)
+        for other in (reordered, duplicated, shuffled_dup):
+            assert (baseline[0] == other[0]).all()
+            assert baseline[1] == other[1]

@@ -28,7 +28,8 @@ class Cluster:
     """N full Command stacks sharing one background event loop."""
 
     def __init__(self, n: int = 3, udp_backend: str = "asyncio",
-                 wire_mode: str = "aggregate"):
+                 wire_mode: str = "aggregate", clock_fn=None,
+                 http_front: str = "auto"):
         self.n = n
         self.api_ports = [free_port() for _ in range(n)]
         node_ports = [free_port() for _ in range(n)]
@@ -36,17 +37,23 @@ class Cluster:
         self.commands = []
         for i in range(n):
             # Per-node clock skew in whole minutes proves clock-sync
-            # independence (≙ command_test.go:45-53).
+            # independence (≙ command_test.go:45-53). Chaos tests inject
+            # frozen clocks instead (clock_fn) so the converged state is
+            # bit-deterministic (no wall-clock refill grants).
             cmd = Command(
                 api_addr=f"127.0.0.1:{self.api_ports[i]}",
                 node_addr=node_addrs[i],
                 peer_addrs=node_addrs,  # full member list; self is filtered
-                clock=offset_clock(i * 60 * NANO),
+                clock=clock_fn(i) if clock_fn else offset_clock(i * 60 * NANO),
                 shutdown_timeout_s=5.0,
                 config=LimiterConfig(buckets=128, nodes=4),
                 handle_signals=False,
                 udp_backend=udp_backend,
                 wire_mode=wire_mode,
+                # The native C++ front computes take time from
+                # CLOCK_REALTIME + offset; chaos tests need the injected
+                # (frozen) clock end-to-end for bit-deterministic state.
+                http_front=http_front,
             )
             self.commands.append(cmd)
 
@@ -512,3 +519,86 @@ class TestFlagshipIncastDiscipline:
             assert stats["replication_incast_suppressed"] >= 35
         finally:
             cluster.close()
+
+
+class TestReplyGateFloods:
+    """Satellite coverage: ReplyGate under duplicate-flood incast storms —
+    TTL expiry re-opens the gate, the hard cap holds under distinct-key
+    floods (covered above), and multi-peer reply fan-in stays independent
+    per requester."""
+
+    def test_ttl_expiry_reopens_the_gate(self):
+        from patrol_tpu.net.replication import ReplyGate
+
+        gate = ReplyGate(ttl_s=0.05)
+        addr = ("127.0.0.1", 7000)
+        assert gate.allow("hot", addr)
+        assert not gate.allow("hot", addr)  # duplicate inside the TTL
+        time.sleep(0.06)
+        assert gate.allow("hot", addr)  # TTL lapsed: one more burst
+
+    def test_duplicate_flood_multi_peer_fanin(self):
+        """A duplicate flood from MANY requesters: each peer gets exactly
+        one burst per TTL (unicast replies are per-requester), however the
+        floods interleave."""
+        from patrol_tpu.net.replication import ReplyGate
+
+        gate = ReplyGate(ttl_s=60.0)
+        addrs = [(f"10.0.{i // 256}.{i % 256}", 5000 + i) for i in range(32)]
+        allowed = 0
+        for _round in range(10):  # interleaved duplicate flood
+            for a in addrs:
+                allowed += gate.allow("hot", a)
+        assert allowed == 32  # one per requester, not per request
+        assert gate.suppressed == 32 * 9
+
+
+class TestShutdownFlush:
+    """Graceful-shutdown flush (Command stop): a stopping node broadcasts
+    the FINAL state of its recently-active buckets before the transport
+    closes, so takes whose organic broadcasts were all lost (here: the
+    peer dropped every rx packet) survive a clean restart on the cluster
+    instead of being silently shed."""
+
+    def test_stop_flushes_dirty_state_to_peer(self):
+        from patrol_tpu.models.limiter import NANO
+
+        c = Cluster(2, clock_fn=lambda i: (lambda: NANO), http_front="python")
+        try:
+            # Isolate the flush path: no heal-time anti-entropy rounds.
+            for cmd in c.commands:
+                cmd.replicator.antientropy.min_interval_s = 3600.0
+            # Node 1 drops ALL rx: node 0's take broadcasts are lost.
+            c.commands[1].replicator.drop_addr = lambda addr: True
+            cl = KeepAliveClient(c.api_ports[0])
+            try:
+                for _ in range(4):
+                    status, _ = cl.take("flush-me", "9:1h")
+                    assert status == 200
+            finally:
+                cl.close()
+            time.sleep(0.2)
+            assert c.commands[1].engine.directory.lookup("flush-me") is None
+
+            # Heal the link, then stop ONLY node 0. No further takes: the
+            # shutdown flush is the only way its spend can reach node 1.
+            c.commands[1].replicator.drop_addr = None
+            c.loop.call_soon_threadsafe(c.stop_events[0].set)
+
+            deadline = time.time() + 10
+            state = None
+            eng1 = c.commands[1].engine
+            while time.time() < deadline:
+                row = eng1.directory.lookup("flush-me")
+                if row is not None:
+                    eng1.flush()
+                    pn, elapsed = eng1.row_view(row)
+                    state = (int(pn[:, 1].sum()), int(elapsed))
+                    if state[0] == 4 * NANO:
+                        break
+                time.sleep(0.05)
+            assert state == (4 * NANO, 0), (
+                f"shutdown flush did not deliver final state: {state}"
+            )
+        finally:
+            c.close()

@@ -1,0 +1,397 @@
+"""Unit tests for the replication resilience layer's building blocks:
+net/faultnet.py (deterministic fault injection), PeerHealth (liveness /
+backoff / re-resolution), the anti-entropy codec, and the unresolvable-
+peer degradation paths of both replication backends.
+
+End-to-end seeded chaos convergence lives in tests/test_chaos.py; this
+file pins the primitives' exact semantics."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from patrol_tpu.net import antientropy as ae
+from patrol_tpu.net.faultnet import REORDER_TTL_S, FaultNet
+from patrol_tpu.net.replication import (
+    PROBE_ACK_NAME,
+    PROBE_NAME,
+    PeerHealth,
+    Replicator,
+    SlotTable,
+)
+from patrol_tpu.ops import wire
+
+
+def mkpkt(i: int) -> bytes:
+    return wire.encode(
+        wire.WireState(name=f"pkt{i}", added=1.0 + i, taken=float(i), elapsed_ns=7)
+    )
+
+
+ADDR = ("127.0.0.1", 4242)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestFaultNet:
+    def test_clean_link_passes_through(self):
+        fn = FaultNet(seed=1)
+        for i in range(10):
+            assert fn.filter(mkpkt(i), ADDR) == [mkpkt(i)]
+        assert fn.stats()["faultnet_dropped"] == 0
+        assert not fn.active
+
+    def test_seed_determinism(self):
+        runs = []
+        for _ in range(2):
+            fn = FaultNet(seed=7).link(drop=0.5)
+            runs.append([len(fn.filter(mkpkt(i), ADDR)) for i in range(64)])
+        assert runs[0] == runs[1]
+        assert 0 < sum(runs[0]) < 64  # some dropped, some delivered
+        other = FaultNet(seed=8).link(drop=0.5)
+        assert [len(other.filter(mkpkt(i), ADDR)) for i in range(64)] != runs[0]
+
+    def test_drop_always(self):
+        fn = FaultNet(seed=0).link(drop=1.0)
+        assert fn.filter(mkpkt(0), ADDR) == []
+        assert fn.dropped == 1
+        assert fn.active
+
+    def test_duplicate(self):
+        fn = FaultNet(seed=0).link(dup=1.0)
+        out = fn.filter(mkpkt(0), ADDR)
+        assert out == [mkpkt(0), mkpkt(0)]
+        assert fn.duplicated == 1
+
+    def test_reorder_swaps_adjacent_packets(self):
+        fn = FaultNet(seed=0).link(reorder=1.0)
+        assert fn.filter(mkpkt(0), ADDR) == []  # held
+        out = fn.filter(mkpkt(1), ADDR)
+        # Held packet is delivered BEHIND its successor (the reorder)...
+        assert mkpkt(0) in out and out[0] != mkpkt(0)
+        assert fn.reordered >= 1
+
+    def test_reorder_stranded_packet_released_by_due(self):
+        clock = FakeClock()
+        fn = FaultNet(seed=0, clock=clock).link(reorder=1.0)
+        assert fn.filter(mkpkt(0), ADDR) == []
+        assert fn.due() == []  # not yet due
+        clock.t += REORDER_TTL_S + 0.01
+        assert fn.due() == [(mkpkt(0), ADDR)]  # never a silent drop
+
+    def test_delay_released_after_time(self):
+        clock = FakeClock()
+        fn = FaultNet(seed=3, clock=clock).link(delay_s=0.5)
+        held = []
+        for i in range(8):
+            held.append((mkpkt(i), fn.filter(mkpkt(i), ADDR)))
+        delayed = [p for p, out in held if p not in out]
+        assert delayed  # seeded: some packets were delayed
+        clock.t += 0.6
+        released = [p for p, _ in fn.due()]
+        assert released == delayed
+        assert fn.stats()["faultnet_held"] == 0
+
+    def test_corrupt_packets_are_always_rejected_by_codec(self):
+        """The corruption model is 'kernel checksum failed': every mangled
+        packet must fail wire.decode, never merge as plausible state —
+        that is what lets corruption schedules converge bit-exactly."""
+        fn = FaultNet(seed=9).link(corrupt=1.0)
+        rejected = 0
+        for i in range(50):
+            for out in fn.filter(mkpkt(i), ADDR):
+                with pytest.raises(ValueError):
+                    wire.decode(out)
+                rejected += 1
+        assert rejected == 50
+        assert fn.corrupted == 50
+
+    def test_partition_and_heal(self):
+        clock = FakeClock()
+        fn = FaultNet(seed=0, self_addr="127.0.0.1:1000", clock=clock)
+        fn.partition(["127.0.0.1:1000"], ["127.0.0.1:2000"])
+        peer = ("127.0.0.1", 2000)
+        outsider = ("127.0.0.1", 3000)
+        assert fn.filter(mkpkt(0), peer) == []  # cross-group: dropped
+        assert fn.filter(mkpkt(0), outsider) == [mkpkt(0)]  # ungrouped: fine
+        assert fn.partition_dropped == 1
+        fn.heal()
+        assert fn.filter(mkpkt(1), peer) == [mkpkt(1)]
+
+    def test_timed_partition_heals_itself(self):
+        clock = FakeClock()
+        fn = FaultNet(seed=0, self_addr="127.0.0.1:1000", clock=clock)
+        fn.partition(
+            ["127.0.0.1:1000"], ["127.0.0.1:2000"], after_s=1.0, duration_s=2.0
+        )
+        peer = ("127.0.0.1", 2000)
+        assert fn.filter(mkpkt(0), peer) == [mkpkt(0)]  # not started yet
+        clock.t = 1.5
+        assert fn.filter(mkpkt(1), peer) == []  # active window
+        clock.t = 3.5
+        assert fn.filter(mkpkt(2), peer) == [mkpkt(2)]  # healed on schedule
+
+
+class TestPeerHealth:
+    def test_first_contact_and_ttl_lapse_report_heal(self):
+        clock = FakeClock()
+        h = PeerHealth(clock=clock, alive_ttl_s=1.0, probe_interval_s=0.5)
+        h.add_peer("127.0.0.1:2000", ("127.0.0.1", 2000), resolved=True)
+        assert h.on_rx(("127.0.0.1", 2000)) == ("127.0.0.1", 2000)  # join
+        assert h.on_rx(("127.0.0.1", 2000)) is None  # still alive
+        clock.t += 2.0
+        assert h.on_rx(("127.0.0.1", 2000)) == ("127.0.0.1", 2000)  # heal
+        assert h.alive_count() == 1
+        assert h.on_rx(("9.9.9.9", 1)) is None  # unknown sender ignored
+
+    def test_probe_schedule_backs_off_exponentially_with_jitter(self):
+        clock = FakeClock()
+        h = PeerHealth(
+            clock=clock, probe_interval_s=1.0, backoff_cap_s=60.0, seed=5
+        )
+        h.add_peer("127.0.0.1:2000", ("127.0.0.1", 2000), resolved=True)
+        gaps = []
+        last = None
+        for _ in range(6):
+            while True:
+                probes, _ = h.tick()
+                if probes:
+                    break
+                clock.t += 0.05
+            if last is not None:
+                gaps.append(clock.t - last)
+            last = clock.t
+        # Consecutive unanswered probes must spread out ~exponentially;
+        # jitter bounds each gap within [0.75, 1.25] of the nominal 2^n.
+        for i, gap in enumerate(gaps):
+            nominal = 1.0 * (2 ** i)
+            assert 0.7 * nominal <= gap <= 1.4 * nominal
+        st = h.stats()
+        assert st["peer_alive"] == 0
+        assert st["peer_backoff_ms"] > 0
+        # Any rx resets the whole schedule.
+        h.on_rx(("127.0.0.1", 2000))
+        assert h.stats()["peer_backoff_ms"] == 0
+
+    def test_unresolved_peer_is_scheduled_for_reresolution(self):
+        clock = FakeClock()
+        h = PeerHealth(clock=clock, probe_interval_s=0.5)
+        h.add_peer("no-such-host.invalid:9", ("no-such-host.invalid", 9), False)
+        probes, resolves = h.tick()
+        assert probes == []  # nothing to probe: no address
+        assert [p.addr_str for p in resolves] == ["no-such-host.invalid:9"]
+        h.mark_resolved(resolves[0], ("127.0.0.1", 2000))
+        assert h.stats()["peer_unresolved"] == 0
+        assert h.stats()["peer_reresolves"] == 1
+        assert ("127.0.0.1", 2000) in h.peers
+
+
+class TestSlotTableRealias:
+    def test_realias_maps_new_addr_to_same_slot(self):
+        st = SlotTable(
+            "127.0.0.1:1000", ["127.0.0.1:1000", "127.0.0.1:2000"], max_slots=4
+        )
+        old_slot = st.resolve(("127.0.0.1", 2000))
+        st.realias(("127.0.0.1", 2000), ("127.0.0.2", 2000))
+        assert st.resolve(("127.0.0.2", 2000)) == old_slot
+        assert st.resolve(("127.0.0.1", 2000)) == old_slot  # old alias kept
+
+
+class TestAntiEntropyCodec:
+    def test_digest_roundtrip(self):
+        entries = [(ae.name_hash64(f"b{i}"), i * 7 + 1) for i in range(30)]
+        packets = ae.encode_digests(entries)
+        assert len(packets) == -(-30 // ae.DIGESTS_PER_PACKET)
+        out = []
+        for data in packets:
+            st = wire.decode(data)
+            assert st.is_zero()  # invisible to v1 peers: an incast request
+            assert st.name.startswith(ae.AE_DIGEST_NAME)
+            out.extend(ae.decode_digest_name(st.name))
+        assert out == entries
+
+    def test_fetch_roundtrip(self):
+        hashes = [ae.name_hash64(f"b{i}") for i in range(60)]
+        packets = ae.encode_fetches(hashes)
+        assert len(packets) == -(-60 // ae.FETCHES_PER_PACKET)
+        out = []
+        for data in packets:
+            st = wire.decode(data)
+            assert st.is_zero()
+            out.extend(ae.decode_fetch_name(st.name))
+        assert out == hashes
+
+    def test_state_digest_ignores_empty_lane_placement(self):
+        """An empty bucket's snapshot pins a zero lane at the LOCAL node
+        slot; the digest must not depend on which node took the snapshot."""
+        a = [wire.from_nanotokens("b", 5, 0, 3, origin_slot=0,
+                                 cap_nt=5, lane_added_nt=0, lane_taken_nt=0)]
+        b = [wire.from_nanotokens("b", 5, 0, 3, origin_slot=2,
+                                  cap_nt=5, lane_added_nt=0, lane_taken_nt=0)]
+        assert ae.state_digest(a) == ae.state_digest(b)
+
+    def test_state_digest_detects_divergence(self):
+        base = [
+            wire.from_nanotokens("b", 9, 4, 3, origin_slot=0,
+                                 cap_nt=5, lane_added_nt=4, lane_taken_nt=4)
+        ]
+        other = [
+            wire.from_nanotokens("b", 9, 5, 3, origin_slot=0,
+                                 cap_nt=5, lane_added_nt=4, lane_taken_nt=5)
+        ]
+        assert ae.state_digest(base) != ae.state_digest(other)
+
+
+class LoopThread:
+    """A background event loop hosting bare Replicators (no engines)."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def call(self, coro, timeout=10):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+
+    def close(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+def free_port() -> int:
+    import socket as sk
+
+    s = sk.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestUnresolvablePeerDegradation:
+    def test_asyncio_replicator_survives_and_reresolves(self, monkeypatch):
+        """Startup with an unresolvable peer must not crash; broadcasts
+        skip it; once DNS answers (simulated), the peer joins the fan-out
+        at the SAME slot and probes mark it alive — the reference's
+        shadowed-error resolve bug class, fixed end-to-end."""
+        from patrol_tpu.net import replication as rep_mod
+
+        bogus = "patrol-chaos-test.invalid:7777"
+        port_a, port_b = free_port(), free_port()
+        b_addr = ("127.0.0.1", port_b)
+
+        real_resolve = rep_mod._resolve
+        dns_up = threading.Event()
+
+        def fake_resolve(addr):
+            if addr == bogus:
+                return b_addr if dns_up.is_set() else ("patrol-chaos-test.invalid", 7777)
+            return real_resolve(addr)
+
+        monkeypatch.setattr(rep_mod, "_resolve", fake_resolve)
+
+        lt = LoopThread()
+        try:
+            slots_a = SlotTable(f"127.0.0.1:{port_a}", [bogus], max_slots=4)
+            a = lt.call(
+                Replicator.create(f"127.0.0.1:{port_a}", [bogus], slots_a)
+            )
+            slots_b = SlotTable(
+                f"127.0.0.1:{port_b}", [f"127.0.0.1:{port_a}"], max_slots=4
+            )
+            b = lt.call(
+                Replicator.create(
+                    f"127.0.0.1:{port_b}", [f"127.0.0.1:{port_a}"], slots_b
+                )
+            )
+            try:
+                assert a.peers == []  # excluded from fan-out, not crashed
+                assert a.stats()["peer_unresolved"] == 1
+                # Broadcasting with zero resolvable peers is a no-op.
+                a.broadcast_states(
+                    [wire.from_nanotokens("x", 1, 1, 1, origin_slot=0, cap_nt=1)]
+                )
+                a.health.configure(probe_interval_s=0.1, backoff_cap_s=0.2)
+                time.sleep(0.5)  # resolve attempts fail against dead DNS
+                assert a.stats()["peer_reresolves"] == 0
+                member_slot = slots_a.slot_of[("patrol-chaos-test.invalid", 7777)]
+
+                dns_up.set()  # DNS comes back
+                deadline = time.time() + 5
+                while time.time() < deadline and b_addr not in a.peers:
+                    time.sleep(0.05)
+                assert b_addr in a.peers
+                assert a.stats()["peer_unresolved"] == 0
+                # Same lane as the static member list assigned.
+                assert slots_a.resolve(b_addr) == member_slot
+                # Probes now flow: the peer goes alive without data traffic.
+                deadline = time.time() + 5
+                while time.time() < deadline and a.stats()["peer_alive"] < 1:
+                    time.sleep(0.05)
+                assert a.stats()["peer_alive"] == 1
+                assert b.stats()["peer_alive"] == 1  # acks flow back too
+            finally:
+                lt.loop.call_soon_threadsafe(a.close)
+                lt.loop.call_soon_threadsafe(b.close)
+                time.sleep(0.2)
+        finally:
+            lt.close()
+
+    def test_native_replicator_survives_unresolvable_peer(self):
+        from patrol_tpu.net import native_replication
+
+        if not native_replication.available():
+            pytest.skip("native toolchain unavailable")
+        port = free_port()
+        slots = SlotTable(
+            f"127.0.0.1:{port}", ["no-such-host.invalid:9"], max_slots=4
+        )
+        rep = native_replication.NativeReplicator(
+            f"127.0.0.1:{port}", ["no-such-host.invalid:9"], slots
+        )
+        try:
+            assert rep.peers == []
+            assert rep.stats()["peer_unresolved"] == 1
+            rep.broadcast_states(
+                [wire.from_nanotokens("x", 1, 1, 1, origin_slot=0, cap_nt=1)]
+            )  # must not crash
+        finally:
+            rep.close()
+
+
+class TestProbeChannel:
+    def test_probe_gets_acked_and_marks_alive(self):
+        lt = LoopThread()
+        try:
+            pa, pb = free_port(), free_port()
+            sa = SlotTable(f"127.0.0.1:{pa}", [f"127.0.0.1:{pb}"], max_slots=4)
+            sb = SlotTable(f"127.0.0.1:{pb}", [f"127.0.0.1:{pa}"], max_slots=4)
+            a = lt.call(Replicator.create(f"127.0.0.1:{pa}", [f"127.0.0.1:{pb}"], sa))
+            b = lt.call(Replicator.create(f"127.0.0.1:{pb}", [f"127.0.0.1:{pa}"], sb))
+            try:
+                a.health.configure(probe_interval_s=0.1)
+                deadline = time.time() + 5
+                while time.time() < deadline and a.stats()["peer_alive"] < 1:
+                    time.sleep(0.05)
+                assert a.stats()["peer_alive"] == 1
+                assert a.stats()["peer_probes_tx"] >= 1
+                # The probe channel never creates buckets anywhere.
+                assert a.repo is None and b.repo is None  # and no crash
+            finally:
+                lt.loop.call_soon_threadsafe(a.close)
+                lt.loop.call_soon_threadsafe(b.close)
+                time.sleep(0.2)
+        finally:
+            lt.close()

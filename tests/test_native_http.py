@@ -110,6 +110,21 @@ class TestConnectionHandling:
         assert [g[0] for g in got] == [200, 200, 429]
         assert [g[1] for g in got] == [b"1", b"0", b"0"]
 
+    def test_reserved_control_channel_name_is_400(self, front):
+        """NUL-led names are the replication control channel (probe pings,
+        anti-entropy — net/replication.py CTRL_PREFIX): both fronts must
+        refuse to create buckets there, or control packets for the name
+        would swallow its replication. Mixed with a normal take so the
+        batch-partitioning path (reject some, submit the rest) is covered."""
+        with socket.create_connection(("127.0.0.1", front.port), timeout=5) as s:
+            req = (
+                b"POST /take/%00pt!probe?rate=5:1s HTTP/1.1\r\nHost: x\r\n\r\n"
+                b"POST /take/legit-name?rate=5:1h HTTP/1.1\r\nHost: x\r\n\r\n"
+            )
+            got = self._roundtrip(s, req, 2)
+        assert got[0][0] == 400
+        assert got[1][0] == 200
+
     def test_connection_close_honored(self, front):
         with socket.create_connection(("127.0.0.1", front.port), timeout=5) as s:
             s.sendall(

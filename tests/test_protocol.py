@@ -1,0 +1,121 @@
+"""patrol-protocol self-tests (stage 6 of scripts/check.sh).
+
+The checker's trust story mirrors patrol-prove's: it must pass the clean
+protocol, reject every seeded mutation, and its model must agree with the
+real kernels on the join it claims to model — a model checker whose model
+drifted from the implementation proves nothing.
+"""
+
+import numpy as np
+import pytest
+
+from patrol_tpu.analysis import protocol as P
+
+pytestmark = pytest.mark.protocol
+
+
+class TestCleanProtocol:
+    def test_clean_protocol_has_no_findings(self):
+        assert P.check_protocol(P.CLEAN) == []
+
+    def test_async_exploration_is_nontrivial(self):
+        """The DFS must actually explore a schedule space, not
+        short-circuit — a bound regression that collapses it to a handful
+        of schedules would quietly gut the gate."""
+        explored, findings = P.check_async_schedules()
+        assert findings == []
+        assert explored >= 20
+
+    def test_ap_bound_exact_without_partition(self):
+        """Sanity on the model itself: one side, sync delivery — admitted
+        is exactly the limit, never more."""
+        c = P.Cluster(3, 4, P.CLEAN)
+        for i in [0, 1, 2, 0, 1, 2, 0, 1, 2]:
+            c.take(i)
+            c.deliver_all(within_side_only=True)
+        assert sum(n.admitted for n in c.nodes) == 4
+
+    def test_partitioned_sides_each_enforce_the_limit(self):
+        c = P.Cluster(3, 2, P.CLEAN)
+        c.set_partition({0: 0, 1: 1, 2: 1})
+        for i in [0, 0, 0, 1, 2, 1, 2]:
+            c.take(i)
+            c.deliver_all(within_side_only=True)
+        assert sum(n.admitted for n in c.nodes) == 4  # 2 sides × limit 2
+        c.heal_and_converge()
+        states = {n.state() for n in c.nodes}
+        assert len(states) == 1
+
+
+class TestMutationsRejected:
+    @pytest.mark.parametrize("name", sorted(P.MUTATIONS))
+    def test_mutation_is_caught(self, name):
+        findings = P.check_protocol(P.MUTATIONS[name])
+        assert findings, f"mutation {name!r} slipped through the checker"
+
+    def test_check_repo_clean(self):
+        assert P.check_repo() == []
+
+    def test_check_repo_flags_a_toothless_checker(self, monkeypatch):
+        """If a mutation stops being caught, check_repo must say so
+        (PTC005) rather than silently passing."""
+        monkeypatch.setitem(
+            P.MUTATIONS, "no-op-mutation", P.Semantics()
+        )
+        findings = P.check_repo()
+        assert any(f.check == "PTC005" for f in findings)
+
+
+class TestModelMatchesKernels:
+    def test_model_join_is_the_merge_kernel_join(self):
+        """The model's merge must be the elementwise max the device kernel
+        computes — drive ops/merge.py over a small state and replay the
+        same deltas through the model."""
+        import jax.numpy as jnp
+
+        from patrol_tpu.models.limiter import LimiterConfig, init_state
+        from patrol_tpu.ops.merge import MergeBatch, merge_batch
+
+        nodes = 4
+        state = init_state(LimiterConfig(buckets=8, nodes=nodes))
+        rows = np.array([0, 0, 0, 0, 0, 0], np.int32)
+        slots = np.array([0, 1, 0, 2, 1, 3], np.int32)
+        added = np.array([5, 3, 2, 7, 9, 1], np.int64)
+        taken = np.array([2, 8, 6, 1, 3, 4], np.int64)
+        elapsed = np.array([1, 2, 3, 4, 5, 6], np.int64)
+        out = merge_batch(
+            state,
+            MergeBatch(
+                rows=jnp.asarray(rows),
+                slots=jnp.asarray(slots),
+                added_nt=jnp.asarray(added),
+                taken_nt=jnp.asarray(taken),
+                elapsed_ns=jnp.asarray(elapsed),
+            ),
+        )
+        node = P.Node(0, nodes, limit=0)
+        for s, a, t in zip(slots, added, taken):
+            node.merge([(int(s), int(a), int(t))], P.CLEAN)
+        pn = np.asarray(out.pn[0])
+        assert list(pn[:, 0]) == node.added
+        assert list(pn[:, 1]) == node.taken
+
+    def test_model_take_is_the_take_kernel_admission(self):
+        """Admission rule parity on the no-refill path: the model admits
+        iff the real HostLanes/take_batch algebra admits (zero-rate
+        bucket: tokens = cap + Σadded − Σtaken)."""
+        from patrol_tpu.models.limiter import NANO
+        from patrol_tpu.ops.rate import Rate
+        from patrol_tpu.runtime.engine import HostLanes
+
+        # Frozen clock ⇒ no grants: the exact algebra the model uses.
+        lanes = HostLanes(nodes=2)
+        rate = Rate(freq=3, per_ns=3600 * NANO)
+        model = P.Node(0, 2, limit=3)
+        for _ in range(5):
+            _, ok = lanes.take(
+                cap_base_nt=3 * NANO, created_ns=0, now_ns=0,
+                rate=rate, count=1, node_slot=0,
+            )
+            assert ok == model.take(P.CLEAN)
+        assert model.admitted == 3
