@@ -34,6 +34,15 @@ generic tool can express:
   format itself is float64 tokens, and those conversions live ONLY in
   the boundary set below.
 
+* **PTL005 counter-registry** — every ``COUNTERS.inc(...)`` /
+  ``COUNTERS.set_max(...)`` call site must name a counter declared in
+  ``utils/profiling.py::CounterRegistry._KNOWN``. The registry zero-fills
+  ``_KNOWN`` into every ``/debug/vars`` snapshot so readers get a stable
+  field set; a counter incremented under an undeclared name would appear
+  only once it first fires — dashboards and bench field assertions
+  silently miss it. Dynamic (non-literal) names are flagged too: they
+  cannot be verified against the declaration.
+
 Suppressions (documented in README.md) are inline comments:
 
     x = time.time()  # patrol-lint: clock-seam (uptime metric)
@@ -699,10 +708,102 @@ def check_dtype_discipline(mod: Module) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# PTL005 — COUNTERS call sites must use names declared in _KNOWN
+
+_counter_names_cache: Optional[Set[str]] = None
+
+
+def known_counter_names() -> Set[str]:
+    """``CounterRegistry._KNOWN`` from utils/profiling.py, loaded by file
+    path (like :func:`native_effects`) so scripts/lint_repo.py stays
+    jax-free. Empty on load failure — the check then degrades to
+    silence rather than flagging every call site."""
+    global _counter_names_cache
+    if _counter_names_cache is not None:
+        return _counter_names_cache
+    try:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "utils",
+            "profiling.py",
+        )
+        spec = importlib.util.spec_from_file_location(
+            "_patrol_counter_names", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _counter_names_cache = set(mod.CounterRegistry._KNOWN)
+    except Exception:  # pragma: no cover - stdlib-only module; belt&braces
+        _counter_names_cache = set()
+    return _counter_names_cache
+
+
+def check_counter_registry(mod: Module) -> List[Finding]:
+    known = known_counter_names()
+    if not known:
+        return []
+    out: List[Finding] = []
+
+    class V(_ScopedVisitor):
+        def visit_Call(self, node):  # noqa: N802
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("inc", "set_max")
+                and (
+                    (isinstance(f.value, ast.Name) and f.value.id == "COUNTERS")
+                    or (
+                        isinstance(f.value, ast.Attribute)
+                        and f.value.attr == "COUNTERS"
+                    )
+                )
+            ) and not mod.suppressed("PTL005", node.lineno):
+                arg = node.args[0] if node.args else None
+                if not (
+                    isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+                ):
+                    out.append(
+                        Finding(
+                            "PTL005",
+                            mod.relpath,
+                            node.lineno,
+                            f"COUNTERS.{f.attr}() with a non-literal counter "
+                            "name: it cannot be verified against "
+                            "CounterRegistry._KNOWN — pass the declared name "
+                            "as a string literal",
+                        )
+                    )
+                elif arg.value not in known:
+                    out.append(
+                        Finding(
+                            "PTL005",
+                            mod.relpath,
+                            node.lineno,
+                            f"COUNTERS.{f.attr}({arg.value!r}) uses a counter "
+                            "name not declared in CounterRegistry._KNOWN; it "
+                            "would be missing from the zero-filled "
+                            "/debug/vars field set — declare it in "
+                            "utils/profiling.py",
+                        )
+                    )
+            self.generic_visit(node)
+
+    V().visit(mod.tree)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Drivers
 
-PER_MODULE_CHECKS = (check_wall_clock, check_lock_order, check_dtype_discipline)
-ALL_CODES = ("PTL001", "PTL002", "PTL003", "PTL004")
+PER_MODULE_CHECKS = (
+    check_wall_clock,
+    check_lock_order,
+    check_dtype_discipline,
+    check_counter_registry,
+)
+ALL_CODES = ("PTL001", "PTL002", "PTL003", "PTL004", "PTL005")
 
 
 def lint_modules(mods: Sequence[Module]) -> List[Finding]:

@@ -156,6 +156,7 @@ class Command:
         )
 
         def stats() -> dict:
+            from patrol_tpu.utils import histogram as hist_mod
             from patrol_tpu.utils import profiling
 
             return {
@@ -173,6 +174,10 @@ class Command:
                 # coalescing, dispatch-ahead depth, rx staging).
                 **profiling.COUNTERS.snapshot(),
                 **replicator.stats(),
+                # patrol-scope latency histograms (count/p50/p99/max per
+                # stage) — the /debug/vars view; /metrics exposes the
+                # full cumulative-bucket form of the same histograms.
+                "histograms": hist_mod.HISTOGRAMS.snapshot(),
             }
 
         api = API(repo, log=log, stats=stats)

@@ -47,7 +47,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from patrol_tpu.ops import wire
 from patrol_tpu.runtime.directory import _fnv1a64
+from patrol_tpu.utils import histogram as hist
 from patrol_tpu.utils import profiling
+from patrol_tpu.utils import trace as trace_mod
 
 log = logging.getLogger("patrol.antientropy")
 
@@ -245,12 +247,21 @@ class AntiEntropy:
                     return
                 job = self._jobs.popleft()
             try:
+                t0 = time.perf_counter_ns()
                 if job[0] == "trigger":
                     self._job_trigger(job[1])
                 elif job[0] == "digest":
                     self._job_digest(job[1], job[2])
                 elif job[0] == "fetch":
                     self._job_fetch(job[1], job[2])
+                dur = time.perf_counter_ns() - t0
+                hist.AE_JOB.record(dur)
+                tr = trace_mod.TRACE
+                if tr.enabled:
+                    tr.record(
+                        trace_mod.EV_AE_PHASE, dur,
+                        trace_mod.AE_PHASES.get(job[0], 0),
+                    )
             except Exception:  # pragma: no cover - worker must not die
                 log.exception("anti-entropy job failed")
 
@@ -295,6 +306,12 @@ class AntiEntropy:
         with self._mu:
             self.packets_tx += sent
         profiling.COUNTERS.inc("ae_packets_tx", sent)
+        if sent < len(packets):
+            # The convergence budget truncated a resync: the remainder
+            # waits for the next damped round. Freeze the flight recorder
+            # — per-job AE phases plus the pipeline timeline show WHY the
+            # heal needed more than one budget (patrol-scope anomaly).
+            trace_mod.anomaly("convergence-budget-breach")
         return sent
 
     def _job_trigger(self, addr: Addr) -> None:
@@ -384,6 +401,8 @@ class AntiEntropy:
         with self._mu:
             self.resync_buckets += buckets
         profiling.COUNTERS.inc("ae_resync_buckets", buckets)
+        if len(packets) > budget:
+            trace_mod.anomaly("convergence-budget-breach")
         self._send_paced(packets[:budget], addr)
 
     # -- lifecycle / observability -------------------------------------------

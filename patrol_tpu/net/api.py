@@ -123,6 +123,7 @@ _STATUS_TEXT = {
     400: "Bad Request",
     404: "Not Found",
     405: "Method Not Allowed",
+    409: "Conflict",
     429: "Too Many Requests",
     500: "Internal Server Error",
 }
@@ -260,9 +261,11 @@ class API:
                 "/debug/pprof/goroutine          thread stack dump\n"
                 "/debug/pprof/heap               allocation summary\n"
                 "/debug/pprof/allocs             allocation summary\n"
-                "/debug/jax/trace?seconds=N      JAX device trace (XPlane)\n"
-                "/debug/vars                     engine stats JSON\n"
-                "/metrics                        prometheus text metrics\n"
+                "/debug/jax/trace?seconds=N      JAX device trace (XPlane; 409 while one runs)\n"
+                "/debug/trace/ring               flight-recorder rings, Chrome-trace JSON (&snapshot=N for anomaly snapshots)\n"
+                "/debug/trace/spans              cross-node take spans JSON (&trace_id=N to filter)\n"
+                "/debug/vars                     engine stats JSON (incl. histogram summaries)\n"
+                "/metrics                        prometheus text exposition (gauges + latency histograms)\n"
             )
             return 200, index.encode(), "text/plain"
         if path == "/debug/pprof/profile":
@@ -293,8 +296,60 @@ class API:
             return 200, raw, "application/octet-stream"
         if path == "/debug/jax/trace":
             seconds = float(q.get("seconds", ["2"])[0])
-            out = await loop.run_in_executor(None, profiling.jax_trace, seconds)
+            try:
+                out = await loop.run_in_executor(
+                    None, profiling.jax_trace, seconds
+                )
+            except profiling.ProfilerBusyError:
+                # Two overlapping captures used to double-start the
+                # process-global jax profiler and crash the handler;
+                # the capture is now serialized and the loser gets a
+                # clean busy signal.
+                return (
+                    409,
+                    b"a jax trace capture is already running; retry later\n",
+                    "text/plain",
+                )
             return 200, f"jax trace written to {out}\n".encode(), "text/plain"
+        if path == "/debug/trace/ring":
+            from patrol_tpu.utils import trace as trace_mod
+
+            snap_arg = q.get("snapshot", [None])[0]
+            if snap_arg is not None:
+                snaps = trace_mod.TRACE.snapshots()
+                if snap_arg in ("", "latest"):
+                    idx = len(snaps) - 1
+                else:
+                    try:
+                        idx = int(snap_arg)
+                    except ValueError:
+                        return 400, b"bad snapshot index\n", "text/plain"
+                if not 0 <= idx < len(snaps):
+                    return 404, b"no such snapshot\n", "text/plain"
+                snap = snaps[idx]
+                body = trace_mod.TRACE.chrome_trace(events=snap["events"])
+                return 200, body, "application/json"
+            return 200, trace_mod.TRACE.chrome_trace(), "application/json"
+        if path == "/debug/trace/snapshots":
+            from patrol_tpu.utils import trace as trace_mod
+
+            listing = [
+                {"index": i, "reason": s["reason"], "at_ns": s["at_ns"],
+                 "events": len(s["events"])}
+                for i, s in enumerate(trace_mod.TRACE.snapshots())
+            ]
+            return 200, json.dumps(listing).encode(), "application/json"
+        if path == "/debug/trace/spans":
+            from patrol_tpu.utils import trace as trace_mod
+
+            tid = None
+            if q.get("trace_id"):
+                try:
+                    tid = int(q["trace_id"][0])
+                except ValueError:
+                    return 400, b"bad trace_id\n", "text/plain"
+            body = json.dumps(trace_mod.SPANS.export(tid)).encode()
+            return 200, body, "application/json"
         if path == "/debug/pprof/cmdline":
             import sys
 
@@ -319,17 +374,15 @@ class API:
         return 404, b"not found\n", "text/plain"
 
     def _metrics(self) -> bytes:
-        stats = self.stats()
-        lines = []
-        for key, val in sorted(stats.items()):
-            if isinstance(val, (int, float)):
-                name = f"patrol_{key}"
-                lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name} {val}")
-        lines.append("# TYPE patrol_uptime_seconds gauge")
+        """Prometheus text exposition (patrol-scope): every numeric stat
+        as a gauge plus the real latency histograms — cumulative
+        ``_bucket``/``_sum``/``_count`` series a scraper can ingest
+        (utils/histogram.py render_exposition; roundtrip-pinned by the
+        parse fixture in tests and the CI smoke gate)."""
+        from patrol_tpu.utils import histogram as hist_mod
+
         uptime = time.time() - self.started_at  # patrol-lint: clock-seam (uptime)
-        lines.append(f"patrol_uptime_seconds {uptime:.3f}")
-        return ("\n".join(lines) + "\n").encode()
+        return hist_mod.render_exposition(self.stats(), uptime_s=uptime).encode()
 
 
 class _HTTPProtocol(asyncio.Protocol):

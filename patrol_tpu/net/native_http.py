@@ -34,6 +34,7 @@ import numpy as np
 
 from patrol_tpu import native
 from patrol_tpu.ops.rate import Rate
+from patrol_tpu.utils import histogram as hist
 
 log = logging.getLogger("patrol.native-http")
 
@@ -231,14 +232,16 @@ class NativeHTTPFront:
         res = repo.submit_takes_batch(names, rates, counts)
         if res is None:  # pool spent with everything pinned: rare overload
             raise RuntimeError("bucket pool spent; takes dropped")
-        self._cq.put((tags, streams, [t for t, _ in res]))
+        self._cq.put(
+            (tags, streams, [t for t, _ in res], time.perf_counter_ns())
+        )
 
     def _completer(self) -> None:
         while True:
             group = self._cq.get()
             if group is None:
                 return
-            tags, streams, tickets = group
+            tags, streams, tickets, t_sub = group
             nt = len(tickets)
             statuses = np.empty(nt, np.int32)
             remaining = np.empty(nt, np.int64)
@@ -248,6 +251,11 @@ class NativeHTTPFront:
                 t.wait()
                 statuses[i] = 200 if t.ok else 429
                 remaining[i] = t.remaining
+            # patrol-scope: the front's engine-wait latency (submit to
+            # batch completion), one observation per pump batch — the
+            # Python-side complement of the C++ server's own ring
+            # (http_latency_* in stats()).
+            hist.FRONT_WAIT.record(time.perf_counter_ns() - t_sub)
             self.lib.pt_http_complete_takes(
                 self.h, tags, streams, statuses, remaining, nt
             )

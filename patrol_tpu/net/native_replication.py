@@ -20,6 +20,7 @@ import logging
 import socket as pysocket
 import struct
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -37,7 +38,9 @@ from patrol_tpu.net.replication import (
     _is_ip,
     _resolve,
 )
+from patrol_tpu.utils import histogram as hist
 from patrol_tpu.utils import profiling
+from patrol_tpu.utils import trace as trace_mod
 
 log = logging.getLogger("patrol.native-replication")
 
@@ -179,7 +182,15 @@ class NativeReplicator:
             # buffers, resolve buckets through the directory's hash table —
             # a Python string is materialized only for incast requests and
             # first-seen bucket names (engine.ingest_deltas_batch_raw).
+            t_batch0 = time.perf_counter_ns()
             dbuf, _ = native.decode_batch_raw(packets, sizes, dbuf)
+            dur = time.perf_counter_ns() - t_batch0
+            # One observation per rx BATCH (the C++ decode is the unit of
+            # work here, not the packet); arg carries the batch size.
+            hist.STAGE_RX_DECODE.record(dur)
+            tr = trace_mod.TRACE
+            if tr.enabled:
+                tr.record(trace_mod.EV_RX_DECODE, dur, n)
             valid = dbuf.name_lens[:n] >= 0
             self.rx_errors += int(n - valid.sum())
             live = valid.copy()
@@ -236,6 +247,9 @@ class NativeReplicator:
                 self.repo.engine.ingest_wire_batch(
                     dbuf, n, slots, no_trailer.view(np.uint8)
                 )
+                # rx→apply for the whole batch: decode start to engine
+                # queue handoff.
+                hist.RX_APPLY.record(time.perf_counter_ns() - t_batch0)
             if multi2.any():
                 for i in np.flatnonzero(multi2):
                     st = wire.decode(bytes(packets[i][: sizes[i]]))
@@ -286,11 +300,19 @@ class NativeReplicator:
         if self.drop_addr is not None and self.drop_addr(addr):
             return
         self.rx_packets += 1
+        t0 = time.perf_counter_ns()
         try:
             state = wire.decode(data)
         except ValueError:
             self.rx_errors += 1
             return
+        dur = time.perf_counter_ns() - t0
+        hist.STAGE_RX_DECODE.record(dur)
+        if state.trace_id:
+            trace_mod.SPANS.add(
+                state.trace_id, self.slots.self_slot, "rx_decode",
+                state.name, t0, dur,
+            )
         healed = self.health.on_rx(addr)
         if healed is not None:
             self.antientropy.trigger(healed)
@@ -483,6 +505,11 @@ class NativeReplicator:
         ips, ports = self._live_peers()
         if len(ips):
             self.tx_packets += self.sock.send_fanout(pkts, sizes, ips, ports)
+            tr = trace_mod.TRACE
+            if tr.enabled:
+                tr.record(
+                    trace_mod.EV_BROADCAST_TX, 0, len(sizes) * len(ips)
+                )
 
     def _retry_oversize(self, states, pkts, sizes):
         """Re-encode trailer-oversized states (size −1) without the

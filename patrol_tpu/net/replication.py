@@ -52,7 +52,9 @@ import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from patrol_tpu.ops import wire
+from patrol_tpu.utils import histogram as hist
 from patrol_tpu.utils import profiling
+from patrol_tpu.utils import trace as trace_mod
 
 Addr = Tuple[str, int]
 
@@ -565,6 +567,7 @@ class Replicator(asyncio.DatagramProtocol):
         if self.drop_addr is not None and self.drop_addr(addr):
             return
         self.rx_packets += 1
+        t0 = time.perf_counter_ns()
         try:
             state = wire.decode(data)
         except ValueError:
@@ -572,6 +575,18 @@ class Replicator(asyncio.DatagramProtocol):
             if self.log:
                 self.log.debug("bad packet", extra={"peer": f"{addr[0]}:{addr[1]}"})
             return
+        dur = time.perf_counter_ns() - t0
+        hist.STAGE_RX_DECODE.record(dur)
+        tr = trace_mod.TRACE
+        if tr.enabled:
+            tr.record(trace_mod.EV_RX_DECODE, dur, 1)
+        if state.trace_id:
+            # A sampled remote take's state broadcast: this decode span
+            # joins the sender's take span via the propagated id.
+            trace_mod.SPANS.add(
+                state.trace_id, self.slots.self_slot, "rx_decode",
+                state.name, t0, dur,
+            )
         healed = self.health.on_rx(addr)
         if healed is not None and self.antientropy is not None:
             # Peer (re)joined or a partition healed: reconcile divergent
@@ -598,6 +613,7 @@ class Replicator(asyncio.DatagramProtocol):
                         ),
                         lane_slot,
                     )
+                hist.RX_APPLY.record(time.perf_counter_ns() - t0)
                 return
             slot = (
                 state.origin_slot
@@ -612,6 +628,8 @@ class Replicator(asyncio.DatagramProtocol):
             # A base (cap-less) trailer is a prior-version patrol_tpu peer
             # whose header carries raw own-lane values — plain lane merge.
             self.repo.apply_delta(state, slot, scalar=state.origin_slot is None)
+            # rx→apply: wire bytes to engine-queue handoff, per datagram.
+            hist.RX_APPLY.record(time.perf_counter_ns() - t0)
             if self.log:
                 self.log.debug(
                     "received",
@@ -675,6 +693,11 @@ class Replicator(asyncio.DatagramProtocol):
         for data in payloads:
             for peer in self.peers:
                 self._send(data, peer)
+        tr = trace_mod.TRACE
+        if tr.enabled and payloads and self.peers:
+            tr.record(
+                trace_mod.EV_BROADCAST_TX, 0, len(payloads) * len(self.peers)
+            )
 
     def _payload_bytes(self, st: wire.WireState) -> bytes:
         """Mode-gated encode: ``compat`` rewrites a dual-payload state to

@@ -96,6 +96,19 @@ MAX_NAME_LENGTH = PACKET_SIZE - FIXED_SIZE - 30  # room for the lane trailer
 MAX_NAME_LENGTH_V1 = PACKET_SIZE - FIXED_SIZE  # the reference's 231 (bucket.go:43-44)
 
 _HEADER = struct.Struct(">ddQ")
+# Trace-context trailer (patrol-scope cross-node take tracing): appended
+# AFTER whichever P2 trailer form the packet carries. Every decoder in
+# the fleet reads its trailer by self-described size and ignores trailing
+# bytes (the reference reads exactly data[25:25+L]; the C++ batch decoder
+# checks `tail_len >= tsz`), so the trace trailer is invisible to v1
+# peers and to pre-trace patrol builds alike — compat-free by the same
+# argument as the P2 trailer itself. Magic + checksum guard against a
+# random tail parsing as a trace id. Best-effort: emitted only when the
+# packet has room (and only for sampled takes), dropped silently
+# otherwise.
+_TRACE_TRAILER = struct.Struct(">2sQB")  # magic | u64 trace_id | checksum
+_TRACE_MAGIC = b"PT"
+TRACE_TRAILER_SIZE = _TRACE_TRAILER.size
 _TRAILER = struct.Struct(">2sBHB")
 _TRAILER_CAP = struct.Struct(">2sBHQB")
 _TRAILER_LANE = struct.Struct(">2sBHQQQB")
@@ -151,6 +164,9 @@ class WireState:
     # PN lanes in one packet (the compact incast reply)
     multi_ok: bool = False  # sender advertised multi-reply capability
     # (flag bit 0x04 on its trailer — set on incast requests)
+    trace_id: Optional[int] = None  # patrol-scope trace context (sampled
+    # takes only): propagates the sender's take span id so the receiver's
+    # decode/merge spans join it (utils/trace.py)
 
     def is_zero(self) -> bool:
         """The incast-request marker (bucket.go:163-170, repo.go:78-90)."""
@@ -213,6 +229,7 @@ def from_nanotokens(
     cap_nt: Optional[int] = None,
     lane_added_nt: Optional[int] = None,
     lane_taken_nt: Optional[int] = None,
+    trace_id: Optional[int] = None,
 ) -> WireState:
     return WireState(
         name=name,
@@ -223,6 +240,7 @@ def from_nanotokens(
         cap_nt=cap_nt,
         lane_added_nt=lane_added_nt,
         lane_taken_nt=lane_taken_nt,
+        trace_id=trace_id,
     )
 
 
@@ -300,6 +318,16 @@ def encode(state: WireState) -> bytes:
             )
         trailer[-1] = sum(trailer[:-1]) & 0xFF
         out += trailer
+        if (
+            state.trace_id is not None
+            and 0 < state.trace_id < 1 << 63
+            and len(out) + TRACE_TRAILER_SIZE <= PACKET_SIZE
+        ):
+            tt = bytearray(
+                _TRACE_TRAILER.pack(_TRACE_MAGIC, state.trace_id, 0)
+            )
+            tt[-1] = sum(tt[:-1]) & 0xFF
+            out += tt
     assert len(out) <= PACKET_SIZE
     return bytes(out)
 
@@ -325,6 +353,7 @@ def decode(data: bytes) -> WireState:
     lane_taken_nt: Optional[int] = None
     lanes: Optional[Tuple[Tuple[int, int, int], ...]] = None
     multi_ok = False
+    consumed = 0  # bytes of tail a VALID P2 trailer occupied (trace scan)
     tail = data[FIXED_SIZE + name_len :]
     if len(tail) >= TRAILER_SIZE and tail[:2] == _TRAILER_MAGIC:
         flags = tail[2]
@@ -357,6 +386,7 @@ def decode(data: bytes) -> WireState:
                     cap_nt = cap_u64
                     lanes = tuple(vals)
                     multi_ok = True
+                    consumed = tsz
         elif flags & _FLAG_LANE and flags & _FLAG_CAP and len(tail) >= TRAILER_LANE_SIZE:
             _m, _f, slot, cap_u64, la_u64, lt_u64, ck = _TRAILER_LANE.unpack_from(tail)
             if (
@@ -369,16 +399,27 @@ def decode(data: bytes) -> WireState:
                 cap_nt = cap_u64
                 lane_added_nt = la_u64
                 lane_taken_nt = lt_u64
+                consumed = TRAILER_LANE_SIZE
         elif flags & _FLAG_CAP and not flags & _FLAG_LANE and len(tail) >= TRAILER_CAP_SIZE:
             _magic, _flags, slot, cap_u64, checksum = _TRAILER_CAP.unpack_from(tail)
             if checksum == sum(tail[: TRAILER_CAP_SIZE - 1]) & 0xFF and cap_u64 < 1 << 63:
                 origin_slot = slot
                 cap_nt = cap_u64
+                consumed = TRAILER_CAP_SIZE
         elif not flags & (_FLAG_CAP | _FLAG_LANE):
             _magic, _flags, slot, checksum = _TRAILER.unpack_from(tail)
             if checksum == sum(tail[: TRAILER_SIZE - 1]) & 0xFF:
                 origin_slot = slot
                 multi_ok = bool(flags & _FLAG_MULTI)  # capability advert
+                consumed = TRAILER_SIZE
+
+    trace_id: Optional[int] = None
+    if consumed and len(tail) >= consumed + TRACE_TRAILER_SIZE:
+        tt = tail[consumed : consumed + TRACE_TRAILER_SIZE]
+        if tt[:2] == _TRACE_MAGIC and tt[-1] == sum(tt[:-1]) & 0xFF:
+            tid = int.from_bytes(tt[2:10], "big")
+            if 0 < tid < 1 << 63:
+                trace_id = tid
 
     return WireState(
         name=name,
@@ -391,6 +432,7 @@ def decode(data: bytes) -> WireState:
         lane_taken_nt=lane_taken_nt,
         lanes=lanes,
         multi_ok=multi_ok,
+        trace_id=trace_id,
     )
 
 

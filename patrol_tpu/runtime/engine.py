@@ -41,7 +41,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from patrol_tpu.models.limiter import NANO, LimiterConfig, LimiterState, init_state
+from patrol_tpu.utils import histogram as hist
 from patrol_tpu.utils import profiling
+from patrol_tpu.utils import trace as trace_mod
 from patrol_tpu.ops import commit as commit_mod
 from patrol_tpu.ops import merge as merge_mod
 from patrol_tpu.ops import wire
@@ -113,20 +115,33 @@ class StagingPool:
         self._max_per_shape = max_per_shape
 
     def lease(self, shape) -> np.ndarray:
+        t0 = time.perf_counter_ns()
         key = tuple(shape)
+        buf = None
         with self._mu:
             stack = self._free.get(key)
             if stack:
-                profiling.COUNTERS.inc("staging_reuse_hits")
-                return stack.pop()
-        profiling.COUNTERS.inc("staging_leases_fresh")
-        return np.empty(key, dtype=np.int64)
+                buf = stack.pop()
+        if buf is not None:
+            profiling.COUNTERS.inc("staging_reuse_hits")
+        else:
+            profiling.COUNTERS.inc("staging_leases_fresh")
+            buf = np.empty(key, dtype=np.int64)
+        dur = time.perf_counter_ns() - t0
+        hist.STAGE_STAGING_WAIT.record(dur)
+        tr = trace_mod.TRACE
+        if tr.enabled:
+            tr.record(trace_mod.EV_STAGING_LEASE, dur, buf.size)
+        return buf
 
     def release(self, buf: np.ndarray) -> None:
         with self._mu:
             stack = self._free.setdefault(buf.shape, [])
             if len(stack) < self._max_per_shape:
                 stack.append(buf)
+        tr = trace_mod.TRACE
+        if tr.enabled:
+            tr.record(trace_mod.EV_STAGING_RECYCLE, 0, buf.size)
 
 # Host fast path (SURVEY §7 hard-part #1; VERDICT r3 item 1): serve
 # cold/low-QPS buckets from an in-process scalar-lane model — µs-class, no
@@ -259,6 +274,8 @@ class TakeTicket:
         "remaining",
         "ok",
         "deferred",
+        "t0_ns",
+        "trace_id",
     )
 
     def __init__(self, name: str, row: int, rate: Rate, count: int, now_ns: int):
@@ -276,6 +293,10 @@ class TakeTicket:
         # ticket is still live in the queue — failure paths must not
         # complete/unpin it (engine thread only; no lock needed).
         self.deferred = False
+        # patrol-scope: service-latency stamp (take_service_ns histogram)
+        # and the sampled cross-node trace id (None when unsampled).
+        self.t0_ns = time.perf_counter_ns()
+        self.trace_id = trace_mod.sample_take()
 
     def complete(self, remaining: int, ok: bool) -> bool:
         """Returns True on the first completion (False if already done) —
@@ -302,11 +323,20 @@ class TakeTicket:
         cb()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
-        return self._event.wait(timeout)
+        ok = self._event.wait(timeout)
+        if not ok:
+            # A caller-visible take stall: freeze the flight recorder so
+            # the tick/dispatch/completion timeline that led here is
+            # inspectable after the fact (damped inside anomaly()).
+            trace_mod.anomaly("take-stall")
+        return ok
 
 
 class _Delta:
-    __slots__ = ("row", "slot", "added_nt", "taken_nt", "elapsed_ns", "scalar")
+    __slots__ = (
+        "row", "slot", "added_nt", "taken_nt", "elapsed_ns", "scalar",
+        "trace_id", "trace_name",
+    )
 
     def __init__(
         self,
@@ -317,6 +347,10 @@ class _Delta:
         elapsed_ns: int,
         scalar: bool = False,
     ):
+        # Cross-node tracing: a sampled remote take's propagated id (and
+        # the bucket name for the span label); None on the common path.
+        self.trace_id = None
+        self.trace_name = None
         self.row = row
         self.slot = slot
         # Ingest clamp: device state is non-negative by invariant; hostile or
@@ -383,6 +417,19 @@ def _pad_size(n: int, lo: int = 8, hi: int = MAX_MERGE_ROWS) -> int:
     while size < n and size < hi:
         size <<= 1
     return size
+
+
+def _obs_stage(h, t0_ns: int, ev: int, arg: int = 0) -> int:
+    """patrol-scope stage probe: close a stage opened at ``t0_ns`` into
+    its latency histogram and (when enabled) the flight recorder. The
+    cost is one perf_counter read + a histogram lane increment — the
+    same noise-level class as the COUNTERS mutex."""
+    dur = time.perf_counter_ns() - t0_ns
+    h.record(dur)
+    tr = trace_mod.TRACE
+    if tr.enabled:
+        tr.record(ev, dur, arg)
+    return dur
 
 
 # Distinct-row bound for the native fold: past this the per-row lane
@@ -763,6 +810,9 @@ class DeviceEngine:
         self._stopped = False
         self._busy = False
         self._ticks = 0  # device calls issued (observability)
+        # Cross-node tracing: (trace_id, bucket) pairs drained into the
+        # current tick; the feeder records their merge spans after _apply.
+        self._tick_traced: List[Tuple[int, str]] = []
         self._evictions = 0  # rows recycled under pool pressure
         self._scalar_dropped = 0  # v1 deltas dropped for unknown capacity
         # Completion pipeline: the feeder DISPATCHES device ticks and hands
@@ -989,6 +1039,16 @@ class DeviceEngine:
                 self._promote_locked(row)
         if ticket.complete(remaining, ok):
             self.directory.unpin_rows([row])
+        done_ns = time.perf_counter_ns()
+        hist.TAKE_SERVICE.record(done_ns - ticket.t0_ns)
+        if ticket.trace_id:
+            trace_mod.SPANS.add(
+                ticket.trace_id, self.node_slot, "take", ticket.name,
+                ticket.t0_ns, done_ns - ticket.t0_ns,
+            )
+            tr = trace_mod.TRACE
+            if tr.enabled:
+                tr.record(trace_mod.EV_TAKE, done_ns - ticket.t0_ns, 1)
         # Replicate exactly as the device completion does (zero state is
         # the incast request marker and must never broadcast).
         if (own_a or own_t or elapsed or cap) and self.on_broadcast is not None:
@@ -996,6 +1056,7 @@ class DeviceEngine:
                 ticket.name, cap + sum_a, sum_t, elapsed,
                 origin_slot=self.node_slot, cap_nt=cap,
                 lane_added_nt=own_a, lane_taken_nt=own_t,
+                trace_id=ticket.trace_id,
             )
             if out_broadcasts is not None:
                 out_broadcasts.append(ws)
@@ -1653,8 +1714,18 @@ class DeviceEngine:
                         absorbed = True
             if absorbed:
                 self.directory.unpin_rows([row])
+                if state.trace_id:
+                    # Host-absorbed remote delta: the merge span completes
+                    # here, joined to the sender's take span by the id.
+                    trace_mod.SPANS.add(
+                        state.trace_id, self.node_slot, "merge", state.name,
+                        time.perf_counter_ns(), 0,
+                    )
                 return created
         delta = _Delta(row, slot, added_nt, taken_nt, state.elapsed_ns, scalar)
+        if state.trace_id:
+            delta.trace_id = state.trace_id
+            delta.trace_name = state.name
         with self._cond:
             self._deltas.append(delta)
             self._cond.notify()
@@ -2344,7 +2415,12 @@ class DeviceEngine:
                 self._completing = True
                 self._pcond.notify_all()  # wake a back-pressured feeder
             try:
+                t0 = time.perf_counter_ns()
                 thunk()
+                _obs_stage(
+                    hist.STAGE_COMPLETION, t0, trace_mod.EV_COMMIT_COMPLETE,
+                    len(tickets),
+                )
             except Exception:  # pragma: no cover - completer must not die
                 log.exception("tick completion failed")
                 try:
@@ -2478,6 +2554,7 @@ class DeviceEngine:
                     )
                 ]
                 self._emit_broadcasts(bc)
+            t_tick0 = time.perf_counter_ns()
             try:
                 # Pending promotions join BEFORE the tick's device work,
                 # so a take routed device-ward this tick (its row's flag
@@ -2490,10 +2567,27 @@ class DeviceEngine:
                 # and on MeshEngine a whole fused no-op step).
                 if deltas is not None or tickets:
                     self._apply(deltas, tickets)
+                    tick_dur = time.perf_counter_ns() - t_tick0
+                    tr = trace_mod.TRACE
+                    if tr.enabled:
+                        tr.record(
+                            trace_mod.EV_TICK, tick_dur,
+                            (len(deltas) if deltas is not None else 0)
+                            + len(tickets),
+                        )
+                    for tid, tname in self._tick_traced:
+                        # Remote deltas merged this tick: their merge
+                        # spans close here, joined by the propagated id.
+                        trace_mod.SPANS.add(
+                            tid, self.node_slot, "merge", tname,
+                            t_tick0, tick_dur,
+                        )
             except Exception:  # pragma: no cover - engine must never die
                 log.exception("engine tick failed")
+                trace_mod.anomaly("engine-tick-failed")
                 self._fail_tickets(tickets)
             finally:
+                self._tick_traced = []
                 if deltas is not None:
                     # Deltas are done (applied or lost with the tick): their
                     # in-flight row pins release here, success or not.
@@ -2530,6 +2624,7 @@ class DeviceEngine:
         taken = np.empty(total, np.int64)
         elapsed = np.empty(total, np.int64)
         scalar = np.zeros(total, bool)
+        traced = self._tick_traced = []
         at = 0
         for it in items:
             if isinstance(it, _DeltaChunk):
@@ -2547,6 +2642,8 @@ class DeviceEngine:
                 taken[at] = it.taken_nt
                 elapsed[at] = it.elapsed_ns
                 scalar[at] = it.scalar
+                if it.trace_id:
+                    traced.append((it.trace_id, it.trace_name))
                 at += 1
         return DeltaArrays(rows, slots, added, taken, elapsed, scalar)
 
@@ -2607,6 +2704,8 @@ class DeviceEngine:
         Completion releases each ticket's directory pin."""
         broadcasts: List[wire.WireState] = []
         unpin: List[int] = []
+        done_ns = time.perf_counter_ns()
+        take_hist = hist.TAKE_SERVICE
         for i, key in enumerate(keys):
             ts = groups[key]
             c_nt = ts[0].count * NANO
@@ -2616,6 +2715,12 @@ class DeviceEngine:
                 )
                 if t.complete(remaining, ok):
                     unpin.append(t.row)
+                    take_hist.record(done_ns - t.t0_ns)
+                    if t.trace_id:
+                        trace_mod.SPANS.add(
+                            t.trace_id, self.node_slot, "take", t.name,
+                            t.t0_ns, done_ns - t.t0_ns,
+                        )
             # Replicate. The reference broadcasts full state on every take,
             # success or not (api.go:74, README.md:41-43) — even a failed
             # first take commits the lazy capacity init (bucket.go:194-196),
@@ -2637,6 +2742,12 @@ class DeviceEngine:
                         cap_nt=cap,
                         lane_added_nt=int(own_a[i]),
                         lane_taken_nt=int(own_t[i]),
+                        # A sampled take in the group propagates its trace
+                        # id on the state broadcast (the group shares one
+                        # packet, so one id rides it).
+                        trace_id=next(
+                            (t.trace_id for t in ts if t.trace_id), None
+                        ),
                     )
                 )
         if unpin:
@@ -2712,12 +2823,15 @@ class DeviceEngine:
         # no effect there.
         fold_default = "0" if jax.default_backend() == "cpu" else "1"
         if os.environ.get("PATROL_TICK_FOLD", fold_default) != "0":
+            t0 = time.perf_counter_ns()
             packed, dense = self._fold_hybrid(deltas)
+            _obs_stage(hist.STAGE_FOLD, t0, trace_mod.EV_FOLD, len(deltas))
             # Stage the operands on device BEFORE the state lock: the
             # H2D transfer then overlaps the previous tick's compute
             # instead of serializing inside the jit call (device-commit
             # pipeline; the fold buffers are freshly allocated per tick,
             # so jax owns them until the async transfer completes).
+            t0 = time.perf_counter_ns()
             dense_dev = (
                 tuple(jax.device_put(x) for x in dense)
                 if dense is not None
@@ -2726,6 +2840,8 @@ class DeviceEngine:
             packed_dev = (
                 jax.device_put(packed) if packed is not None else None
             )
+            _obs_stage(hist.STAGE_H2D, t0, trace_mod.EV_H2D_PUT, len(deltas))
+            t0 = time.perf_counter_ns()
             with self._state_mu:
                 if dense_dev is not None:
                     self.state = _jit_merge_rows_dense()(
@@ -2735,6 +2851,10 @@ class DeviceEngine:
                     self.state = _jit_merge_packed_folded()(
                         self.state, packed_dev
                     )
+            _obs_stage(
+                hist.STAGE_DISPATCH, t0, trace_mod.EV_COMMIT_DISPATCH,
+                len(deltas),
+            )
             self._ticks += 1
             return
         n = len(deltas)
@@ -2745,9 +2865,13 @@ class DeviceEngine:
         packed[2, :n] = deltas.added_nt
         packed[3, :n] = deltas.taken_nt
         packed[4, :n] = deltas.elapsed_ns
+        t0 = time.perf_counter_ns()
         packed_dev = jax.device_put(packed)  # staged ahead of the lock
+        _obs_stage(hist.STAGE_H2D, t0, trace_mod.EV_H2D_PUT, n)
+        t0 = time.perf_counter_ns()
         with self._state_mu:
             self.state = _jit_merge_packed()(self.state, packed_dev)
+        _obs_stage(hist.STAGE_DISPATCH, t0, trace_mod.EV_COMMIT_DISPATCH, n)
         self._ticks += 1
 
     def _commit_coalesced(self, deltas: DeltaArrays) -> None:
@@ -2762,27 +2886,43 @@ class DeviceEngine:
         returns to the pool on the completer thread once the transfer is
         ready, which also keeps pipeline depth bounded."""
         blocks_in = -(-len(deltas) // MAX_MERGE_ROWS)  # ceil
+        t0 = time.perf_counter_ns()
         ur, us, ua, ut, er, e = self._fold_core(deltas)
+        _obs_stage(hist.STAGE_FOLD, t0, trace_mod.EV_FOLD, len(deltas))
         if len(ur) <= MAX_MERGE_ROWS:
             # The fold collapsed the drain into one block (hot keys /
             # cross-block duplicates): the single-block folded kernel is
             # the cheaper dispatch, and the coalescing already happened
             # on host.
             packed = self._pack_folded(ur, us, ua, ut, er, e)
+            t0 = time.perf_counter_ns()
             packed_dev = jax.device_put(packed)
+            _obs_stage(hist.STAGE_H2D, t0, trace_mod.EV_H2D_PUT, len(ur))
+            t0 = time.perf_counter_ns()
             with self._state_mu:
                 self.state = _jit_merge_packed_folded()(
                     self.state, packed_dev
                 )
+            _obs_stage(
+                hist.STAGE_DISPATCH, t0, trace_mod.EV_COMMIT_DISPATCH,
+                len(ur),
+            )
         else:
             shape = commit_mod.commit_shape(len(ur), MAX_MERGE_ROWS)
             buf = self._staging.lease(shape)
             commit_mod.pack_commit_blocks(
                 ur, us, ua, ut, er, e, MAX_MERGE_ROWS, out=buf
             )
+            t0 = time.perf_counter_ns()
             dev = jax.device_put(buf)
+            _obs_stage(hist.STAGE_H2D, t0, trace_mod.EV_H2D_PUT, len(ur))
+            t0 = time.perf_counter_ns()
             with self._state_mu:
                 self.state = _jit_commit_packed()(self.state, dev)
+            _obs_stage(
+                hist.STAGE_DISPATCH, t0, trace_mod.EV_COMMIT_DISPATCH,
+                len(ur),
+            )
             self._release_when_shipped(dev, buf)
         self._ticks += 1
         profiling.COUNTERS.inc("commit_blocks_coalesced", blocks_in)
@@ -2921,11 +3061,17 @@ class DeviceEngine:
             packed[6, i] = self.directory.cap_base_nt[first.row]
             packed[7, i] = self.directory.created_ns[first.row]
 
+        t0 = time.perf_counter_ns()
         packed_dev = jax.device_put(packed)  # staged ahead of the lock
+        _obs_stage(hist.STAGE_H2D, t0, trace_mod.EV_H2D_PUT, len(keys))
+        t0 = time.perf_counter_ns()
         with self._state_mu:
             self.state, out = _jit_take_packed(self.node_slot)(
                 self.state, packed_dev
             )
+        _obs_stage(
+            hist.STAGE_DISPATCH, t0, trace_mod.EV_COMMIT_DISPATCH, len(keys)
+        )
         self._ticks += 1
 
         def complete() -> None:

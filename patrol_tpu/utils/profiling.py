@@ -222,10 +222,18 @@ class CounterRegistry:
     * ``ae_resync_buckets`` / ``ae_packets_tx`` — buckets re-synced and
       packets sent by heal-time anti-entropy (net/antientropy.py);
     * ``shutdown_flush_states`` — final dirty bucket states broadcast by
-      the graceful-shutdown flush (command.py).
+      the graceful-shutdown flush (command.py);
+    * ``trace_anomaly_snapshots`` / ``trace_take_samples`` — patrol-scope
+      flight-recorder anomaly snapshots taken and takes tagged with a
+      cross-node trace id (utils/trace.py).
 
     Monotonic counts + high-water gauges only; all call sites are
-    per-tick/per-batch (kHz), so one mutex is noise-level overhead."""
+    per-tick/per-batch (kHz), so one mutex is noise-level overhead.
+
+    Every ``inc``/``set_max`` call site in the tree must name a counter
+    declared here — enforced by the PTL005 lint (analysis/lint.py), so a
+    new counter cannot silently miss the zero-filled ``/debug/vars``
+    field set below."""
 
     _KNOWN = (
         "staging_reuse_hits",
@@ -239,6 +247,8 @@ class CounterRegistry:
         "ae_resync_buckets",
         "ae_packets_tx",
         "shutdown_flush_states",
+        "trace_anomaly_snapshots",
+        "trace_take_samples",
     )
 
     def __init__(self):
@@ -379,13 +389,34 @@ def heap_summary(limit: int = 30) -> str:
     return "\n".join(lines) + "\n"
 
 
+class ProfilerBusyError(RuntimeError):
+    """A JAX trace capture is already running (the route answers 409)."""
+
+
+# One capture at a time: jax.profiler.start_trace is process-global state,
+# and two overlapping /debug/jax/trace requests used to call it twice —
+# the second start_trace raises inside the handler's executor and the
+# route 500s (or worse, the stop_trace of one request tears down the
+# other's live capture). Serialized here rather than in the HTTP layer so
+# BOTH fronts (and direct callers) get the same busy contract.
+_jax_trace_mu = threading.Lock()
+
+
 def jax_trace(duration_s: float = 2.0, out_dir: Optional[str] = None) -> str:
     """Capture a JAX profiler trace (XPlane; viewable in perfetto /
-    tensorboard). Returns the dump directory."""
-    import jax
+    tensorboard). Returns the dump directory. Raises
+    :class:`ProfilerBusyError` when a capture is already in flight."""
+    if not _jax_trace_mu.acquire(blocking=False):
+        raise ProfilerBusyError("a jax trace capture is already running")
+    try:
+        import jax
 
-    out = out_dir or tempfile.mkdtemp(prefix="patrol-jax-trace-")
-    jax.profiler.start_trace(out)
-    time.sleep(min(duration_s, 30.0))
-    jax.profiler.stop_trace()
-    return out
+        out = out_dir or tempfile.mkdtemp(prefix="patrol-jax-trace-")
+        jax.profiler.start_trace(out)
+        try:
+            time.sleep(min(duration_s, 30.0))
+        finally:
+            jax.profiler.stop_trace()
+        return out
+    finally:
+        _jax_trace_mu.release()

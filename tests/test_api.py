@@ -208,6 +208,73 @@ class TestDebugRoutes:
         assert "patrol_engine_ticks" in body
         assert "patrol_uptime_seconds" in body
 
+    def test_metrics_is_parseable_exposition_with_histograms(self, srv):
+        """patrol-scope: /metrics is real Prometheus text exposition —
+        the strict fixture parser accepts it and the latency histograms
+        ride it as cumulative bucket series."""
+        from patrol_tpu.utils import histogram as hist_mod
+
+        # Guarantee at least one take-service observation first.
+        srv.request("POST", "/take/meters?rate=5:1s")
+        status, body = srv.request("GET", "/metrics")
+        assert status == 200
+        parsed = hist_mod.parse_exposition(body)
+        assert parsed["types"]["patrol_take_service_ns"] == "histogram"
+        assert parsed["samples"][("patrol_take_service_ns_count", ())] >= 1
+
+    def test_trace_ring_routes(self, srv):
+        import json as _json
+
+        status, body = srv.request("GET", "/debug/trace/ring")
+        assert status == 200
+        doc = _json.loads(body)
+        assert "traceEvents" in doc
+        status, body = srv.request("GET", "/debug/trace/snapshots")
+        assert status == 200 and isinstance(_json.loads(body), list)
+        status, _ = srv.request("GET", "/debug/trace/ring?snapshot=9999")
+        assert status == 404
+
+    def test_trace_spans_route(self, srv):
+        import json as _json
+
+        status, body = srv.request("GET", "/debug/trace/spans")
+        assert status == 200 and isinstance(_json.loads(body), list)
+        status, _ = srv.request("GET", "/debug/trace/spans?trace_id=junk")
+        assert status == 400
+
+    def test_jax_trace_busy_409(self, srv):
+        """Regression (utils/profiling.py): two overlapping
+        /debug/jax/trace requests used to double-start the process-global
+        jax profiler and crash the handler. The capture is serialized
+        now; a request that overlaps a running capture gets a clean 409.
+        Deterministic form: hold the REAL serialization lock (what a
+        running capture holds) while hitting the real route — the busy
+        path short-circuits before touching the jax profiler at all."""
+        from patrol_tpu.utils import profiling
+
+        assert profiling._jax_trace_mu.acquire(timeout=10)
+        try:
+            status, body = srv.request("GET", "/debug/jax/trace?seconds=0.1")
+            assert status == 409
+            assert "already running" in body
+        finally:
+            profiling._jax_trace_mu.release()
+
+    def test_jax_trace_busy_error_without_http(self):
+        """The busy contract lives in profiling.jax_trace itself (shared
+        by both fronts and direct callers): a held capture lock raises
+        ProfilerBusyError without starting a second capture."""
+        import pytest as _pytest
+
+        from patrol_tpu.utils import profiling
+
+        assert profiling._jax_trace_mu.acquire(timeout=10)
+        try:
+            with _pytest.raises(profiling.ProfilerBusyError):
+                profiling.jax_trace(duration_s=0.01)
+        finally:
+            profiling._jax_trace_mu.release()
+
     def test_vars(self, srv):
         status, body = srv.request("GET", "/debug/vars")
         assert status == 200 and "engine_ticks" in body

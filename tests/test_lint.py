@@ -443,6 +443,64 @@ class TestDtypeDiscipline:
         assert lint.lint_sources({"patrol_tpu/ops/take.py": src}) == []
 
 
+class TestCounterRegistry:
+    """PTL005: every COUNTERS.inc/set_max call site must name a counter
+    declared in CounterRegistry._KNOWN (the zero-filled /debug/vars field
+    set). Proven both ways on fixtures, like the other checks."""
+
+    def test_fires_on_undeclared_literal_name(self):
+        src = (
+            "from patrol_tpu.utils import profiling\n\n"
+            "def f():\n"
+            "    profiling.COUNTERS.inc('not_a_declared_counter')\n"
+        )
+        f = lint.lint_sources({"patrol_tpu/runtime/x.py": src})
+        assert codes(f) == ["PTL005"]
+        assert "not_a_declared_counter" in f[0].message
+
+    def test_fires_on_set_max_too(self):
+        src = (
+            "from patrol_tpu.utils.profiling import COUNTERS\n\n"
+            "def f(d):\n    COUNTERS.set_max('bogus_gauge', d)\n"
+        )
+        assert codes(lint.lint_sources({"patrol_tpu/x.py": src})) == ["PTL005"]
+
+    def test_fires_on_non_literal_name(self):
+        # A dynamic name cannot be verified against the declaration.
+        src = (
+            "from patrol_tpu.utils import profiling\n\n"
+            "def f(name):\n    profiling.COUNTERS.inc(name)\n"
+        )
+        f = lint.lint_sources({"patrol_tpu/x.py": src})
+        assert codes(f) == ["PTL005"]
+        assert "non-literal" in f[0].message
+
+    def test_silent_on_declared_name(self):
+        src = (
+            "from patrol_tpu.utils import profiling\n\n"
+            "def f():\n    profiling.COUNTERS.inc('commit_dispatches')\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def test_silent_on_unrelated_inc_methods(self):
+        # .inc on anything not named COUNTERS is out of scope.
+        src = "def f(metrics):\n    metrics.inc('whatever')\n"
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def test_suppressible_inline(self):
+        src = (
+            "from patrol_tpu.utils import profiling\n\n"
+            "def f():\n"
+            "    profiling.COUNTERS.inc('adhoc')  # patrol-lint: disable=PTL005\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def test_known_names_load_from_profiling(self):
+        names = lint.known_counter_names()
+        assert "commit_dispatches" in names
+        assert "trace_anomaly_snapshots" in names
+
+
 class TestGenericSuppression:
     def test_disable_directive_names_codes(self):
         src = (

@@ -210,6 +210,32 @@ class TestContentionProfiles:
         assert "test.cond" in strings
         assert len(prof.get(2, [])) >= 1
 
+    def test_condition_wait_timeout_records_park(self):
+        """The TIMEOUT path of ProfiledCondition.wait is a block event
+        too: a park that expired unserved is exactly the wait the block
+        profile exists to attribute (Go records it the same way)."""
+        import time as _t
+
+        from patrol_tpu.utils import profiling
+
+        reg = profiling.ContentionRegistry(fraction=1)
+        old = profiling.REGISTRY
+        profiling.REGISTRY = reg
+        try:
+            cond = profiling.ProfiledCondition("timeout.cond")
+            t0 = _t.perf_counter()
+            with cond:
+                assert cond.wait(timeout=0.03) is False  # nobody notifies
+            assert _t.perf_counter() - t0 >= 0.02
+        finally:
+            profiling.REGISTRY = old
+        text = reg.block_text()
+        assert "timeout.cond" in text
+        with reg._mu:
+            (_stack, (contentions, delay_ns)), = reg._block.items()
+        assert contentions == 1
+        assert delay_ns >= 20_000_000  # the full park time was recorded
+
     def test_engine_under_load_records_contention(self):
         """Driving the engine from two threads produces a non-empty mutex
         or block profile — the feeder-vs-caller contention signal the
@@ -232,3 +258,56 @@ class TestContentionProfiles:
         # events; at fraction 1/8 a 200-take run records plenty.
         text = profiling.REGISTRY.block_text()
         assert "engine." in text
+
+
+class TestContentionSubsampling:
+    """``fraction=N`` subsamples Go-style: 1/N of events pay the stack
+    walk, and the profile scales recorded values back by ×N. Property:
+    over seeded wait schedules the scaled totals track the true totals —
+    contentions exactly (deterministic every-Nth sampling), delay within
+    sampling noise."""
+
+    def _drive(self, fraction, waits):
+        from patrol_tpu.utils import profiling
+
+        reg = profiling.ContentionRegistry(fraction=fraction)
+        for w in waits:
+            reg.record_mutex("prop.lock", int(w))
+        with reg._mu:
+            contentions = sum(c for c, _ in reg._mutex.values())
+            delay = sum(d for _, d in reg._mutex.values())
+        return contentions * reg.fraction, delay * reg.fraction
+
+    def test_scaled_totals_track_truth_over_seeded_schedules(self):
+        import random
+
+        for seed in (1, 7, 42, 1337):
+            rng = random.Random(seed)
+            n = 400
+            waits = [rng.randrange(1_000, 2_000_000) for _ in range(n)]
+            for fraction in (2, 4, 8):
+                sc, sd = self._drive(fraction, waits)
+                true_delay = sum(waits)
+                # Every-Nth sampling: the scaled count is exact when
+                # N divides the schedule length.
+                assert sc == n, (seed, fraction, sc)
+                # Delay: sampled mean ≈ true mean (uniform waits, 50+
+                # samples) — generous ±40% band keeps this seed-stable.
+                assert 0.6 * true_delay <= sd <= 1.4 * true_delay, (
+                    seed, fraction, sd, true_delay,
+                )
+
+    def test_fraction_one_is_exact(self):
+        waits = [10_000, 20_000, 30_000]
+        sc, sd = self._drive(1, waits)
+        assert sc == 3 and sd == 60_000
+
+    def test_fraction_reduces_recorded_sites(self):
+        from patrol_tpu.utils import profiling
+
+        reg = profiling.ContentionRegistry(fraction=8)
+        for i in range(64):
+            reg.record_mutex("site.lock", 1000)
+        with reg._mu:
+            (_stack, (contentions, _)), = reg._mutex.items()
+        assert contentions == 8  # 64/8 events actually recorded
