@@ -1591,9 +1591,244 @@ def chaos_main() -> int:
     return 0
 
 
+def wire_main() -> int:
+    """``bench.py --wire-smoke``: a seconds-class, CPU-safe gate for the
+    wire-v2 delta-interval data plane (net/delta.py). Runs the SAME seeded
+    churn workload (one taker node, round-robin over a bucket set, frozen
+    clocks) over a real 2-node loopback replication plane twice — once in
+    ``--wire-mode compat`` (the v1 full-state-packet-per-take plane) and
+    once in ``--wire-mode delta`` — and emits the side-by-side:
+    ``wire_deltas_per_packet``, ``wire_packets_per_take`` (both modes),
+    ``wire_tx_bytes_per_admitted_take``. Exits nonzero unless the delta
+    run packs ≥ 50 bucket deltas per datagram, uses ≥ 10x fewer
+    packets-per-take than compat, and BOTH runs converge bit-exactly to
+    the SAME per-bucket fixpoint (state digests equal across nodes and
+    across modes)."""
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    OUT["metric"] = "wire v2 delta-interval smoke (delta vs compat)"
+    OUT["unit"] = "takes"
+    OUT["wire_smoke"] = True
+    t0 = time.time()
+    # Manual pacing: the smoke drives flush ticks itself so the packing
+    # numbers are deterministic, not a race against a 20 ms timer.
+    os.environ["PATROL_DELTA_FLUSH_MS"] = "0"
+    try:
+        import asyncio
+        import socket as sk
+        import threading
+
+        import jax
+
+        import patrol_tpu  # noqa: F401  (enables x64)
+        from patrol_tpu.models.limiter import NANO, LimiterConfig
+        from patrol_tpu.net.antientropy import state_digest
+        from patrol_tpu.net.replication import Replicator, SlotTable
+        from patrol_tpu.ops.rate import Rate
+        from patrol_tpu.runtime.engine import DeviceEngine
+        from patrol_tpu.runtime.repo import TPURepo
+        from patrol_tpu.utils import profiling
+
+        OUT["platform"] = jax.default_backend()
+        BUCKETS, TAKES, FLUSH_EVERY = 600, 6000, 1200
+        OUT["value"] = TAKES
+        OUT["wire_smoke_buckets"] = BUCKETS
+        names = [f"w{k:04d}" for k in range(BUCKETS)]
+        rate = Rate(freq=1_000_000, per_ns=3600 * NANO)
+
+        def free_port():
+            s = sk.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        def run_mode(mode: str) -> dict:
+            loop = asyncio.new_event_loop()
+            thread = threading.Thread(target=lambda: (
+                asyncio.set_event_loop(loop), loop.run_forever()
+            ), daemon=True)
+            thread.start()
+
+            def on_loop(coro):
+                return asyncio.run_coroutine_threadsafe(coro, loop).result(15)
+
+            # SlotTable ranks members by sorted address string: order the
+            # two addrs lexicographically so the TAKER is always lane 0 —
+            # otherwise the cross-mode digest comparison would race the
+            # ephemeral-port draw (lane slots are part of the digest).
+            addrs = sorted(
+                (f"127.0.0.1:{free_port()}" for _ in range(2)),
+            )
+            frozen = lambda: NANO  # noqa: E731 — zero grants ⇒ exact fixpoint
+            nodes = []
+            tx0 = profiling.COUNTERS.get("replication_tx_packets")
+            res: dict = {"mode": mode}
+            try:
+                for i in range(2):
+                    slots = SlotTable(addrs[i], addrs, max_slots=4)
+                    rep = on_loop(
+                        Replicator.create(addrs[i], addrs, slots, wire_mode=mode)
+                    )
+                    rep.antientropy.min_interval_s = 0.3
+                    eng = DeviceEngine(
+                        LimiterConfig(buckets=2048, nodes=4),
+                        node_slot=slots.self_slot,
+                        clock=frozen,
+                    )
+                    # send_incast=None: the smoke measures the DATA plane;
+                    # cold-miss incast solicitation is not what it gates.
+                    repo = TPURepo(eng, send_incast=None)
+                    rep.repo = repo
+                    eng.on_broadcast = rep.broadcast_states
+                    nodes.append((rep, eng, repo))
+
+                def flush_all():
+                    for rep, _, _ in nodes:
+                        rep.delta.flush()
+
+                if mode == "delta":
+                    deadline = time.time() + 10
+                    while time.time() < deadline:
+                        flush_all()
+                        if all(
+                            len(rep.delta.capable_peers()) == 1
+                            for rep, _, _ in nodes
+                        ):
+                            break
+                        time.sleep(0.02)
+                    assert all(
+                        len(rep.delta.capable_peers()) == 1 for rep, _, _ in nodes
+                    ), "v2 capability handshake did not complete"
+
+                for t in range(TAKES):
+                    _, ok = nodes[0][2].take(names[t % BUCKETS], rate, 1)
+                    assert ok, "admission must not fail at cap >> takes"
+                    if mode == "delta" and (t + 1) % FLUSH_EVERY == 0:
+                        flush_all()
+                if mode == "delta":
+                    flush_all()
+
+                # Converge: the CvRDT subsumption plus (both modes) the
+                # heal-time anti-entropy backstop repair any rx loss.
+                deadline = time.time() + 30
+                next_trigger = 0.0
+                converged = False
+                digests = [{}, {}]
+                while time.time() < deadline:
+                    if mode == "delta":
+                        flush_all()
+                    if time.time() >= next_trigger:
+                        next_trigger = time.time() + 1.0
+                        for rep, _, _ in nodes:
+                            for peer in rep.peers:
+                                rep.antientropy.trigger(peer, force=True)
+                    for k, (_, eng, _) in enumerate(nodes):
+                        eng.flush()
+                        d = {}
+                        for lo in range(0, BUCKETS, 64):
+                            for nm, sts in eng.snapshot_many(
+                                names[lo : lo + 64]
+                            ).items():
+                                d[nm] = state_digest(sts)
+                        digests[k] = d
+                    if (
+                        len(digests[0]) == BUCKETS
+                        and digests[0] == digests[1]
+                    ):
+                        converged = True
+                        break
+                    time.sleep(0.05)
+
+                res["converged"] = converged
+                res["digests"] = digests[0]
+                res["classic_broadcast_packets"] = (
+                    profiling.COUNTERS.get("replication_tx_packets") - tx0
+                )
+                res["tx_bytes"] = sum(rep.tx_bytes for rep, _, _ in nodes)
+                res["stats0"] = nodes[0][0].delta.stats()
+                res["rx_errors"] = sum(rep.rx_errors for rep, _, _ in nodes)
+            finally:
+                for rep, eng, _ in nodes:
+                    loop.call_soon_threadsafe(rep.close)
+                    eng.stop()
+                time.sleep(0.2)
+                loop.call_soon_threadsafe(loop.stop)
+                thread.join(timeout=5)
+            return res
+
+        compat = run_mode("compat")
+        delta = run_mode("delta")
+
+        st = delta["stats0"]
+        data_pkts = st["wire_delta_packets_tx"]
+        ack_pkts = st["wire_delta_ack_packets_tx"]
+        OUT["wire_deltas_batched"] = st["wire_deltas_batched"]
+        OUT["wire_delta_packets"] = data_pkts
+        OUT["wire_delta_ack_packets"] = ack_pkts
+        OUT["wire_interval_retransmits"] = st["wire_interval_retransmits"]
+        OUT["wire_fullstate_fallbacks"] = st["wire_fullstate_fallbacks"]
+        OUT["wire_deltas_per_packet"] = (
+            round(st["wire_deltas_batched"] / data_pkts, 1) if data_pkts else 0.0
+        )
+        OUT["wire_packets_per_take"] = round(
+            (data_pkts + ack_pkts) / TAKES, 4
+        )
+        OUT["wire_packets_per_take_compat"] = round(
+            compat["classic_broadcast_packets"] / TAKES, 4
+        )
+        OUT["wire_tx_bytes_per_admitted_take"] = round(
+            delta["tx_bytes"] / TAKES, 1
+        )
+        OUT["wire_tx_bytes_per_admitted_take_compat"] = round(
+            compat["tx_bytes"] / TAKES, 1
+        )
+        OUT["wire_converged_compat"] = compat["converged"]
+        OUT["wire_converged_delta"] = delta["converged"]
+        fixpoint_equal = (
+            compat["converged"]
+            and delta["converged"]
+            and compat["digests"] == delta["digests"]
+        )
+        OUT["wire_fixpoint_equal"] = fixpoint_equal
+        ratio = (
+            OUT["wire_packets_per_take_compat"] / OUT["wire_packets_per_take"]
+            if OUT["wire_packets_per_take"]
+            else 0.0
+        )
+        OUT["wire_packet_reduction_x"] = round(ratio, 1)
+
+        assert compat["converged"], "compat-mode run did not converge"
+        assert delta["converged"], "delta-mode run did not converge"
+        assert fixpoint_equal, (
+            "delta-mode fixpoint diverged from the compat-mode fixpoint"
+        )
+        assert OUT["wire_deltas_per_packet"] >= 50, (
+            f"only {OUT['wire_deltas_per_packet']} deltas per packet (< 50)"
+        )
+        assert ratio >= 10, (
+            f"delta plane only {ratio:.1f}x fewer packets-per-take (< 10x)"
+        )
+        OUT["wire_smoke_seconds"] = round(time.time() - t0, 2)
+        OUT["stages_completed"] = 1
+        OUT["stages"] = ["wire-smoke"]
+    except BaseException as e:
+        _log(f"wire smoke failed: {type(e).__name__}: {e}")
+        OUT["error"] = f"{type(e).__name__}: {e}"
+        OUT["wire_fixpoint_equal"] = False
+        _emit()
+        if not isinstance(e, Exception):
+            raise
+        return 1
+    _emit()
+    return 0
+
+
 if __name__ == "__main__":
     if "--smoke" in sys.argv:
         sys.exit(smoke_main())
     if "--chaos-smoke" in sys.argv:
         sys.exit(chaos_main())
+    if "--wire-smoke" in sys.argv:
+        sys.exit(wire_main())
     main()

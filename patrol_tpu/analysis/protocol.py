@@ -18,13 +18,22 @@ model of the protocol:
   bucket with capacity ``limit`` and no refill (the algebra of
   ops/take.py's no-grant path: admit iff
   ``limit + Σadded − Σtaken ≥ count``, spend into the own lane);
-* every take broadcasts the taker's lanes (the full-state datagram);
+* every take broadcasts the taker's lanes (the full-state datagram) —
+  or, on the wire-v2 delta plane (``Semantics.wire``), marks the taker
+  dirty for an explicit *flush* event that emits a sequenced
+  delta-interval packet per capable peer, acked on delivery (GC),
+  retransmitted by the convergence procedure when lost (net/delta.py's
+  interval/ack-vector machinery as explicit model events);
 * the network is a per-link multiset of in-flight packets supporting
   deliver / duplicate-deliver / drop / reorder (delivery order is free);
-* merge is the elementwise lattice max (CvRDT join);
+* merge is the elementwise lattice max (CvRDT join); a v1 node in a
+  mixed cluster ignores delta packets entirely (the control-channel
+  invisibility of the real framing);
 * heal-time anti-entropy = pairwise state exchange, modelling
   net/antientropy.py's digest+fetch resync as its effect (ship the
-  divergent state, join on arrival).
+  divergent state, join on arrival) — deliberately NOT applied to
+  pure-delta clusters, whose own retransmit machinery must converge
+  unaided (a broken interval log cannot hide behind AE).
 
 Machine-checked invariants, each a PTC code:
 
@@ -84,14 +93,33 @@ _SELF = "patrol_tpu/analysis/protocol.py"
 @dataclasses.dataclass(frozen=True)
 class Semantics:
     """The model's tunable laws. The clean protocol is the default; each
-    mutation flips one law to a plausible-but-wrong alternative."""
+    mutation flips one law to a plausible-but-wrong alternative.
+
+    ``wire`` selects the data plane: ``"full"`` is the v1 per-take
+    full-state broadcast; ``"delta"`` is the wire-v2 delta-interval plane
+    (net/delta.py) — takes mark the taker dirty, an explicit *flush*
+    event packs the own-lane join-decomposition into a sequenced interval
+    packet per capable peer, delivery acks the interval (GC), loss leaves
+    it unacked and the convergence procedure retransmits it; ``"mixed"``
+    runs the last node as a v1 peer (it ships/receives only full states,
+    and *ignores* any delta packet — the control-channel invisibility).
+    Delta-plane laws: ``delta_payload`` ships absolute lane values (the
+    correct join-decomposition of a max-lattice) or raw increments (the
+    classic delta-CRDT bug: duplication inflates state); ``delta_gc``
+    garbage-collects intervals on ack or eagerly at send (the GC bug:
+    a lost interval is never repaired)."""
 
     merge: str = "join"  # "join" | "sum" | "assign"
     resync: str = "join"  # "join" | "overwrite"
     take: str = "global"  # "global" | "own_only"
+    wire: str = "full"  # "full" | "delta" | "mixed"
+    delta_payload: str = "absolute"  # "absolute" | "increment"
+    delta_gc: str = "acked"  # "acked" | "eager"
 
 
 CLEAN = Semantics()
+CLEAN_DELTA = Semantics(wire="delta")
+CLEAN_MIXED = Semantics(wire="mixed")
 
 # Seeded protocol bugs the checker must reject (name → (semantics, what a
 # correct checker reports about it)).
@@ -100,13 +128,39 @@ MUTATIONS: Dict[str, Semantics] = {
     "merge-sums-instead-of-maxes": Semantics(merge="sum"),
     "merge-assigns-lww": Semantics(merge="assign"),
     "take-ignores-remote-lanes": Semantics(take="own_only"),
+    # Wire-v2 delta-plane bugs: shipping increments instead of absolute
+    # join-decompositions (duplicated delivery inflates state), and
+    # GC'ing an interval before its ack (a dropped interval is lost for
+    # good — the plane's retransmit machinery has nothing to re-ship).
+    "delta-ships-increments-not-absolutes": Semantics(
+        wire="delta", delta_payload="increment"
+    ),
+    "delta-gc-before-ack": Semantics(wire="delta", delta_gc="eager"),
 }
 
 
-class Node:
-    """One replica: PN lanes over a single bucket, capacity ``limit``."""
+def _caps(sem: Semantics, n: int) -> List[bool]:
+    """Per-node v2 capability: all (delta), none (full), or all but the
+    last node (mixed — the v1 peer)."""
+    if sem.wire == "delta":
+        return [True] * n
+    if sem.wire == "mixed":
+        return [i != n - 1 for i in range(n)]
+    return [False] * n
 
-    __slots__ = ("slot", "n", "limit", "added", "taken", "admitted")
+
+class Node:
+    """One replica: PN lanes over a single bucket, capacity ``limit``.
+    Delta-plane state (used only when the node is v2-capable): ``dirty``
+    marks un-flushed own-lane changes, ``unacked[dst]`` maps interval seq
+    → recorded payload (None for absolute payloads — a retransmit re-reads
+    the current lane, which subsumes), ``sent_a/sent_t`` are the
+    increment-mutation baseline."""
+
+    __slots__ = (
+        "slot", "n", "limit", "added", "taken", "admitted",
+        "dirty", "sent_a", "sent_t", "next_seq", "unacked",
+    )
 
     def __init__(self, slot: int, n: int, limit: int):
         self.slot = slot
@@ -115,6 +169,11 @@ class Node:
         self.added = [0] * n
         self.taken = [0] * n
         self.admitted = 0
+        self.dirty = False
+        self.sent_a = 0
+        self.sent_t = 0
+        self.next_seq = {j: 1 for j in range(n) if j != slot}
+        self.unacked = {j: {} for j in range(n) if j != slot}
 
     def state(self) -> Tuple[int, ...]:
         return tuple(self.added) + tuple(self.taken)
@@ -178,11 +237,16 @@ class _Violation(Exception):
 
 
 class Cluster:
-    """The model cluster: nodes + per-link in-flight packet lists."""
+    """The model cluster: nodes + per-link in-flight packet lists.
+    Packets are tagged: ``("full", lanes)`` is the v1 full-state
+    datagram; ``("delta", src, seq, lanes)`` is a wire-v2 delta interval
+    (delivery to a capable node acks it — the sender GCs the record;
+    loss leaves it unacked for the convergence procedure's retransmit)."""
 
     def __init__(self, n: int, limit: int, sem: Semantics):
         self.sem = sem
         self.nodes = [Node(i, n, limit) for i in range(n)]
+        self.caps = _caps(sem, n)
         # links[(src, dst)] = list of in-flight payloads, FIFO by append
         # but deliverable in any order (the reorder model).
         self.links: Dict[Tuple[int, int], List[tuple]] = {
@@ -196,10 +260,55 @@ class Cluster:
         node = self.nodes[i]
         node.take(self.sem)
         pkt = node.packet()
+        if self.caps[i]:
+            # Delta plane: the emission accumulates (dirty) for capable
+            # peers; v1 peers keep getting the classic full state now.
+            node.dirty = True
+            if pkt:
+                for j in range(len(self.nodes)):
+                    if j != i and not self.caps[j]:
+                        self.links[(i, j)].append(("full", pkt))
+            return
         if pkt:
             for j in range(len(self.nodes)):
                 if j != i:
-                    self.links[(i, j)].append(pkt)
+                    self.links[(i, j)].append(("full", pkt))
+
+    def _delta_payload(self, node: Node) -> tuple:
+        if self.sem.delta_payload == "increment":
+            return (
+                (
+                    node.slot,
+                    node.added[node.slot] - node.sent_a,
+                    node.taken[node.slot] - node.sent_t,
+                ),
+            )
+        return ((node.slot, node.added[node.slot], node.taken[node.slot]),)
+
+    def flush(self, i: int) -> None:
+        """Pack node i's dirty own-lane join-decomposition into one
+        sequenced interval per capable peer (the paced flusher event)."""
+        node = self.nodes[i]
+        if not self.caps[i] or not node.dirty:
+            return
+        payload = self._delta_payload(node)
+        for j in range(len(self.nodes)):
+            if j == i or not self.caps[j]:
+                continue
+            seq = node.next_seq[j]
+            node.next_seq[j] = seq + 1
+            if self.sem.delta_gc == "acked":
+                # Absolute payloads need no history: a retransmit re-reads
+                # the (monotone) current lane, which subsumes. Increments
+                # must be recorded verbatim.
+                node.unacked[j][seq] = (
+                    payload if self.sem.delta_payload == "increment" else None
+                )
+            self.links[(i, j)].append(("delta", i, seq, payload))
+        if self.sem.delta_payload == "increment":
+            node.sent_a = node.added[i]
+            node.sent_t = node.taken[i]
+        node.dirty = False
 
     def crosses_partition(self, i: int, j: int) -> bool:
         return (
@@ -212,19 +321,39 @@ class Cluster:
         reorder model). ``dup`` delivers without removing. A partitioned
         link DROPS the packet instead of delivering (UDP, not TCP: the
         datagram is gone, not queued — held-back delivery is modelled by
-        simply not choosing to deliver before heal)."""
+        simply not choosing to deliver before heal). A dropped delta
+        interval stays unacked at the sender."""
         q = self.links[(i, j)]
         pkt = q[idx]
         if not dup:
             q.pop(idx)
         if self.crosses_partition(i, j):
             return
-        self._merge_checked(j, pkt)
+        self._apply_packet(j, pkt)
 
-    def _merge_checked(self, j: int, pkt: tuple) -> None:
+    def _apply_packet(self, j: int, pkt: tuple, ack: bool = True) -> None:
+        if pkt[0] == "full":
+            self._merge_checked(j, pkt[1])
+            return
+        _, src, seq, payload = pkt
+        if not self.caps[j]:
+            return  # a v1 node ignores v2 datagrams (control-channel name)
+        if self.sem.delta_payload == "increment":
+            node = self.nodes[j]
+            for s, a, t in payload:
+                node.added[s] += a
+                node.taken[s] += t
+        else:
+            self._merge_checked(j, payload)
+        if ack and self.sem.delta_gc == "acked":
+            # Ack vector: the receiver acknowledges the interval seq and
+            # the sender garbage-collects its record.
+            self.nodes[src].unacked[j].pop(seq, None)
+
+    def _merge_checked(self, j: int, lanes: tuple) -> None:
         node = self.nodes[j]
         before = node.state()
-        node.merge(pkt, self.sem)
+        node.merge(lanes, self.sem)
         if not _ge(node.state(), before):
             raise _Violation(
                 "PTC002",
@@ -241,7 +370,7 @@ class Cluster:
                     q.clear()  # partition drops cross-side datagrams
                 continue
             while q:
-                self._merge_checked(j, q.pop(0))
+                self._apply_packet(j, q.pop(0))
 
     def set_partition(self, sides: Optional[Dict[int, int]]) -> None:
         self.partition = sides
@@ -251,22 +380,67 @@ class Cluster:
                 if self.crosses_partition(i, j):
                     q.clear()
 
+    def _converge_delta(self) -> None:
+        """The delta plane's own repair loop: flush dirty lanes and
+        retransmit every unacked interval (with current absolute values —
+        or the recorded increment) until the interval logs drain. This is
+        what must converge WITHOUT anti-entropy: steady-state loss is the
+        retransmit machinery's job, AE is only the heal-time backstop."""
+        for _ in range(4 * len(self.nodes) + 4):
+            moved = False
+            for i, node in enumerate(self.nodes):
+                if not self.caps[i]:
+                    continue
+                if node.dirty:
+                    self.flush(i)
+                    moved = True
+                for j in range(len(self.nodes)):
+                    if j == i or not self.caps[j]:
+                        continue
+                    pend = node.unacked[j]
+                    if not pend:
+                        continue
+                    moved = True
+                    for seq in list(pend):
+                        payload = pend.pop(seq)
+                        if payload is None:  # absolute: re-read, subsumes
+                            payload = self._delta_payload(node)
+                        seq2 = node.next_seq[j]
+                        node.next_seq[j] = seq2 + 1
+                        node.unacked[j][seq2] = (
+                            payload
+                            if self.sem.delta_payload == "increment"
+                            else None
+                        )
+                        self.links[(i, j)].append(("delta", i, seq2, payload))
+            inflight = any(q for q in self.links.values())
+            if not moved and not inflight:
+                return
+            self.deliver_all()
+
     def heal_and_converge(self) -> None:
-        """Heal + full delivery + pairwise anti-entropy (both directions,
-        every pair — the model of net/antientropy.py's digest+fetch)."""
+        """Heal + full delivery, then the wire-appropriate repair: the
+        delta plane's flush/retransmit loop for capable nodes, and
+        pairwise anti-entropy (the model of net/antientropy.py's
+        digest+fetch) for full and mixed clusters — pure-delta clusters
+        deliberately get NO resync, so a broken interval log cannot hide
+        behind AE."""
         self.set_partition(None)
         self.deliver_all()
         before = [n.state() for n in self.nodes]
-        for a, b in itertools.permutations(range(len(self.nodes)), 2):
-            node = self.nodes[b]
-            prev = node.state()
-            node.resync_from(self.nodes[a], self.sem)
-            if not _ge(node.state(), prev):
-                raise _Violation(
-                    "PTC002",
-                    f"anti-entropy resync shrank node {b}'s state "
-                    f"{prev} -> {node.state()}",
-                )
+        if any(self.caps):
+            self._converge_delta()
+        if self.sem.wire != "delta":
+            for a, b in itertools.permutations(range(len(self.nodes)), 2):
+                node = self.nodes[b]
+                prev = node.state()
+                node.resync_from(self.nodes[a], self.sem)
+                if not _ge(node.state(), prev):
+                    raise _Violation(
+                        "PTC002",
+                        f"anti-entropy resync shrank node {b}'s state "
+                        f"{prev} -> {node.state()}",
+                    )
         expect = _join(before)
         states = [n.state() for n in self.nodes]
         if any(s != states[0] for s in states):
@@ -319,6 +493,10 @@ def check_ap_bound(
             try:
                 for i in seq:
                     c.take(i)
+                    # Sync-within-side includes the delta flusher: a
+                    # capable node's take reaches its side's peers via
+                    # the flushed interval, not a per-take datagram.
+                    c.flush(i)
                     c.deliver_all(within_side_only=True)
                 admitted = sum(node.admitted for node in c.nodes)
                 if admitted > limit * sides:
@@ -352,7 +530,15 @@ def check_async_schedules(
 
     def _key(c: Cluster, takes_left: int, disrupt_left: int):
         return (
-            tuple(n.state() + (n.admitted,) for n in c.nodes),
+            tuple(
+                n.state()
+                + (n.admitted, n.dirty, n.sent_a, n.sent_t)
+                + tuple(
+                    (j, tuple(sorted(d.items())), n.next_seq[j])
+                    for j, d in sorted(n.unacked.items())
+                )
+                for n in c.nodes
+            ),
             tuple(
                 (lk, tuple(map(tuple, q))) for lk, q in sorted(c.links.items())
             ),
@@ -394,6 +580,10 @@ def check_async_schedules(
         moves = []
         if takes_left:
             moves += [("take", i) for i in range(len(c.nodes))]
+        # Delta plane: the paced flusher is its own schedulable event.
+        for i, node in enumerate(c.nodes):
+            if c.caps[i] and node.dirty:
+                moves.append(("flush", i))
         # Deliver the HEAD of each link (plus the tail when reordering is
         # possible) — delivering only head/tail spans the reorder space
         # for the 2-deep links these bounds produce.
@@ -412,6 +602,9 @@ def check_async_schedules(
                 if mv[0] == "take":
                     c2.take(mv[1])
                     dfs(c2, takes_left - 1, disrupt_left, depth - 1)
+                elif mv[0] == "flush":
+                    c2.flush(mv[1])
+                    dfs(c2, takes_left, disrupt_left, depth - 1)
                 elif mv[0] == "deliver":
                     c2.deliver(mv[1], mv[2], mv[3])
                     dfs(c2, takes_left, disrupt_left, depth - 1)
@@ -426,13 +619,23 @@ def check_async_schedules(
                 return
 
     root = Cluster(n_nodes, limit, sem)
-    dfs(root, takes, max_disruptions, depth=takes * 3 + max_disruptions + 4)
+    # Delta mode needs one flush event per take to put data on the wire.
+    extra = takes + 2 if any(root.caps) else 0
+    dfs(root, takes, max_disruptions, depth=takes * 3 + max_disruptions + 4 + extra)
     return explored, findings
 
 
 def _snapshot(c: Cluster):
     return (
-        [(list(n.added), list(n.taken), n.admitted) for n in c.nodes],
+        [
+            (
+                list(n.added), list(n.taken), n.admitted,
+                n.dirty, n.sent_a, n.sent_t,
+                {j: dict(d) for j, d in n.unacked.items()},
+                dict(n.next_seq),
+            )
+            for n in c.nodes
+        ],
         {k: list(v) for k, v in c.links.items()},
         None if c.partition is None else dict(c.partition),
     )
@@ -441,10 +644,15 @@ def _snapshot(c: Cluster):
 def _restore(template: Cluster, snap) -> Cluster:
     nodes, links, part = snap
     c = Cluster(len(template.nodes), template.nodes[0].limit, template.sem)
-    for node, (a, t, adm) in zip(c.nodes, nodes):
+    for node, (a, t, adm, dirty, sa, st_, unacked, seqs) in zip(c.nodes, nodes):
         node.added = list(a)
         node.taken = list(t)
         node.admitted = adm
+        node.dirty = dirty
+        node.sent_a = sa
+        node.sent_t = st_
+        node.unacked = {j: dict(d) for j, d in unacked.items()}
+        node.next_seq = dict(seqs)
     c.links = {k: list(v) for k, v in links.items()}
     c.partition = None if part is None else dict(part)
     return c
@@ -461,6 +669,7 @@ def check_idempotence(
         base = Cluster(n_nodes, limit, sem)
         for i in seq:
             base.take(i)
+            base.flush(i)  # delta mode: put the interval on the wire
         snap = _snapshot(base)
 
         def run(order, dup):
@@ -471,9 +680,9 @@ def check_idempotence(
                     if order == "reversed":
                         idxs = idxs[::-1]
                     for idx in idxs:
-                        c._merge_checked(j, q[idx])
+                        c._apply_packet(j, q[idx], ack=False)
                         if dup:
-                            c._merge_checked(j, q[idx])
+                            c._apply_packet(j, q[idx], ack=False)
                     q.clear()
             except _Violation as v:
                 findings.append(Finding(v.check, _SELF, 0, v.message))
@@ -521,9 +730,13 @@ def check_protocol(sem: Semantics = CLEAN) -> List[Finding]:
 
 
 def check_repo() -> List[Finding]:
-    """The stage-6 gate: the clean protocol must satisfy every invariant,
-    and every registered mutation must be rejected by at least one."""
+    """The stage-6 gate: the clean protocol — on the v1 full-state plane,
+    the wire-v2 delta plane, AND a mixed v1/v2 cluster — must satisfy
+    every invariant, and every registered mutation must be rejected by at
+    least one."""
     findings = list(check_protocol(CLEAN))
+    findings += check_protocol(CLEAN_DELTA)
+    findings += check_protocol(CLEAN_MIXED)
     for name, sem in MUTATIONS.items():
         caught = check_protocol(sem)
         if not caught:

@@ -1022,12 +1022,77 @@ def _model_pallas_interpret(
     return findings
 
 
+def _model_delta_roundtrip(
+    root: ProveRoot, fn: Callable, site: Tuple[str, int]
+) -> List[Finding]:
+    """Wire-v2 delta codec: PTP003 decode∘encode identity over a grid of
+    names/values/ack vectors, re-encode byte-stability, strict rejection
+    of every truncation, and single-byte-corruption detection — replicas
+    must either merge an interval exactly or not at all."""
+    from patrol_tpu.ops import wire
+
+    findings: List[Finding] = []
+
+    def bad(msg: str) -> None:
+        findings.append(Finding("PTP003", *site, f"[{root.name}] {msg}"))
+
+    big = (1 << 62) + 7
+    names = ["", "a", "bucket-µ≠ascii", "x" * 200]
+    vals = [0, 1, big]
+    entries = [
+        wire.DeltaEntry(n, s, c, a, t, e)
+        for n in names
+        for s in (0, 3)
+        for c, a, t, e in ((0, 0, 0, 0), (vals[2], 1, 2, 3), (5, big, big, big))
+    ]
+    cases = [
+        (0, (), ()),  # bare ack, empty vector
+        (1, (1, 2, 3), tuple(entries[:4])),
+        (0xFFFFFFFF, tuple(range(100, 132)), tuple(entries)),
+        (7, (), tuple(entries[:1])),
+    ]
+    for seq, acks, ents in cases:
+        pkt, n = fn(3, seq, acks, ents)
+        back = wire.decode_delta_packet(pkt)
+        if back is None:
+            bad(f"decode(encode(...)) rejected a legal interval (seq={seq})")
+            break
+        expect = wire.DeltaPacket(3, seq, tuple(acks)[:wire.DELTA_MAX_ACKS], tuple(ents[:n]))
+        if back != expect:
+            bad(
+                f"decode(encode(x)) != x at seq={seq}: interval round-trip "
+                "must be exact or replicas fork on relay"
+            )
+            break
+        repkt, _ = fn(back.sender_slot, back.seq, back.acks, back.entries)
+        if repkt != pkt:
+            bad(f"re-encode of a decoded interval is not byte-stable (seq={seq})")
+            break
+    if not findings:
+        pkt, n = fn(1, 9, (4, 5), tuple(entries[:6]))
+        for i in range(len(pkt)):
+            if wire.decode_delta_packet(pkt[:i]) is not None:
+                bad(f"truncation to {i} bytes decoded as a valid interval")
+                break
+        for i in range(len(pkt)):
+            flipped = bytearray(pkt)
+            flipped[i] ^= 0x41
+            # Envelope flips break the reserved-name check; body flips
+            # break the checksum: every single-byte corruption must be
+            # rejected whole (faultnet's corrupt schedules rely on it).
+            if wire.decode_delta_packet(bytes(flipped)) is not None:
+                bad(f"byte flip at offset {i} went undetected")
+                break
+    return findings
+
+
 _MODELS: Dict[str, Callable] = {
     "dense_join": _model_dense_join,
     "take_monotone": _model_take_monotone,
     "scalar_monotone": _model_scalar_monotone,
     "rate_algebra": _model_rate_algebra,
     "wire_roundtrip": _model_wire_roundtrip,
+    "delta_roundtrip": _model_delta_roundtrip,
     "pallas_interpret": _model_pallas_interpret,
 }
 # "join_batch:<adapter>" tags dispatch through the adapter registry the

@@ -62,11 +62,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--wire-mode",
-        choices=["aggregate", "compat"],
+        choices=["aggregate", "compat", "delta"],
         default="aggregate",
         help="outgoing replication wire form: dual-payload aggregate "
-        "headers (flag-day vs pre-lane-trailer builds) or compat raw "
-        "own-lane headers for rolling upgrades (see ops/wire.py)",
+        "headers (flag-day vs pre-lane-trailer builds), compat raw "
+        "own-lane headers for rolling upgrades, or delta-interval "
+        "batched datagrams to v2-capable peers with aggregate fallback "
+        "(see ops/wire.py and net/delta.py)",
     )
     p.add_argument(
         "--http-front",

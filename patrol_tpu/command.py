@@ -41,8 +41,10 @@ class Command:
     # "auto" = native when the toolchain built it, else asyncio.
     udp_backend: str = "auto"
     # Outgoing wire form: "aggregate" (dual-payload; flag-day upgrade from
-    # pre-lane-trailer patrol_tpu builds) or "compat" (raw own-lane headers
-    # for rolling upgrades). See ops/wire.py module docs.
+    # pre-lane-trailer patrol_tpu builds), "compat" (raw own-lane headers
+    # for rolling upgrades), or "delta" (wire-v2 batched delta-interval
+    # datagrams to capability-advertising peers, aggregate to the rest).
+    # See ops/wire.py module docs and net/delta.py.
     wire_mode: str = "aggregate"
     # HTTP front: "native" = C++ epoll front (net/native_http.py) — the
     # /take decision runs entirely in-process for host-resident buckets

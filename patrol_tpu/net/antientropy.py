@@ -172,6 +172,11 @@ class AntiEntropy:
         self._jobs: deque = deque()
         self._jobs_cap = 512
         self._last_trigger: Dict[Addr, float] = {}
+        # Buckets an in-flight push job is re-shipping per peer — the
+        # delta plane (net/delta.py) dedupes interval retransmits against
+        # this set so a mid-resync peer never receives the same bucket
+        # twice in one repair window.
+        self._inflight: Dict[Addr, frozenset] = {}
         self._refresh_timers: Dict[Addr, threading.Timer] = {}
         self._worker: Optional[threading.Thread] = None
         self._stopped = False
@@ -384,6 +389,13 @@ class AntiEntropy:
         _, _, snaps = self._snapshot_digests(names)
         self._push_states(list(snaps.items()), addr, self.max_packets_per_job)
 
+    def inflight_buckets(self, addr: Addr) -> frozenset:
+        """Bucket names an in-flight push job is currently re-shipping to
+        ``addr`` (empty when none). Read by the delta plane's retransmit
+        pass; never blocks."""
+        with self._mu:
+            return self._inflight.get(addr, frozenset())
+
     def _push_states(
         self, named_states: List[Tuple[str, list]], addr: Addr, budget: int
     ) -> None:
@@ -400,10 +412,17 @@ class AntiEntropy:
                 packets.append(wire.encode(st))
         with self._mu:
             self.resync_buckets += buckets
+            self._inflight[addr] = frozenset(
+                name for name, _ in named_states[:buckets]
+            )
         profiling.COUNTERS.inc("ae_resync_buckets", buckets)
         if len(packets) > budget:
             trace_mod.anomaly("convergence-budget-breach")
-        self._send_paced(packets[:budget], addr)
+        try:
+            self._send_paced(packets[:budget], addr)
+        finally:
+            with self._mu:
+                self._inflight.pop(addr, None)
 
     # -- lifecycle / observability -------------------------------------------
 

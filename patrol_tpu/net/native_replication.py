@@ -71,11 +71,12 @@ class NativeReplicator:
         self.node_addr = node_addr
         self.slots = slots
         self.log = log_ or log
-        if wire_mode not in ("aggregate", "compat"):
+        if wire_mode not in ("aggregate", "compat", "delta"):
             raise ValueError(f"unknown wire_mode {wire_mode!r}")
         # "aggregate" = dual-payload wire form (flag-day vs pre-lane-trailer
         # builds); "compat" = raw own-lane headers + base trailers for
-        # rolling upgrades. See ops/wire.py module docs.
+        # rolling upgrades; "delta" = batched delta-interval datagrams to
+        # v2-capable peers (net/delta.py). See ops/wire.py module docs.
         self.wire_mode = wire_mode
         # Unresolvable peers are health-tracked for re-resolution but
         # excluded from the fan-out arrays (inet_aton on a hostname would
@@ -102,6 +103,7 @@ class NativeReplicator:
         self.rx_packets = 0
         self.rx_errors = 0
         self.tx_packets = 0
+        self.tx_bytes = 0
         self.send_errors = 0
         # Fault injection: predicate (host, port)→bool; True drops traffic
         # to/from that peer (partition simulation). Settable at runtime.
@@ -111,8 +113,18 @@ class NativeReplicator:
         # vectorized batch path resumes the moment it is detached).
         self.faultnet = None
         from patrol_tpu.net.antientropy import AntiEntropy
+        from patrol_tpu.net.delta import DeltaPlane
 
         self.antientropy = AntiEntropy(self)
+        # The recvmmsg rx ring is PACKET-sized rows: this backend can only
+        # RECEIVE v1-sized delta datagrams, and its (1, 256) unicast
+        # staging bounds tx the same way — both advertised/handled by the
+        # plane, so asyncio peers never send us what we would truncate.
+        self.delta = DeltaPlane(
+            self, tx_mtu=native.PACKET, rx_mtu=native.PACKET
+        )
+        if self.wire_mode == "delta":
+            self.delta.start()
         self._probe_bytes = wire.encode(
             wire.WireState(name=PROBE_NAME, added=0.0, taken=0.0, elapsed_ns=0)
         )
@@ -212,6 +224,7 @@ class NativeReplicator:
                     healed = self.health.on_rx(addr)
                     if healed is not None:
                         self.antientropy.trigger(healed)
+                        self.delta.on_peer_heal(healed)
             # Incast requests (zero-state packets, repo.go:86-90).
             inc = (
                 live
@@ -276,10 +289,16 @@ class NativeReplicator:
                         "utf-8", "surrogateescape"
                     )
                     if name.startswith(CTRL_PREFIX):
-                        # Probe pings / anti-entropy: never a bucket.
-                        self._handle_control(
-                            name, (_u32_to_ip(int(ips[i])), int(ports[i]))
-                        )
+                        addr_i = (_u32_to_ip(int(ips[i])), int(ports[i]))
+                        if name == wire.DELTA_CHANNEL_NAME:
+                            # v2 delta interval: payload rides after the
+                            # reserved name in the raw datagram bytes.
+                            self.delta.on_packet(
+                                bytes(packets[i][: sizes[i]]), addr_i
+                            )
+                        else:
+                            # Probe pings / anti-entropy: never a bucket.
+                            self._handle_control(name, addr_i)
                         continue
                     incasts.append(
                         (
@@ -316,7 +335,11 @@ class NativeReplicator:
         healed = self.health.on_rx(addr)
         if healed is not None:
             self.antientropy.trigger(healed)
+            self.delta.on_peer_heal(healed)
         if state.is_zero() and state.name.startswith(CTRL_PREFIX):
+            if state.name == wire.DELTA_CHANNEL_NAME:
+                self.delta.on_packet(data, addr)
+                return
             self._handle_control(state.name, addr)
             return
         if self.repo is None:
@@ -357,6 +380,8 @@ class NativeReplicator:
                 self.unicast(self._probe_ack_bytes, addr)
         elif name == PROBE_ACK_NAME:
             pass  # on_rx already refreshed liveness
+        elif self.delta is not None and self.delta.handle_control(name, addr):
+            pass  # v2 capability advert/ack (net/delta.py)
         elif self.antientropy is not None:
             self.antientropy.handle(name, addr)
 
@@ -439,12 +464,14 @@ class NativeReplicator:
         pkts = np.zeros((1, 256), np.uint8)
         pkts[0, :n] = np.frombuffer(data, np.uint8)
         try:
-            self.tx_packets += self.sock.send_fanout(
+            sent = self.sock.send_fanout(
                 pkts,
                 np.array([n], np.int32),
                 np.array([_ip_to_u32(addr[0])], np.uint32),
                 np.array([int(addr[1])], np.uint16),
             )
+            self.tx_packets += sent
+            self.tx_bytes += n * sent
         except OSError:
             self.send_errors += 1
 
@@ -498,13 +525,49 @@ class NativeReplicator:
 
     def broadcast_states(self, states: Sequence[wire.WireState]) -> None:
         """Full-state broadcast to every peer (repo.go:123-158); one
-        sendmmsg per ≤1024-datagram chunk. Runs on the caller's thread."""
+        sendmmsg per ≤1024-datagram chunk. Runs on the caller's thread.
+        In delta mode the emission splits like the asyncio backend's:
+        delta-able states accumulate for v2-capable peers, classic
+        datagrams go to the rest."""
         if not len(self._endpoints[0]) or not states:
             return
+        if self.delta is not None and self.delta.tx_enabled:
+            classic_addrs, leftover = self.delta.offer(states)
+            classic = set(classic_addrs)
+            if classic:
+                self._fanout_states(
+                    states, [a for a in self.peers if a in classic]
+                )
+            if leftover:
+                capable = [a for a in self.peers if a not in classic]
+                if capable:
+                    self._fanout_states(leftover, capable)
+            return
+        self._fanout_states(states, None)
+
+    def _fanout_states(
+        self,
+        states: Sequence[wire.WireState],
+        addrs: Optional[List[Tuple[str, int]]],
+    ) -> None:
+        """Encode + sendmmsg ``states`` to ``addrs`` (None = every live
+        peer)."""
         pkts, sizes = self._encode_states(states)
-        ips, ports = self._live_peers()
+        if addrs is None:
+            ips, ports = self._live_peers()
+        else:
+            if self.drop_addr is not None:
+                addrs = [a for a in addrs if not self.drop_addr(a)]
+            ips = np.array([_ip_to_u32(h) for h, _ in addrs], np.uint32)
+            ports = np.array([p for _, p in addrs], np.uint16)
         if len(ips):
-            self.tx_packets += self.sock.send_fanout(pkts, sizes, ips, ports)
+            sent = self.sock.send_fanout(pkts, sizes, ips, ports)
+            self.tx_packets += sent
+            self.tx_bytes += int(np.maximum(sizes, 0).sum()) * len(ips)
+            profiling.COUNTERS.inc("replication_tx_packets", sent)
+            profiling.COUNTERS.inc(
+                "replication_tx_bytes", int(np.maximum(sizes, 0).sum()) * len(ips)
+            )
             tr = trace_mod.TRACE
             if tr.enabled:
                 tr.record(
@@ -553,6 +616,8 @@ class NativeReplicator:
 
     def close(self) -> None:
         self._stopped.set()
+        if self.delta is not None:
+            self.delta.close()
         if self.antientropy is not None:
             self.antientropy.close()
         self._rx_thread.join(timeout=2)
@@ -563,6 +628,7 @@ class NativeReplicator:
             "replication_rx_packets": self.rx_packets,
             "replication_rx_errors": self.rx_errors,
             "replication_tx_packets": self.tx_packets,
+            "replication_tx_bytes": self.tx_bytes,
             "replication_send_errors": self.send_errors,
             "replication_peers": len(self.peers),
             "replication_incast_suppressed": self.reply_gate.suppressed,
@@ -570,6 +636,8 @@ class NativeReplicator:
             "faultnet_active": int(self.faultnet.active) if self.faultnet else 0,
         }
         out.update(self.health.stats())
+        if self.delta is not None:
+            out.update(self.delta.stats())
         if self.antientropy is not None:
             out.update(self.antientropy.stats())
         if self.faultnet is not None:

@@ -413,7 +413,10 @@ class Replicator(asyncio.DatagramProtocol):
     ``"aggregate"`` (default) sends the dual-payload form — flag-day
     upgrade from pre-lane-trailer patrol_tpu builds; ``"compat"`` sends
     raw own-lane headers + base trailers every build can parse, for
-    rolling upgrades."""
+    rolling upgrades; ``"delta"`` ships batched delta-interval datagrams
+    (net/delta.py) to peers that advertised the v2 capability and the
+    aggregate form to everyone else. Receiving deltas is unconditional —
+    any build with the delta plane accepts them in every mode."""
 
     def __init__(
         self,
@@ -426,7 +429,7 @@ class Replicator(asyncio.DatagramProtocol):
         self.node_addr = node_addr
         self.slots = slots
         self.log = log
-        if wire_mode not in ("aggregate", "compat"):
+        if wire_mode not in ("aggregate", "compat", "delta"):
             raise ValueError(f"unknown wire_mode {wire_mode!r}")
         self.wire_mode = wire_mode
         self.transport: Optional[asyncio.DatagramTransport] = None
@@ -436,6 +439,7 @@ class Replicator(asyncio.DatagramProtocol):
         self.rx_packets = 0
         self.rx_errors = 0
         self.tx_packets = 0
+        self.tx_bytes = 0
         self.send_errors = 0  # OSErrors surfaced by the transport
         # Self-filtering peer list (repo.go:36-41); unresolvable peers are
         # health-tracked for re-resolution but EXCLUDED from the fan-out —
@@ -461,8 +465,14 @@ class Replicator(asyncio.DatagramProtocol):
         # received datagram when set. Settable at runtime.
         self.faultnet = None
         from patrol_tpu.net.antientropy import AntiEntropy
+        from patrol_tpu.net.delta import DeltaPlane
 
         self.antientropy = AntiEntropy(self)
+        # Wire-v2 delta-interval plane (net/delta.py): tx gated on
+        # wire_mode == "delta" + per-peer capability; rx always on.
+        self.delta = DeltaPlane(self)
+        if self.wire_mode == "delta":
+            self.delta.start()
         self._health_task: Optional[asyncio.Task] = None
         self._health_tick_s = 0.1
         self._probe_bytes = wire.encode(
@@ -551,6 +561,8 @@ class Replicator(asyncio.DatagramProtocol):
                 self._send(self._probe_ack_bytes, addr)
         elif name == PROBE_ACK_NAME:
             pass  # on_rx already refreshed liveness
+        elif self.delta is not None and self.delta.handle_control(name, addr):
+            pass  # v2 capability advert/ack (net/delta.py)
         elif self.antientropy is not None:
             self.antientropy.handle(name, addr)
 
@@ -588,11 +600,21 @@ class Replicator(asyncio.DatagramProtocol):
                 state.name, t0, dur,
             )
         healed = self.health.on_rx(addr)
-        if healed is not None and self.antientropy is not None:
-            # Peer (re)joined or a partition healed: reconcile divergent
-            # buckets by digest instead of waiting for organic takes.
-            self.antientropy.trigger(healed)
+        if healed is not None:
+            if self.antientropy is not None:
+                # Peer (re)joined or a partition healed: reconcile divergent
+                # buckets by digest instead of waiting for organic takes.
+                self.antientropy.trigger(healed)
+            if self.delta is not None:
+                # Pending delta intervals toward a healed peer are stale;
+                # full-state repair (anti-entropy) takes over.
+                self.delta.on_peer_heal(healed)
         if state.is_zero() and state.name.startswith(CTRL_PREFIX):
+            if state.name == wire.DELTA_CHANNEL_NAME and self.delta is not None:
+                # v2 delta-interval datagram: the payload rides AFTER the
+                # reserved name, invisible to the v1 decode above.
+                self.delta.on_packet(data, addr)
+                return
             self._handle_control(state.name, addr)
             return
         if self.repo is None:
@@ -683,20 +705,29 @@ class Replicator(asyncio.DatagramProtocol):
                 self.send_errors += 1
                 return
             self.tx_packets += 1
+            self.tx_bytes += len(data)
 
     def unicast(self, data: bytes, addr: Addr) -> None:
         """Thread-safe single-datagram send (anti-entropy worker)."""
         if self.loop is not None:
             self.loop.call_soon_threadsafe(self._send, data, addr)
 
-    def _broadcast_now(self, payloads: List[bytes]) -> None:
+    def _broadcast_now(self, payloads: List[bytes], addrs: Optional[List[Addr]] = None) -> None:
+        targets = self.peers if addrs is None else addrs
         for data in payloads:
-            for peer in self.peers:
+            for peer in targets:
                 self._send(data, peer)
+        if payloads and targets:
+            profiling.COUNTERS.inc(
+                "replication_tx_packets", len(payloads) * len(targets)
+            )
+            profiling.COUNTERS.inc(
+                "replication_tx_bytes", sum(map(len, payloads)) * len(targets)
+            )
         tr = trace_mod.TRACE
-        if tr.enabled and payloads and self.peers:
+        if tr.enabled and payloads and targets:
             tr.record(
-                trace_mod.EV_BROADCAST_TX, 0, len(payloads) * len(self.peers)
+                trace_mod.EV_BROADCAST_TX, 0, len(payloads) * len(targets)
             )
 
     def _payload_bytes(self, st: wire.WireState) -> bytes:
@@ -721,8 +752,28 @@ class Replicator(asyncio.DatagramProtocol):
     def broadcast_states(self, states: Sequence[wire.WireState]) -> None:
         """Thread-safe broadcast of full bucket states to every peer —
         callable from the engine thread (the reference broadcasts from the
-        request goroutine, repo.go:129-158)."""
+        request goroutine, repo.go:129-158). In delta mode the emission is
+        split: delta-able states accumulate in the per-peer delta buffers
+        for v2-capable peers (shipped batched by the paced flusher) and
+        only the remaining peers/states get classic per-state datagrams."""
         if not self.peers:
+            return
+        if self.delta is not None and self.delta.tx_enabled:
+            classic_addrs, leftover = self.delta.offer(states)
+            if self.loop is None:
+                return
+            if classic_addrs:
+                payloads = [self._payload_bytes(st) for st in states]
+                self.loop.call_soon_threadsafe(
+                    self._broadcast_now, payloads, classic_addrs
+                )
+            if leftover:
+                capable = [a for a in self.peers if a not in classic_addrs]
+                if capable:
+                    payloads = [self._payload_bytes(st) for st in leftover]
+                    self.loop.call_soon_threadsafe(
+                        self._broadcast_now, payloads, capable
+                    )
             return
         payloads = [self._payload_bytes(st) for st in states]
         if self.loop is not None:
@@ -752,6 +803,8 @@ class Replicator(asyncio.DatagramProtocol):
         if self._health_task is not None:
             self._health_task.cancel()
             self._health_task = None
+        if self.delta is not None:
+            self.delta.close()
         if self.antientropy is not None:
             self.antientropy.close()
         if self.transport is not None:
@@ -762,12 +815,15 @@ class Replicator(asyncio.DatagramProtocol):
             "replication_rx_packets": self.rx_packets,
             "replication_rx_errors": self.rx_errors,
             "replication_tx_packets": self.tx_packets,
+            "replication_tx_bytes": self.tx_bytes,
             "replication_send_errors": self.send_errors,
             "replication_peers": len(self.peers),
             "replication_incast_suppressed": self.reply_gate.suppressed,
             "faultnet_active": int(self.faultnet.active) if self.faultnet else 0,
         }
         out.update(self.health.stats())
+        if self.delta is not None:
+            out.update(self.delta.stats())
         if self.antientropy is not None:
             out.update(self.antientropy.stats())
         if self.faultnet is not None:

@@ -1,0 +1,57 @@
+"""Delta-interval fold kernel — the device half of the wire-v2 data plane.
+
+One decoded delta datagram (ops/wire.py ``DeltaPacket``) carries hundreds
+of bucket join-decompositions: absolute PN-lane values, monotone by
+construction. :func:`delta_fold` joins a whole interval into state in ONE
+scatter-max dispatch — the rx path the device-commit pipeline wants:
+wire bytes become a single batched plane commit instead of hundreds of
+queued per-delta objects (engine.ingest_interval).
+
+Algebra: identical lattice join as ops/merge.py (elementwise int64 max),
+so every PTP obligation holds bit-exactly; registered in
+``ops/obligations.py::PROVE_ROOTS`` with the full PTP001-005 set. The only
+structural difference from ``merge_batch`` is ``mode="drop"`` with the
+shared ``FOLD_PAD_ROW`` sentinel: intervals arrive in arbitrary sizes, and
+padding to the power-of-two shape class with out-of-bounds sentinel rows
+(dropped by XLA, never merged) bounds the compiled-variant count without a
+host-side compaction pass.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from patrol_tpu.models.limiter import LimiterState
+from patrol_tpu.ops.merge import FOLD_PAD_ROW  # noqa: F401  (re-export: the
+# sentinel contract is shared with the tick fold and the commit ring)
+
+
+class DeltaBatch(NamedTuple):
+    """K decoded delta-interval entries. Padding entries carry
+    ``FOLD_PAD_ROW`` (out of bounds ⇒ dropped by ``mode="drop"``); live
+    entries are non-negative absolute lane values (the decode guard
+    rejects bit-63 wire values, ingest clamps the rest)."""
+
+    rows: jax.Array  # int32[K]; FOLD_PAD_ROW marks padding
+    slots: jax.Array  # int32[K] origin node lane
+    added_nt: jax.Array  # int64[K] absolute own-lane PN values
+    taken_nt: jax.Array  # int64[K]
+    elapsed_ns: jax.Array  # int64[K]
+
+
+def delta_fold(state: LimiterState, batch: DeltaBatch) -> LimiterState:
+    """Join one delta interval into state: scatter-max of K (row, slot)
+    lane pairs plus the per-row elapsed max. Duplicate keys in one
+    interval are fine (max is commutative/associative/idempotent — the
+    same argument as ``merge_batch``); sentinel rows are dropped."""
+    pair = jnp.stack([batch.added_nt, batch.taken_nt], axis=-1)
+    pn = state.pn.at[batch.rows, batch.slots].max(pair, mode="drop")
+    elapsed = state.elapsed.at[batch.rows].max(batch.elapsed_ns, mode="drop")
+    return LimiterState(pn=pn, elapsed=elapsed)
+
+
+delta_fold_jit = partial(jax.jit, donate_argnums=0)(delta_fold)

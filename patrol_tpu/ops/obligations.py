@@ -37,6 +37,7 @@ from patrol_tpu.analysis.abi import AbiObligation
 from patrol_tpu.analysis.prove import JOIN_BATCH_ADAPTERS, ProveRoot, Trace
 from patrol_tpu.models.limiter import LimiterState
 from patrol_tpu.ops.commit import CommitBlocks
+from patrol_tpu.ops.delta import DeltaBatch
 from patrol_tpu.ops.merge import FoldedMergeBatch, MergeBatch, RowDenseBatch
 
 _S = jax.ShapeDtypeStruct
@@ -102,6 +103,17 @@ def _trace_commit_blocks(fn) -> Trace:
         elapsed_ns=_mat(jnp.int64),
     )
     return _mk_trace(fn, _state(), blocks)
+
+
+def _trace_delta_fold(fn) -> Trace:
+    batch = DeltaBatch(
+        rows=_vec(jnp.int32),
+        slots=_vec(jnp.int32),
+        added_nt=_vec(jnp.int64),
+        taken_nt=_vec(jnp.int64),
+        elapsed_ns=_vec(jnp.int64),
+    )
+    return _mk_trace(fn, _state(), batch)
 
 
 def _trace_merge_rows_dense(fn) -> Trace:
@@ -188,6 +200,16 @@ def _as_commit_blocks(d) -> CommitBlocks:
     )
 
 
+def _as_delta_batch(d) -> DeltaBatch:
+    return DeltaBatch(
+        rows=d[0].astype(jnp.int32)[None],
+        slots=d[1].astype(jnp.int32)[None],
+        added_nt=d[2][None],
+        taken_nt=d[3][None],
+        elapsed_ns=d[4][None],
+    )
+
+
 def _as_rows_dense_batch(d) -> RowDenseBatch:
     # One-hot lane window: the delta's (added, taken) in its slot, zeros —
     # the join identity on the non-negative domain — everywhere else.
@@ -204,6 +226,7 @@ JOIN_BATCH_ADAPTERS.update(
     folded=_as_folded_batch,
     rows_dense=_as_rows_dense_batch,
     commit_blocks=_as_commit_blocks,
+    delta_fold=_as_delta_batch,
 )
 
 _ALL = ("PTP001", "PTP002", "PTP003", "PTP004", "PTP005")
@@ -228,6 +251,11 @@ PROVE_ROOTS: Tuple[ProveRoot, ...] = (
         "ops.commit.commit_blocks", "patrol_tpu.ops.commit",
         "commit_blocks", _ALL, structural="join",
         model="join_batch:commit_blocks", tracer=_trace_commit_blocks,
+    ),
+    ProveRoot(
+        "ops.delta.delta_fold", "patrol_tpu.ops.delta", "delta_fold",
+        _ALL, structural="join", model="join_batch:delta_fold",
+        tracer=_trace_delta_fold,
     ),
     ProveRoot(
         "ops.merge.merge_dense", "patrol_tpu.ops.merge", "merge_dense",
@@ -256,6 +284,10 @@ PROVE_ROOTS: Tuple[ProveRoot, ...] = (
     ProveRoot(
         "ops.wire.codec", "patrol_tpu.ops.wire", "encode",
         ("PTP003",), model="wire_roundtrip",
+    ),
+    ProveRoot(
+        "ops.wire.delta_codec", "patrol_tpu.ops.wire", "encode_delta_packet",
+        ("PTP003",), model="delta_roundtrip",
     ),
     ProveRoot(
         "ops.pallas_merge.merge_batch_pallas", "patrol_tpu.ops.pallas_merge",

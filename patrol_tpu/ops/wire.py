@@ -80,6 +80,44 @@ is the conversion boundary. float64 represents integers exactly up to 2^53,
 i.e. ~9.0e6 tokens at nanotoken resolution; beyond that the wire value is
 rounded (observable semantics are preserved within float64's own precision,
 which is all the reference ever had).
+
+**Wire protocol v2: delta-interval datagrams** (Almeida et al.,
+arXiv:1410.2803; ROADMAP item 3). The per-take full-state packet above
+ships ONE bucket per ≤256-B datagram. The delta plane instead ships
+*join-decompositions*: each entry is one bucket's absolute PN-lane values
+(cap base, lane added/taken, elapsed) — absolute monotone values, so an
+entry IS its own join-decomposition: delivering it twice, late, or out of
+order is a no-op under the lattice max. Hundreds of entries pack into one
+datagram under this framing:
+
+====  ======  ====================================================
+off   size    field
+====  ======  ====================================================
+0     24      zeros (v1 header: added=0, taken=0, elapsed=0)
+24    1       L = len(DELTA_CHANNEL_NAME) (= 7)
+25    L       ``\\x00pt!dv2`` — the reserved control-channel name
+25+L  1       version (= 2)
++1    2       sender_slot (u16, the sender's PN lane)
++3    4       seq (u32 interval number; 0 = bare ack, no payload)
++7    1       K = ack-vector length (≤ 32)
++8    4×K     ack vector: interval seqs received from the DESTINATION
++..   2       N = entry count
++..   ...     N × entry: u8 name_len | name | u16 slot |
+              u64 cap_nt | u64 added_nt | u64 taken_nt | u64 elapsed
+last  1       checksum (sum of payload bytes mod 256)
+====  ======  ====================================================
+
+The first 25+L bytes make the datagram a *v1 zero-state packet for a
+reserved name*: a reference node reads it as an incast request for a
+bucket that cannot exist (the API rejects NUL-led names), misses, and
+stays silent; pre-delta patrol builds dispatch it to the control channel
+and ignore the unknown name. Both ignore the payload because every v1
+decoder reads exactly ``data[25:25+L]`` — the same invisibility argument
+as the P2 trailer. Validation is all-or-nothing (version, checksum,
+entry bounds, bit-63 guards): a truncated or mangled delta datagram is
+rejected whole, never partially merged. Senders ship deltas only to
+peers that advertised the capability (and their receive size) on the
+control channel — see net/delta.py.
 """
 
 from __future__ import annotations
@@ -480,3 +518,170 @@ def pack_multi(states: Sequence[WireState]) -> List[WireState]:
             )
         )
     return out
+
+
+# ---------------------------------------------------------------------------
+# Wire protocol v2: delta-interval datagrams (framing in the module docs).
+
+# Rides the reserved control-channel namespace (net/replication.CTRL_PREFIX):
+# no legal bucket name starts with NUL, so v1 peers read a delta datagram as
+# an incast request for an impossible bucket and stay silent.
+DELTA_CHANNEL_NAME = "\x00pt!dv2"
+_DELTA_NAME_BYTES = DELTA_CHANNEL_NAME.encode()
+_DELTA_BASE = FIXED_SIZE + len(_DELTA_NAME_BYTES)  # payload offset (32)
+# Default delta datagram bound. Deliberately larger than the v1 PACKET_SIZE:
+# the 256-B bound exists so per-take datagrams never fragment; the delta
+# plane is paced and batched, and datacenter paths (and loopback) carry
+# multi-KB UDP fine. Each peer advertises its own receive bound on the
+# control channel (the native recvmmsg backend can only take PACKET_SIZE),
+# and senders pack to min(own tx bound, peer's advertised rx bound).
+DELTA_PACKET_SIZE = 8192
+DELTA_VERSION = 2
+DELTA_MAX_ACKS = 32  # ack-vector entries per datagram
+_DELTA_HEAD = struct.Struct(">BHIB")  # version | sender_slot | seq | n_acks
+_DELTA_ACK = struct.Struct(">I")
+_DELTA_COUNT = struct.Struct(">H")
+_DELTA_ENTRY = struct.Struct(">HQQQQ")  # slot | cap | added | taken | elapsed
+
+
+@dataclasses.dataclass(frozen=True)
+class DeltaEntry:
+    """One bucket's join-decomposition: the ABSOLUTE values of one PN lane
+    (plus cap base and the elapsed G-counter). Monotone, so shipping the
+    current value subsumes every earlier interval — retransmits re-read
+    state instead of replaying history."""
+
+    name: str
+    slot: int
+    cap_nt: int
+    added_nt: int
+    taken_nt: int
+    elapsed_ns: int
+
+
+@dataclasses.dataclass(frozen=True)
+class DeltaPacket:
+    sender_slot: int
+    seq: int  # 0 = bare ack (no payload interval)
+    acks: Tuple[int, ...]  # interval seqs received from the destination
+    entries: Tuple[DeltaEntry, ...]
+
+
+def delta_entry_size(name: str) -> int:
+    return 1 + len(name.encode("utf-8", errors="surrogateescape")) + _DELTA_ENTRY.size
+
+
+def delta_capacity(max_size: int, name_len: int) -> int:
+    """How many entries of a given name length fit one delta datagram."""
+    room = max_size - _DELTA_BASE - _DELTA_HEAD.size - _DELTA_COUNT.size - 1
+    return max(0, room // (1 + name_len + _DELTA_ENTRY.size))
+
+
+def encode_delta_packet(
+    sender_slot: int,
+    seq: int,
+    acks: Sequence[int],
+    entries: Sequence[DeltaEntry],
+    max_size: int = DELTA_PACKET_SIZE,
+) -> Tuple[bytes, int]:
+    """Pack ``acks`` (≤ 32 kept) and as many ``entries`` as fit under
+    ``max_size`` → (datagram, number of entries packed). The caller loops
+    with fresh seqs for the remainder. ``seq=0`` with no entries is a bare
+    ack. Values are clamped non-negative (the bit-63 decode guard is the
+    receiving side's contract)."""
+    envelope = bytearray(_DELTA_BASE)
+    envelope[24] = len(_DELTA_NAME_BYTES)
+    envelope[FIXED_SIZE:] = _DELTA_NAME_BYTES
+    acks = list(acks)[:DELTA_MAX_ACKS]
+    body = bytearray(
+        _DELTA_HEAD.pack(
+            DELTA_VERSION, sender_slot & 0xFFFF, seq & 0xFFFFFFFF, len(acks)
+        )
+    )
+    for a in acks:
+        body += _DELTA_ACK.pack(a & 0xFFFFFFFF)
+    count_off = len(body)
+    body += _DELTA_COUNT.pack(0)
+    budget = max_size - _DELTA_BASE - len(body) - 1  # −1 checksum
+    packed = 0
+    for e in entries:
+        nb = e.name.encode("utf-8", errors="surrogateescape")
+        if len(nb) > 255:
+            raise NameTooLargeError(255)
+        sz = 1 + len(nb) + _DELTA_ENTRY.size
+        if sz > budget or packed >= 0xFFFF:
+            break
+        body.append(len(nb))
+        body += nb
+        body += _DELTA_ENTRY.pack(
+            e.slot & 0xFFFF,
+            min(max(e.cap_nt, 0), _INT64_MAX),
+            min(max(e.added_nt, 0), _INT64_MAX),
+            min(max(e.taken_nt, 0), _INT64_MAX),
+            min(max(e.elapsed_ns, 0), _INT64_MAX),
+        )
+        budget -= sz
+        packed += 1
+    _DELTA_COUNT.pack_into(body, count_off, packed)
+    body.append(sum(body) & 0xFF)
+    return bytes(envelope) + bytes(body), packed
+
+
+def decode_delta_packet(data: bytes) -> Optional[DeltaPacket]:
+    """Strict all-or-nothing decode of a v2 delta datagram; ``None`` for
+    anything malformed (wrong envelope, bad version/checksum, truncated or
+    overlong body, out-of-range values) — a hostile or corrupted datagram
+    must never be partially merged."""
+    end = len(data) - 1
+    if end < _DELTA_BASE + _DELTA_HEAD.size + _DELTA_COUNT.size:
+        return None
+    if (
+        data[:24] != b"\x00" * 24
+        or data[24] != len(_DELTA_NAME_BYTES)
+        or data[FIXED_SIZE:_DELTA_BASE] != _DELTA_NAME_BYTES
+    ):
+        return None
+    if data[end] != sum(data[_DELTA_BASE:end]) & 0xFF:
+        return None
+    version, sender_slot, seq, n_acks = _DELTA_HEAD.unpack_from(data, _DELTA_BASE)
+    if version != DELTA_VERSION or n_acks > DELTA_MAX_ACKS:
+        return None
+    off = _DELTA_BASE + _DELTA_HEAD.size
+    if off + n_acks * _DELTA_ACK.size + _DELTA_COUNT.size > end:
+        return None
+    acks = tuple(
+        _DELTA_ACK.unpack_from(data, off + i * _DELTA_ACK.size)[0]
+        for i in range(n_acks)
+    )
+    off += n_acks * _DELTA_ACK.size
+    (count,) = _DELTA_COUNT.unpack_from(data, off)
+    off += _DELTA_COUNT.size
+    entries = []
+    for _ in range(count):
+        if off >= end:
+            return None
+        name_len = data[off]
+        off += 1
+        if off + name_len + _DELTA_ENTRY.size > end:
+            return None
+        name = data[off : off + name_len].decode("utf-8", errors="surrogateescape")
+        off += name_len
+        slot, cap, added, taken, elapsed = _DELTA_ENTRY.unpack_from(data, off)
+        off += _DELTA_ENTRY.size
+        if max(cap, added, taken, elapsed) > _INT64_MAX:
+            return None
+        entries.append(DeltaEntry(name, slot, cap, added, taken, elapsed))
+    if off != end:
+        return None  # trailing garbage ⇒ reject whole, like the P2 trailers
+    return DeltaPacket(sender_slot, seq, acks, tuple(entries))
+
+
+def is_delta_packet(data: bytes) -> bool:
+    """Cheap envelope test — routes rx traffic to the delta decoder before
+    the generic control-channel dispatch."""
+    return (
+        len(data) > _DELTA_BASE
+        and data[24] == len(_DELTA_NAME_BYTES)
+        and data[FIXED_SIZE:_DELTA_BASE] == _DELTA_NAME_BYTES
+        and data[:24] == b"\x00" * 24
+    )

@@ -225,7 +225,15 @@ class CounterRegistry:
       the graceful-shutdown flush (command.py);
     * ``trace_anomaly_snapshots`` / ``trace_take_samples`` — patrol-scope
       flight-recorder anomaly snapshots taken and takes tagged with a
-      cross-node trace id (utils/trace.py).
+      cross-node trace id (utils/trace.py);
+    * ``replication_tx_packets`` / ``replication_tx_bytes`` — datagrams
+      and bytes the replication send paths put on the wire (both
+      backends' broadcast fan-outs);
+    * ``wire_deltas_batched`` / ``wire_interval_retransmits`` /
+      ``wire_fullstate_fallbacks`` — wire-v2 delta plane (net/delta.py):
+      bucket join-decompositions packed into delta-interval datagrams,
+      expired intervals re-shipped, and peers dropped back to full-state
+      repair (anti-entropy) after ack loss or heal.
 
     Monotonic counts + high-water gauges only; all call sites are
     per-tick/per-batch (kHz), so one mutex is noise-level overhead.
@@ -249,6 +257,11 @@ class CounterRegistry:
         "shutdown_flush_states",
         "trace_anomaly_snapshots",
         "trace_take_samples",
+        "replication_tx_packets",
+        "replication_tx_bytes",
+        "wire_deltas_batched",
+        "wire_interval_retransmits",
+        "wire_fullstate_fallbacks",
     )
 
     def __init__(self):

@@ -65,6 +65,9 @@ EV_BROADCAST_TX = 9  # replication broadcast fan-out (arg = datagrams)
 EV_AE_PHASE = 10  # anti-entropy job (arg = phase code, see AE_PHASES)
 EV_TAKE = 11  # one served take (sampled)
 EV_ANOMALY = 12  # anomaly marker (snapshot trigger)
+EV_DELTA_PACK = 13  # delta-plane flush: intervals packed (arg = datagrams)
+EV_DELTA_ACK = 14  # delta ack vector sent/processed (arg = acks)
+EV_DELTA_RETRANSMIT = 15  # expired intervals re-shipped (arg = intervals)
 
 EVENT_NAMES = {
     EV_TICK: "engine.tick",
@@ -79,6 +82,9 @@ EVENT_NAMES = {
     EV_AE_PHASE: "ae.phase",
     EV_TAKE: "take",
     EV_ANOMALY: "anomaly",
+    EV_DELTA_PACK: "delta.pack",
+    EV_DELTA_ACK: "delta.ack",
+    EV_DELTA_RETRANSMIT: "delta.retransmit",
 }
 
 AE_PHASES = {"trigger": 1, "digest": 2, "fetch": 3}

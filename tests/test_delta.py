@@ -1,0 +1,698 @@
+"""Wire protocol v2: delta-interval data plane (ops/wire.py framing,
+net/delta.py plane, engine.ingest_interval fold).
+
+Coverage, per the delta-plane contract:
+
+* codec — exact roundtrip, bare acks, max-pack boundary, strict
+  rejection of every truncation / single-byte corruption / trailing
+  garbage / bit-63 value, seeded hostile-bytes fuzz;
+* plane — capability handshake on the control channel, dirty
+  accumulation + packing, ack-vector GC, retransmit-with-current-values,
+  duplicate/overlapping interval idempotence, unacked-overflow
+  full-state fallback (anti-entropy handoff + capability renegotiation),
+  heal behavior;
+* engine — ``ingest_interval`` lands absolute lane values bit-exactly,
+  idempotently, through host-resident and device-resident rows alike;
+* cluster — a real 2-node loopback exchange converges bit-exactly, and
+  a MIXED cluster with a reference (v1) peer ignores v2 datagrams while
+  still converging via the classic compat traffic.
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+
+from patrol_tpu.models.limiter import NANO, LimiterConfig
+from patrol_tpu.net import delta as delta_plane
+from patrol_tpu.net.antientropy import state_digest
+from patrol_tpu.net.replication import CTRL_PREFIX, Replicator, ReplyGate, SlotTable
+from patrol_tpu.net.v1node import V1Node
+from patrol_tpu.ops import wire
+from patrol_tpu.ops.rate import Rate
+from patrol_tpu.runtime.engine import DeviceEngine
+from patrol_tpu.runtime.repo import TPURepo
+
+RATE = Rate(freq=100, per_ns=3600 * NANO)
+
+
+def entries(n, name="b{:03d}", slot=1, base=0):
+    return [
+        wire.DeltaEntry(name.format(i), slot, 10 * NANO, base + i, 2 * i, i)
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# codec
+
+
+class TestDeltaCodec:
+    def test_roundtrip_exact(self):
+        ents = entries(120)
+        pkt, n = wire.encode_delta_packet(3, 9, [4, 5, 6], ents)
+        assert n == 120 and len(pkt) <= wire.DELTA_PACKET_SIZE
+        back = wire.decode_delta_packet(pkt)
+        assert back == wire.DeltaPacket(3, 9, (4, 5, 6), tuple(ents))
+        # Re-encode is byte-stable (replicas relay identically).
+        again, _ = wire.encode_delta_packet(3, 9, back.acks, back.entries)
+        assert again == pkt
+
+    def test_bare_ack(self):
+        pkt, n = wire.encode_delta_packet(0, 0, [17], ())
+        assert n == 0
+        back = wire.decode_delta_packet(pkt)
+        assert back.seq == 0 and back.acks == (17,) and back.entries == ()
+
+    def test_envelope_is_a_v1_zero_state_control_packet(self):
+        pkt, _ = wire.encode_delta_packet(1, 1, (), entries(5))
+        st = wire.decode(pkt)
+        assert st.is_zero()
+        assert st.name == wire.DELTA_CHANNEL_NAME
+        assert st.name.startswith(CTRL_PREFIX)
+        assert st.origin_slot is None  # no P2 trailer parsed from payload
+
+    def test_max_pack_boundary(self):
+        """Entries pack to exactly the size bound; the first overflowing
+        entry is left for the next interval, never truncated."""
+        ents = entries(400)
+        size = wire.delta_entry_size(ents[0].name)
+        pkt, n = wire.encode_delta_packet(1, 1, (), ents, max_size=1024)
+        assert 0 < n < 400
+        assert len(pkt) <= 1024 and len(pkt) + size > 1024
+        assert wire.decode_delta_packet(pkt).entries == tuple(ents[:n])
+        # Capacity helper agrees with the real packer.
+        assert n == wire.delta_capacity(1024, len(ents[0].name))
+
+    def test_every_truncation_rejected(self):
+        pkt, _ = wire.encode_delta_packet(2, 5, [1, 2], entries(7))
+        for i in range(len(pkt)):
+            assert wire.decode_delta_packet(pkt[:i]) is None, i
+
+    def test_every_single_byte_corruption_rejected(self):
+        pkt, _ = wire.encode_delta_packet(2, 5, [1, 2], entries(7))
+        for i in range(len(pkt)):
+            bad = bytearray(pkt)
+            bad[i] ^= 0x5A
+            assert wire.decode_delta_packet(bytes(bad)) is None, i
+
+    def test_trailing_garbage_rejected(self):
+        pkt, _ = wire.encode_delta_packet(2, 5, (), entries(3))
+        assert wire.decode_delta_packet(pkt + b"x") is None
+
+    def test_corrupt_ack_vector_count_rejected(self):
+        """An ack count pointing past the body must reject the whole
+        packet (checksum fixed up to isolate the bounds check)."""
+        pkt, _ = wire.encode_delta_packet(2, 5, [1], entries(3))
+        bad = bytearray(pkt)
+        off = wire._DELTA_BASE + wire._DELTA_HEAD.size - 1
+        bad[off] = 33  # n_acks > DELTA_MAX_ACKS
+        bad[-1] = sum(bad[wire._DELTA_BASE : -1]) & 0xFF
+        assert wire.decode_delta_packet(bytes(bad)) is None
+        bad[off] = 31  # plausible count, but the body is too short
+        bad[-1] = sum(bad[wire._DELTA_BASE : -1]) & 0xFF
+        assert wire.decode_delta_packet(bytes(bad)) is None
+
+    def test_bit63_values_rejected_whole(self):
+        pkt, _ = wire.encode_delta_packet(1, 1, (), entries(2))
+        # Corrupt an entry value to set bit 63, then fix the checksum:
+        # validation must be all-or-nothing like the P2 trailers.
+        bad = bytearray(pkt)
+        off = wire._DELTA_BASE + wire._DELTA_HEAD.size + wire._DELTA_COUNT.size
+        off += 1 + len("b000") + 2  # name_len + name + slot
+        bad[off] |= 0x80
+        bad[-1] = sum(bad[wire._DELTA_BASE : -1]) & 0xFF
+        assert wire.decode_delta_packet(bytes(bad)) is None
+
+    def test_hostile_fuzz_never_crashes(self):
+        import random
+
+        rng = random.Random(20260804)
+        pkt, _ = wire.encode_delta_packet(1, 3, [9], entries(20))
+        for _ in range(500):
+            bad = bytearray(pkt)
+            for _ in range(rng.randrange(1, 6)):
+                bad[rng.randrange(len(bad))] = rng.randrange(256)
+            got = wire.decode_delta_packet(bytes(bad))
+            assert got is None or isinstance(got, wire.DeltaPacket)
+        for _ in range(200):
+            blob = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 300)))
+            assert wire.decode_delta_packet(blob) is None or True
+
+    def test_oversized_name_raises(self):
+        with pytest.raises(wire.NameTooLargeError):
+            wire.encode_delta_packet(
+                1, 1, (), [wire.DeltaEntry("x" * 300, 0, 0, 0, 0, 0)]
+            )
+
+
+# ---------------------------------------------------------------------------
+# plane unit tests (no sockets)
+
+
+class _Slots:
+    def __init__(self):
+        self.self_slot = 0
+        self.max_slots = 4
+
+
+class _StubAE:
+    def __init__(self):
+        self.inflight = frozenset()
+        self.triggers = []
+
+    def inflight_buckets(self, addr):
+        return self.inflight
+
+    def trigger(self, addr, force=False):
+        self.triggers.append((addr, force))
+
+
+class FakeRep:
+    log = None
+
+    def __init__(self, peers, wire_mode="delta"):
+        self.wire_mode = wire_mode
+        self.peers = list(peers)
+        self.slots = _Slots()
+        self.repo = None
+        self.antientropy = _StubAE()
+        self.reply_gate = ReplyGate()
+        self.sent = []
+
+    def unicast(self, data, addr):
+        self.sent.append((data, addr))
+
+
+PEER = ("127.0.0.1", 1234)
+
+
+def make_plane(rep=None, **kw):
+    rep = rep or FakeRep([PEER])
+    kw.setdefault("flush_interval_s", 0)  # manual ticks
+    return rep, delta_plane.DeltaPlane(rep, **kw)
+
+
+def offered(name, slot=0, added=5, taken=3, elapsed=0, cap=10 * NANO):
+    return wire.from_nanotokens(
+        name, cap + added, taken, elapsed, origin_slot=slot, cap_nt=cap,
+        lane_added_nt=added, lane_taken_nt=taken,
+    )
+
+
+def sent_deltas(rep):
+    out = []
+    for data, addr in rep.sent:
+        pkt = wire.decode_delta_packet(data)
+        if pkt is not None:
+            out.append((pkt, addr))
+    return out
+
+
+class TestDeltaPlane:
+    def test_advertises_until_capable(self):
+        rep, plane = make_plane()
+        plane.flush()
+        assert len(rep.sent) == 1  # one advert, no data
+        st = wire.decode(rep.sent[0][0])
+        assert st.name.startswith(delta_plane.DELTA_ADVERT_NAME)
+        plane.flush()  # damped: no re-advert inside advert_ticks
+        assert len(rep.sent) == 1
+        plane.mark_capable(PEER, 8192)
+        rep.sent.clear()
+        plane.flush()
+        assert rep.sent == []  # capable + nothing dirty ⇒ silence
+
+    def test_handshake_advert_ack(self):
+        rep, plane = make_plane()
+        payload = delta_plane._ADVERT_PAYLOAD.pack(4096)
+        name = delta_plane.DELTA_ADVERT_NAME + payload.decode(
+            "utf-8", "surrogateescape"
+        )
+        assert plane.handle_control(name, PEER)
+        assert plane.capable_peers() == [PEER]
+        # An advert is answered with our own ack (reply-gated).
+        assert len(rep.sent) == 1
+        back = wire.decode(rep.sent[0][0])
+        assert back.name.startswith(delta_plane.DELTA_ADVERT_ACK_NAME)
+        assert not plane.handle_control("\x00pt!something-else", PEER)
+
+    def test_offer_splits_capable_and_classic(self):
+        other = ("127.0.0.1", 9999)
+        rep, plane = make_plane(FakeRep([PEER, other]))
+        plane.mark_capable(PEER, 8192)
+        classic, leftover = plane.offer([offered("a")])
+        assert classic == [other] and leftover == []
+        # Non-delta-able states (no lane payload) stay classic everywhere.
+        bare = wire.WireState(name="a", added=1.0, taken=0.0, elapsed_ns=0)
+        classic, leftover = plane.offer([bare])
+        assert classic == [other] and leftover == [bare]
+
+    def test_flush_packs_acks_and_gcs(self):
+        rep, plane = make_plane()
+        plane.mark_capable(PEER, 8192)
+        plane.offer([offered(f"b{i}") for i in range(100)])
+        assert plane.flush() == 1
+        pkts = sent_deltas(rep)
+        assert len(pkts) == 1
+        pkt, addr = pkts[0]
+        assert addr == PEER and pkt.seq == 1 and len(pkt.entries) == 100
+        assert plane.stats()["wire_intervals_unacked"] == 1
+        # Ack vector from the peer GCs the interval.
+        ack, _ = wire.encode_delta_packet(1, 0, [1], ())
+        assert plane.on_packet(ack, PEER)
+        assert plane.stats()["wire_intervals_unacked"] == 0
+        # A stale/duplicate ack (overlapping interval) is a no-op.
+        assert plane.on_packet(ack, PEER)
+
+    def test_newest_value_wins_in_dirty_buffer(self):
+        rep, plane = make_plane()
+        plane.mark_capable(PEER, 8192)
+        plane.offer([offered("b", taken=1)])
+        plane.offer([offered("b", taken=7)])
+        plane.flush()
+        (pkt, _), = sent_deltas(rep)
+        assert len(pkt.entries) == 1
+        assert pkt.entries[0].taken_nt == 7
+
+    def test_retransmit_after_timeout_with_new_seq(self):
+        rep, plane = make_plane(retransmit_ticks=2)
+        plane.mark_capable(PEER, 8192)
+        plane.offer([offered("b", taken=1)])
+        plane.flush()
+        rep.sent.clear()
+        plane.flush()  # age 1: not yet
+        assert sent_deltas(rep) == []
+        plane.flush()  # age 2: retransmit, fresh seq subsumes seq 1
+        (pkt, _), = sent_deltas(rep)
+        assert pkt.seq == 2 and pkt.entries[0].name == "b"
+        assert plane.stats()["wire_interval_retransmits"] == 1
+        # seq 1's record is gone (subsumed): only seq 2 is outstanding.
+        ack, _ = wire.encode_delta_packet(1, 0, [2], ())
+        plane.on_packet(ack, PEER)
+        assert plane.stats()["wire_intervals_unacked"] == 0
+
+    def test_retransmit_prefers_current_dirty_value(self):
+        rep, plane = make_plane(retransmit_ticks=1)
+        plane.mark_capable(PEER, 8192)
+        plane.offer([offered("b", taken=1)])
+        plane.flush()
+        rep.sent.clear()
+        plane.offer([offered("b", taken=9)])
+        plane.flush()  # retransmit due AND dirty: one entry, newest value
+        (pkt, _), = sent_deltas(rep)
+        assert len(pkt.entries) == 1 and pkt.entries[0].taken_nt == 9
+
+    def test_unacked_overflow_falls_back_to_antientropy(self):
+        rep, plane = make_plane(
+            retransmit_ticks=10**9, max_unacked_intervals=2
+        )
+        plane.mark_capable(PEER, 8192)
+        for i in range(3):
+            plane.offer([offered(f"b{i}")])
+            plane.flush()
+        st = plane.stats()
+        assert st["wire_fullstate_fallbacks"] == 1
+        assert st["wire_intervals_unacked"] == 0
+        assert plane.capable_peers() == []  # capability renegotiated
+        assert rep.antientropy.triggers == [(PEER, True)]
+
+    def test_heal_drops_interval_log_and_renegotiates(self):
+        rep, plane = make_plane(retransmit_ticks=10**9)
+        plane.mark_capable(PEER, 8192)
+        plane.offer([offered("b")])
+        plane.flush()
+        plane.on_peer_heal(PEER)
+        st = plane.stats()
+        assert st["wire_intervals_unacked"] == 0
+        assert st["wire_fullstate_fallbacks"] == 1
+        assert plane.capable_peers() == []
+
+    def test_rx_acks_piggyback_on_data_and_bare_acks(self):
+        rep, plane = make_plane()
+        plane.mark_capable(PEER, 8192)
+        data, _ = wire.encode_delta_packet(1, 42, (), entries(3))
+        assert plane.on_packet(data, PEER)
+        plane.offer([offered("b")])
+        plane.flush()
+        (pkt, _), = sent_deltas(rep)
+        assert pkt.acks == (42,)  # piggybacked on the data interval
+        rep.sent.clear()
+        data, _ = wire.encode_delta_packet(1, 43, (), entries(1))
+        plane.on_packet(data, PEER)
+        plane.flush()  # nothing dirty: bare ack datagram
+        (pkt, _), = sent_deltas(rep)
+        assert pkt.seq == 0 and pkt.acks == (43,)
+
+    def test_rx_malformed_counted_not_raised(self):
+        rep, plane = make_plane()
+        assert not plane.on_packet(b"\x00" * 40, PEER)
+        assert plane.stats()["wire_delta_rx_errors"] == 1
+
+    def test_rx_entry_slot_out_of_range_dropped(self):
+        rep, plane = make_plane()
+        eng = DeviceEngine(
+            LimiterConfig(buckets=16, nodes=4), node_slot=0, clock=lambda: NANO
+        )
+        try:
+            rep.repo = TPURepo(eng, send_incast=None)
+            bad = wire.DeltaEntry("ok", 99, 0, 5, 5, 0)
+            good = wire.DeltaEntry("ok", 1, 0, 5, 5, 0)
+            data, _ = wire.encode_delta_packet(1, 1, (), [bad, good])
+            assert plane.on_packet(data, PEER)
+            eng.flush()
+            row = eng.directory.lookup("ok")
+            pn, _ = eng.row_view(row)
+            assert int(pn[1, 0]) == 5 and int(pn[:, 0].sum()) == 5
+        finally:
+            eng.stop()
+
+    def test_mtu_respected_per_peer(self):
+        rep, plane = make_plane()
+        plane.mark_capable(PEER, 256)  # a native-backend peer
+        plane.offer([offered(f"b{i:03d}") for i in range(40)])
+        plane.flush()
+        pkts = sent_deltas(rep)
+        assert len(pkts) > 1
+        assert all(len(data) <= 256 for data, _ in rep.sent)
+        total = sum(len(p.entries) for p, _ in pkts)
+        assert total == 40
+        seqs = [p.seq for p, _ in pkts]
+        assert seqs == list(range(1, len(pkts) + 1))
+
+
+# ---------------------------------------------------------------------------
+# engine fold
+
+
+class TestIngestInterval:
+    def _engine(self):
+        return DeviceEngine(
+            LimiterConfig(buckets=32, nodes=4), node_slot=0, clock=lambda: NANO
+        )
+
+    def test_lands_absolute_values_idempotently(self):
+        eng = self._engine()
+        try:
+            args = (["a", "b"], [1, 2], [10 * NANO, 0], [7, 8], [3, 4], [5, 6])
+            assert eng.ingest_interval(*args) == 2
+            eng.ingest_interval(*args)  # dup interval: idempotent
+            eng.flush()
+            ra = eng.directory.lookup("a")
+            pn, el = eng.row_view(ra)
+            assert (int(pn[1, 0]), int(pn[1, 1]), int(el)) == (7, 3, 5)
+            assert int(eng.directory.cap_base_nt[ra]) == 10 * NANO
+            rb = eng.directory.lookup("b")
+            pn, el = eng.row_view(rb)
+            assert (int(pn[2, 0]), int(pn[2, 1]), int(el)) == (8, 4, 6)
+        finally:
+            eng.stop()
+
+    def test_monotone_join_never_rolls_back(self):
+        eng = self._engine()
+        try:
+            eng.ingest_interval(["a"], [1], [0], [9], [9], [9])
+            eng.ingest_interval(["a"], [1], [0], [4], [4], [4])  # stale
+            eng.flush()
+            pn, el = eng.row_view(eng.directory.lookup("a"))
+            assert (int(pn[1, 0]), int(pn[1, 1]), int(el)) == (9, 9, 9)
+        finally:
+            eng.stop()
+
+    def test_bad_slots_filtered(self):
+        eng = self._engine()
+        try:
+            assert eng.ingest_interval(["a"], [99], [0], [1], [1], [0]) == 0
+            assert eng.directory.lookup("a") is None
+        finally:
+            eng.stop()
+
+    def test_host_resident_row_absorbs(self):
+        eng = self._engine()
+        try:
+            eng.take("hot", RATE, 1)  # fresh bucket: host-resident lanes
+            assert eng.ingest_interval(["hot"], [2], [0], [11], [12], [0]) == 1
+            eng.flush()
+            pn, _ = eng.row_view(eng.directory.lookup("hot"))
+            assert (int(pn[2, 0]), int(pn[2, 1])) == (11, 12)
+            # Own lane untouched by the remote interval.
+            assert int(pn[0, 1]) == NANO
+        finally:
+            eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# loopback clusters
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _LoopThread:
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def call(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(15)
+
+    def close(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+class TestDeltaCluster:
+    def test_two_node_delta_convergence_and_gc(self):
+        """Handshake → batched intervals → bit-exact convergence → the
+        ack vector GCs every interval (no retransmit storm)."""
+        lt = _LoopThread()
+        addrs = sorted(f"127.0.0.1:{free_port()}" for _ in range(2))
+        nodes = []
+        try:
+            for i in range(2):
+                slots = SlotTable(addrs[i], addrs, max_slots=4)
+                rep = lt.call(
+                    Replicator.create(addrs[i], addrs, slots, wire_mode="delta")
+                )
+                rep.delta.close()  # stop the auto-flusher: manual pacing
+                eng = DeviceEngine(
+                    LimiterConfig(buckets=64, nodes=4),
+                    node_slot=slots.self_slot,
+                    clock=lambda: NANO,
+                )
+                repo = TPURepo(eng, send_incast=None)
+                rep.repo = repo
+                eng.on_broadcast = rep.broadcast_states
+                nodes.append((rep, eng, repo))
+
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                for rep, _, _ in nodes:
+                    rep.delta.flush()
+                if all(len(r.delta.capable_peers()) == 1 for r, _, _ in nodes):
+                    break
+                time.sleep(0.02)
+            assert all(len(r.delta.capable_peers()) == 1 for r, _, _ in nodes)
+
+            names = [f"d{i:02d}" for i in range(20)]
+            for t in range(100):
+                _, ok = nodes[0][2].take(names[t % 20], RATE, 1)
+                assert ok
+            nodes[0][0].delta.flush()
+
+            deadline = time.time() + 10
+            digs = [{}, {}]
+            while time.time() < deadline:
+                for k, (_, eng, _) in enumerate(nodes):
+                    eng.flush()
+                    digs[k] = {
+                        n: state_digest(s)
+                        for n, s in eng.snapshot_many(names).items()
+                    }
+                if len(digs[0]) == 20 and digs[0] == digs[1]:
+                    break
+                time.sleep(0.05)
+            assert digs[0] == digs[1] and len(digs[0]) == 20
+            st = nodes[0][0].delta.stats()
+            assert st["wire_deltas_batched"] == 20
+            assert st["wire_delta_packets_tx"] == 1
+            # Let the receiver's bare ack land, then assert GC.
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                nodes[1][0].delta.flush()
+                if nodes[0][0].delta.stats()["wire_intervals_unacked"] == 0:
+                    break
+                time.sleep(0.02)
+            assert nodes[0][0].delta.stats()["wire_intervals_unacked"] == 0
+            assert st["wire_interval_retransmits"] == 0
+        finally:
+            for rep, eng, _ in nodes:
+                lt.loop.call_soon_threadsafe(rep.close)
+                eng.stop()
+            time.sleep(0.2)
+            lt.close()
+
+    def test_mixed_cluster_v1_peer_ignores_v2_and_converges(self):
+        """The interop proof: a reference-semantics (v1) peer receives the
+        delta node's traffic — classic compat datagrams, because a v1 node
+        never answers the capability advert — plus a crafted v2 delta
+        datagram, which it must IGNORE (a zero-state incast request for an
+        impossible bucket name), and still converge."""
+        lt = _LoopThread()
+        addrs = sorted(f"127.0.0.1:{free_port()}" for _ in range(2))
+        v1 = None
+        rep = eng = None
+        try:
+            slots = SlotTable(addrs[0], addrs, max_slots=4)
+            rep = lt.call(
+                Replicator.create(addrs[0], addrs, slots, wire_mode="delta")
+            )
+            rep.delta.close()  # stop the auto-flusher: manual pacing
+            eng = DeviceEngine(
+                LimiterConfig(buckets=64, nodes=4),
+                node_slot=slots.self_slot,
+                clock=lambda: NANO,
+            )
+            repo = TPURepo(eng, send_incast=None)
+            rep.repo = repo
+            eng.on_broadcast = rep.broadcast_states
+            v1 = V1Node(addrs[1], [addrs[0]], clock=lambda: NANO)
+
+            # Advert goes out; the v1 node never answers (unknown-bucket
+            # incast request) — the peer stays on the classic plane.
+            rep.delta.flush()
+            _, ok = repo.take("mix", RATE, 2)
+            assert ok
+            rep.delta.flush()
+            assert rep.delta.capable_peers() == []
+
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                b, existed = v1.repo.get_bucket("mix")
+                if existed and b.taken_nt >= 2 * NANO:
+                    break
+                time.sleep(0.05)
+            b, existed = v1.repo.get_bucket("mix")
+            assert existed and b.taken_nt == 2 * NANO
+
+            # A stray v2 delta datagram at the v1 node: the reference
+            # reads it as an incast request for the reserved channel name
+            # (at most an empty placeholder bucket, like a probe ping),
+            # NEVER merging the payload — no entry bucket appears, no
+            # state moves.
+            rx_before = v1.rx_packets
+            data, _ = wire.encode_delta_packet(
+                0, 1, (), [wire.DeltaEntry("ghost", 0, 0, 5, 5, 0)]
+            )
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.sendto(data, v1.addr)
+            s.close()
+            deadline = time.time() + 5
+            while time.time() < deadline and v1.rx_packets == rx_before:
+                time.sleep(0.02)
+            assert v1.rx_packets > rx_before
+            assert "ghost" not in v1.repo._buckets
+            ctrl = v1.repo._buckets.get(wire.DELTA_CHANNEL_NAME)
+            assert ctrl is None or ctrl.is_zero()
+            # The real bucket's state is untouched by the stray datagram.
+            b, _ = v1.repo.get_bucket("mix")
+            assert b.taken_nt == 2 * NANO
+        finally:
+            if v1 is not None:
+                v1.close()
+            if rep is not None:
+                lt.loop.call_soon_threadsafe(rep.close)
+            if eng is not None:
+                eng.stop()
+            time.sleep(0.2)
+            lt.close()
+
+
+class TestNativeDeltaCluster:
+    def test_native_backend_delta_convergence_at_v1_mtu(self):
+        """The recvmmsg backend advertises its 256-B rx ring: peers pack
+        v1-sized delta datagrams (still multi-bucket), the C++ batch
+        decoder routes them off the control name, and the cluster
+        converges bit-exactly."""
+        from patrol_tpu.net import native_replication
+
+        if not native_replication.available():
+            pytest.skip("native library not built")
+        addrs = sorted(f"127.0.0.1:{free_port()}" for _ in range(2))
+        nodes = []
+        try:
+            for i in range(2):
+                slots = SlotTable(addrs[i], addrs, max_slots=4)
+                rep = native_replication.NativeReplicator(
+                    addrs[i], addrs, slots, wire_mode="delta"
+                )
+                rep.delta.close()  # manual pacing
+                eng = DeviceEngine(
+                    LimiterConfig(buckets=64, nodes=4),
+                    node_slot=slots.self_slot,
+                    clock=lambda: NANO,
+                )
+                repo = TPURepo(eng, send_incast=None)
+                rep.repo = repo
+                eng.on_broadcast = rep.broadcast_states
+                nodes.append((rep, eng, repo))
+
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                for rep, _, _ in nodes:
+                    rep.delta.flush()
+                if all(len(r.delta.capable_peers()) == 1 for r, _, _ in nodes):
+                    break
+                time.sleep(0.02)
+            assert all(len(r.delta.capable_peers()) == 1 for r, _, _ in nodes)
+            # Both ends advertised the native 256-B bound.
+            for rep, _, _ in nodes:
+                with rep.delta._mu:
+                    assert all(
+                        st.max_rx == 256
+                        for st in rep.delta._peers.values()
+                        if st.capable
+                    )
+
+            names = [f"n{i:02d}" for i in range(12)]
+            for t in range(60):
+                _, ok = nodes[0][2].take(names[t % 12], RATE, 1)
+                assert ok
+            nodes[0][0].delta.flush()
+
+            deadline = time.time() + 10
+            digs = [{}, {}]
+            while time.time() < deadline:
+                nodes[0][0].delta.flush()  # retransmit safety net
+                nodes[1][0].delta.flush()  # acks
+                for k, (_, eng, _) in enumerate(nodes):
+                    eng.flush()
+                    digs[k] = {
+                        n: state_digest(s)
+                        for n, s in eng.snapshot_many(names).items()
+                    }
+                if len(digs[0]) == 12 and digs[0] == digs[1]:
+                    break
+                time.sleep(0.05)
+            assert digs[0] == digs[1] and len(digs[0]) == 12
+            st = nodes[0][0].delta.stats()
+            assert st["wire_delta_packets_tx"] >= 2  # multi-datagram interval
+            assert st["wire_deltas_batched"] >= 12
+            # Batched: strictly fewer datagrams than bucket deltas shipped.
+            assert st["wire_delta_packets_tx"] < st["wire_deltas_batched"]
+        finally:
+            for rep, eng, _ in nodes:
+                rep.close()
+                eng.stop()

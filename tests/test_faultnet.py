@@ -395,3 +395,59 @@ class TestProbeChannel:
                 time.sleep(0.2)
         finally:
             lt.close()
+
+
+class TestDeltaAntiEntropyCoordination:
+    """Satellite regression (wire v2): a peer mid-anti-entropy-resync must
+    not receive overlapping delta retransmits for the buckets the AE job
+    is already re-shipping — the plane dedupes against the job's in-flight
+    bucket set, and the AE worker publishes that set for exactly the push
+    window."""
+
+    def test_push_states_publishes_inflight_bucket_set(self):
+        peer = ("127.0.0.1", 777)
+        seen = []
+
+        class Rep:
+            repo = None
+            log = None
+
+            def unicast(self, data, addr):
+                seen.append(worker.inflight_buckets(addr))
+
+        worker = ae.AntiEntropy(Rep())
+        states = [
+            wire.from_nanotokens(
+                "aeb", 5, 5, 0, origin_slot=0, cap_nt=5,
+                lane_added_nt=5, lane_taken_nt=5,
+            )
+        ]
+        worker._push_states([("aeb", states)], peer, budget=10)
+        assert seen and all("aeb" in s for s in seen)
+        # ...and the window closes with the push.
+        assert worker.inflight_buckets(peer) == frozenset()
+
+    def test_delta_retransmit_defers_ae_inflight_buckets(self):
+        from test_delta import PEER, make_plane, offered, sent_deltas
+
+        rep, plane = make_plane(retransmit_ticks=1)
+        plane.mark_capable(PEER, 8192)
+        plane.offer([offered("aeb"), offered("other")])
+        plane.flush()
+        rep.sent.clear()
+        rep.antientropy.inflight = frozenset({"aeb"})
+        plane.flush()  # both intervals expired; aeb is AE-in-flight
+        names = [
+            e.name for p, _ in sent_deltas(rep) for e in p.entries
+        ]
+        assert "other" in names and "aeb" not in names
+        assert plane.stats()["wire_ae_deduped"] == 1
+        # The deferred bucket is NOT lost: once the AE job completes, the
+        # next expiry re-ships it.
+        rep.antientropy.inflight = frozenset()
+        rep.sent.clear()
+        plane.flush()
+        names = [
+            e.name for p, _ in sent_deltas(rep) for e in p.entries
+        ]
+        assert "aeb" in names  # ("other", still unacked, retransmits too)

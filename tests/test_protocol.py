@@ -66,6 +66,47 @@ class TestMutationsRejected:
         assert any(f.check == "PTC005" for f in findings)
 
 
+class TestDeltaProtocol:
+    def test_clean_delta_and_mixed_pass_every_invariant(self):
+        assert P.check_protocol(P.CLEAN_DELTA) == []
+        assert P.check_protocol(P.CLEAN_MIXED) == []
+
+    def test_v1_node_ignores_delta_packets(self):
+        """Mixed cluster: delivering a v2 interval at the v1 node is a
+        no-op (the real wire reads it as an incast request for a reserved
+        name)."""
+        c = P.Cluster(3, 2, P.CLEAN_MIXED)
+        assert c.caps == [True, True, False]
+        before = c.nodes[2].state()
+        c._apply_packet(2, ("delta", 0, 1, ((0, 0, 1),)))
+        assert c.nodes[2].state() == before
+        # And the sender never addresses delta intervals to it.
+        c.take(0)
+        c.flush(0)
+        assert all(p[0] == "full" for p in c.links[(0, 2)])
+        assert all(p[0] == "delta" for p in c.links[(0, 1)])
+
+    def test_interval_loss_recovered_by_retransmit_not_ae(self):
+        """A dropped interval stays unacked; the convergence procedure's
+        retransmit (NOT anti-entropy — pure-delta clusters get none)
+        repairs it."""
+        c = P.Cluster(2, 2, P.CLEAN_DELTA)
+        c.take(0)
+        c.flush(0)
+        assert c.nodes[0].unacked[1] != {}
+        c.drop(0, 1, 0)  # the interval is lost on the wire
+        assert c.nodes[0].unacked[1] != {}  # ...but not forgotten
+        c.heal_and_converge()  # raises PTC001 if retransmit were broken
+        assert c.nodes[1].taken == c.nodes[0].taken
+
+    def test_delivery_acks_and_gcs_the_interval(self):
+        c = P.Cluster(2, 2, P.CLEAN_DELTA)
+        c.take(0)
+        c.flush(0)
+        c.deliver(0, 1, 0)
+        assert c.nodes[0].unacked[1] == {}  # ack vector GC'd the record
+
+
 class TestModelMatchesKernels:
     def test_model_join_is_the_merge_kernel_join(self):
         """The model's merge must be the elementwise max the device kernel
@@ -99,6 +140,37 @@ class TestModelMatchesKernels:
         pn = np.asarray(out.pn[0])
         assert list(pn[:, 0]) == node.added
         assert list(pn[:, 1]) == node.taken
+
+    def test_model_delta_join_is_the_delta_fold_kernel_join(self):
+        """The delta-mode model's absolute-payload merge must be the same
+        elementwise max the wire-v2 rx fold kernel (ops/delta.delta_fold)
+        computes over a decoded interval."""
+        import jax.numpy as jnp
+
+        from patrol_tpu.models.limiter import LimiterConfig, init_state
+        from patrol_tpu.ops.delta import DeltaBatch, delta_fold
+
+        nodes = 4
+        state = init_state(LimiterConfig(buckets=8, nodes=nodes))
+        slots = np.array([0, 1, 0, 2, 1, 3], np.int32)
+        added = np.array([5, 3, 2, 7, 9, 1], np.int64)
+        taken = np.array([2, 8, 6, 1, 3, 4], np.int64)
+        out = delta_fold(
+            state,
+            DeltaBatch(
+                rows=jnp.zeros(6, jnp.int32),
+                slots=jnp.asarray(slots),
+                added_nt=jnp.asarray(added),
+                taken_nt=jnp.asarray(taken),
+                elapsed_ns=jnp.zeros(6, jnp.int64),
+            ),
+        )
+        cluster = P.Cluster(nodes, 0, P.CLEAN_DELTA)
+        for s, a, t in zip(slots, added, taken):
+            cluster._apply_packet(0, ("delta", 1, 1, ((int(s), int(a), int(t)),)), ack=False)
+        pn = np.asarray(out.pn[0])
+        assert list(pn[:, 0]) == cluster.nodes[0].added
+        assert list(pn[:, 1]) == cluster.nodes[0].taken
 
     def test_model_take_is_the_take_kernel_admission(self):
         """Admission rule parity on the no-refill path: the model admits

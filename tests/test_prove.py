@@ -363,6 +363,8 @@ class TestRepoIsProven:
             "ops.take.take_batch",
             "ops.rate",
             "ops.wire.codec",
+            "ops.wire.delta_codec",
+            "ops.delta.delta_fold",
             "ops.pallas_merge.merge_batch_pallas",
         ):
             assert required in names, required
@@ -382,3 +384,45 @@ class TestRepoIsProven:
         obl = set(ROOTS["merge_scalar_batch"].obligations)
         assert "PTP002" not in obl and "PTP003" not in obl
         assert "PTP004" in obl
+
+
+def add_delta_fold(state, batch):
+    """Seeded wire-v2 rx-fold bug: accumulating an interval instead of
+    joining it — duplicated/retransmitted intervals would inflate state."""
+    pair = jnp.stack([batch.added_nt, batch.taken_nt], axis=-1)
+    pn = state.pn.at[batch.rows, batch.slots].add(pair, mode="drop")
+    elapsed = state.elapsed.at[batch.rows].add(batch.elapsed_ns, mode="drop")
+    return LimiterState(pn=pn, elapsed=elapsed)
+
+
+class TestDeltaObligations:
+    """The wire-v2 roots: delta_fold carries the FULL join obligation set
+    and the interval codec the roundtrip obligation — and both reject
+    their seeded mutations (the prover keeps its teeth on the new plane)."""
+
+    def test_delta_fold_proves_clean(self):
+        assert prove.prove_root(ROOTS["delta_fold"]) == []
+
+    def test_delta_fold_full_obligations_declared(self):
+        assert set(ROOTS["delta_fold"].obligations) == set(prove.ALL_CODES)
+
+    def test_add_delta_fold_rejected_by_model_and_structure(self):
+        f = prove.prove_root(ROOTS["delta_fold"], fn=add_delta_fold)
+        got = codes(f)
+        # Structural taint (add on a merged plane) AND the model checker
+        # (idempotence breaks: re-applying an interval moves state).
+        assert "PTP001" in got and "PTP003" in got
+
+    def test_delta_codec_proves_clean(self):
+        assert prove.prove_root(ROOTS["encode_delta_packet"]) == []
+
+    def test_delta_codec_mutation_rejected(self):
+        from patrol_tpu.ops import wire
+
+        def checksum_off_by_one(slot, seq, acks, entries,
+                                max_size=wire.DELTA_PACKET_SIZE):
+            pkt, n = wire.encode_delta_packet(slot, seq, acks, entries, max_size)
+            return pkt[:-1] + bytes([(pkt[-1] + 1) & 0xFF]), n
+
+        f = prove.prove_root(ROOTS["encode_delta_packet"], fn=checksum_off_by_one)
+        assert codes(f) == ["PTP003"]
