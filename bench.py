@@ -1593,20 +1593,23 @@ def chaos_main() -> int:
 
 def wire_main() -> int:
     """``bench.py --wire-smoke``: a seconds-class, CPU-safe gate for the
-    wire-v2 delta-interval data plane (net/delta.py). Runs the SAME seeded
-    churn workload (one taker node, round-robin over a bucket set, frozen
-    clocks) over a real 2-node loopback replication plane twice — once in
-    ``--wire-mode compat`` (the v1 full-state-packet-per-take plane) and
-    once in ``--wire-mode delta`` — and emits the side-by-side:
-    ``wire_deltas_per_packet``, ``wire_packets_per_take`` (both modes),
+    wire-v2 delta-interval data plane (net/delta.py). First asserts the
+    deployment DEFAULT wire mode (cli + Command) is ``delta`` — the
+    ROADMAP item-3a flip — then runs the SAME seeded churn workload (one
+    taker node, round-robin over a bucket set, frozen clocks) over a
+    real 2-node loopback replication plane twice: once in the new
+    default (``delta``) and once in the explicit ``--wire-mode full``
+    opt-out (the v1 full-state-packet-per-take plane, exercising the
+    alias), and emits the side-by-side: ``wire_deltas_per_packet``,
+    ``wire_packets_per_take`` (both legs),
     ``wire_tx_bytes_per_admitted_take``. Exits nonzero unless the delta
     run packs ≥ 50 bucket deltas per datagram, uses ≥ 10x fewer
-    packets-per-take than compat, and BOTH runs converge bit-exactly to
-    the SAME per-bucket fixpoint (state digests equal across nodes and
-    across modes)."""
+    packets-per-take than the full-state leg, and BOTH legs converge
+    bit-exactly to the SAME per-bucket fixpoint (state digests equal
+    across nodes and across modes)."""
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
-    OUT["metric"] = "wire v2 delta-interval smoke (delta vs compat)"
+    OUT["metric"] = "wire v2 delta-interval smoke (default delta vs full opt-out)"
     OUT["unit"] = "takes"
     OUT["wire_smoke"] = True
     t0 = time.time()
@@ -1630,6 +1633,20 @@ def wire_main() -> int:
         from patrol_tpu.utils import profiling
 
         OUT["platform"] = jax.default_backend()
+        # The ROADMAP item-3a default flip: delta is the deployment
+        # default at every layer that sets one; "full" is the opt-out.
+        from patrol_tpu.cli import build_parser
+        from patrol_tpu.command import Command
+
+        cli_default = build_parser().get_default("wire_mode")
+        cmd_default = Command.__dataclass_fields__["wire_mode"].default
+        assert cli_default == "delta", (
+            f"cli --wire-mode default is {cli_default!r}, expected 'delta'"
+        )
+        assert cmd_default == "delta", (
+            f"Command.wire_mode default is {cmd_default!r}, expected 'delta'"
+        )
+        OUT["wire_default_mode"] = cli_default
         BUCKETS, TAKES, FLUSH_EVERY = 600, 6000, 1200
         OUT["value"] = TAKES
         OUT["wire_smoke_buckets"] = BUCKETS
@@ -1757,7 +1774,9 @@ def wire_main() -> int:
                 thread.join(timeout=5)
             return res
 
-        compat = run_mode("compat")
+        # The explicit opt-out leg runs through the "full" ALIAS so the
+        # regression covers both the classic plane and the alias plumbing.
+        full = run_mode("full")
         delta = run_mode("delta")
 
         st = delta["stats0"]
@@ -1774,34 +1793,34 @@ def wire_main() -> int:
         OUT["wire_packets_per_take"] = round(
             (data_pkts + ack_pkts) / TAKES, 4
         )
-        OUT["wire_packets_per_take_compat"] = round(
-            compat["classic_broadcast_packets"] / TAKES, 4
+        OUT["wire_packets_per_take_full"] = round(
+            full["classic_broadcast_packets"] / TAKES, 4
         )
         OUT["wire_tx_bytes_per_admitted_take"] = round(
             delta["tx_bytes"] / TAKES, 1
         )
-        OUT["wire_tx_bytes_per_admitted_take_compat"] = round(
-            compat["tx_bytes"] / TAKES, 1
+        OUT["wire_tx_bytes_per_admitted_take_full"] = round(
+            full["tx_bytes"] / TAKES, 1
         )
-        OUT["wire_converged_compat"] = compat["converged"]
+        OUT["wire_converged_full"] = full["converged"]
         OUT["wire_converged_delta"] = delta["converged"]
         fixpoint_equal = (
-            compat["converged"]
+            full["converged"]
             and delta["converged"]
-            and compat["digests"] == delta["digests"]
+            and full["digests"] == delta["digests"]
         )
         OUT["wire_fixpoint_equal"] = fixpoint_equal
         ratio = (
-            OUT["wire_packets_per_take_compat"] / OUT["wire_packets_per_take"]
+            OUT["wire_packets_per_take_full"] / OUT["wire_packets_per_take"]
             if OUT["wire_packets_per_take"]
             else 0.0
         )
         OUT["wire_packet_reduction_x"] = round(ratio, 1)
 
-        assert compat["converged"], "compat-mode run did not converge"
-        assert delta["converged"], "delta-mode run did not converge"
+        assert full["converged"], "full-state (opt-out) run did not converge"
+        assert delta["converged"], "delta-mode (default) run did not converge"
         assert fixpoint_equal, (
-            "delta-mode fixpoint diverged from the compat-mode fixpoint"
+            "delta-mode fixpoint diverged from the full-state fixpoint"
         )
         assert OUT["wire_deltas_per_packet"] >= 50, (
             f"only {OUT['wire_deltas_per_packet']} deltas per packet (< 50)"

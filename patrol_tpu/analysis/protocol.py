@@ -107,7 +107,11 @@ class Semantics:
     correct join-decomposition of a max-lattice) or raw increments (the
     classic delta-CRDT bug: duplication inflates state); ``delta_gc``
     garbage-collects intervals on ack or eagerly at send (the GC bug:
-    a lost interval is never repaired)."""
+    a lost interval is never repaired). ``incast_gate`` models the
+    responder-side ReplyGate (net/replication.py): ``"ttl"`` grants ONE
+    reply burst per requester per gate window (the bounded schedule is
+    one window); ``"bypass"`` answers every duplicate request — the
+    cold-start storm amplification the gate exists to bound."""
 
     merge: str = "join"  # "join" | "sum" | "assign"
     resync: str = "join"  # "join" | "overwrite"
@@ -115,6 +119,7 @@ class Semantics:
     wire: str = "full"  # "full" | "delta" | "mixed"
     delta_payload: str = "absolute"  # "absolute" | "increment"
     delta_gc: str = "acked"  # "acked" | "eager"
+    incast_gate: str = "ttl"  # "ttl" | "bypass"
 
 
 CLEAN = Semantics()
@@ -136,6 +141,12 @@ MUTATIONS: Dict[str, Semantics] = {
         wire="delta", delta_payload="increment"
     ),
     "delta-gc-before-ack": Semantics(wire="delta", delta_gc="eager"),
+    # Incast gating (the ROADMAP "grow toward the full wire feature set"
+    # item): a responder that ignores the ReplyGate answers EVERY
+    # duplicate request in a cold-start retry storm — ⌈lanes/packet⌉ × M
+    # packets where the budget is one burst (VERDICT r3 item 8's
+    # amplification, closed by replication.ReplyGate).
+    "incast-gate-bypass": Semantics(incast_gate="bypass"),
 }
 
 
@@ -160,6 +171,7 @@ class Node:
     __slots__ = (
         "slot", "n", "limit", "added", "taken", "admitted",
         "dirty", "sent_a", "sent_t", "next_seq", "unacked",
+        "reply_granted", "replies_tx", "replies_suppressed",
     )
 
     def __init__(self, slot: int, n: int, limit: int):
@@ -174,6 +186,12 @@ class Node:
         self.sent_t = 0
         self.next_seq = {j: 1 for j in range(n) if j != slot}
         self.unacked = {j: {} for j in range(n) if j != slot}
+        # Responder-side incast ReplyGate model: requesters granted a
+        # reply burst this gate window, and the tx/suppression counters
+        # the budget invariant reads.
+        self.reply_granted: set = set()
+        self.replies_tx = 0
+        self.replies_suppressed = 0
 
     def state(self) -> Tuple[int, ...]:
         return tuple(self.added) + tuple(self.taken)
@@ -310,6 +328,30 @@ class Cluster:
             node.sent_t = node.taken[i]
         node.dirty = False
 
+    def incast(self, i: int) -> None:
+        """Node i broadcasts a zero-state incast request for the bucket
+        (the cold-miss solicitation, repo.go:99-103). The requester-side
+        dedup is NOT modeled — the whole point of the responder gate is
+        surviving a requester that re-asks in a tight loop."""
+        for j in range(len(self.nodes)):
+            if j != i:
+                self.links[(i, j)].append(("incast", i))
+
+    def _serve_incast(self, j: int, src: int) -> None:
+        """Responder j answers an incast request from src: one full-state
+        reply burst, gated per requester (replication.ReplyGate — ONE
+        burst per (bucket, requester) per TTL; the bounded schedule is
+        one TTL window)."""
+        node = self.nodes[j]
+        if self.sem.incast_gate == "ttl" and src in node.reply_granted:
+            node.replies_suppressed += 1
+            return
+        node.reply_granted.add(src)
+        pkt = node.packet()
+        if pkt:
+            node.replies_tx += 1
+            self.links[(j, src)].append(("full", pkt))
+
     def crosses_partition(self, i: int, j: int) -> bool:
         return (
             self.partition is not None
@@ -332,6 +374,9 @@ class Cluster:
         self._apply_packet(j, pkt)
 
     def _apply_packet(self, j: int, pkt: tuple, ack: bool = True) -> None:
+        if pkt[0] == "incast":
+            self._serve_incast(j, pkt[1])
+            return
         if pkt[0] == "full":
             self._merge_checked(j, pkt[1])
             return
@@ -705,6 +750,63 @@ def check_idempotence(
     return findings
 
 
+def check_incast_gating(
+    n_nodes: int = 3, limit: int = 4, requests: int = 3,
+    sem: Semantics = CLEAN,
+) -> List[Finding]:
+    """Incast gating (the ROADMAP wire-feature-set growth item): a
+    requester re-asking in a tight loop — ``requests`` duplicate incast
+    broadcasts inside one gate TTL — must draw AT MOST ONE reply burst
+    from each responder (PTC003's budget family: the amplification bound
+    replication.ReplyGate enforces), the suppressed duplicates must be
+    observable, and the replies themselves must still converge the
+    requester to the join of all state (PTC001) without ever shrinking
+    it (PTC002, via the checked merge)."""
+    findings: List[Finding] = []
+    c = Cluster(n_nodes, limit, sem)
+    try:
+        # Give every responder distinguishable state to reply with.
+        for j in range(1, n_nodes):
+            c.take(j)
+            c.take(j)
+            c.flush(j)
+        c.deliver_all()
+        for _ in range(requests):
+            c.incast(0)
+            c.deliver_all()  # serve the requests, deliver the replies
+        for j in range(1, n_nodes):
+            node = c.nodes[j]
+            if node.replies_tx > 1:
+                raise _Violation(
+                    "PTC003",
+                    f"incast reply storm: node {j} answered "
+                    f"{node.replies_tx} reply bursts for {requests} "
+                    "duplicate requests inside one gate TTL (responder "
+                    "budget is 1 — the ReplyGate was bypassed)",
+                )
+            if (
+                sem.incast_gate == "ttl"
+                and node.replies_suppressed != requests - node.replies_tx
+            ):
+                raise _Violation(
+                    "PTC003",
+                    f"incast gate accounting broken on node {j}: "
+                    f"{node.replies_suppressed} suppressed for "
+                    f"{requests} requests / {node.replies_tx} granted",
+                )
+        expect = _join([n.state() for n in c.nodes])
+        if c.nodes[0].state() != expect:
+            raise _Violation(
+                "PTC001",
+                f"incast requester did not converge to the join: "
+                f"{c.nodes[0].state()} != {expect}",
+            )
+        c.heal_and_converge()
+    except _Violation as v:
+        findings.append(Finding(v.check, _SELF, 0, v.message))
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # entry points
 
@@ -718,6 +820,7 @@ def check_protocol(sem: Semantics = CLEAN) -> List[Finding]:
     _, async_findings = check_async_schedules(sem=sem)
     findings += async_findings
     findings += check_idempotence(sem=sem)
+    findings += check_incast_gating(sem=sem)
     # De-duplicate identical findings from overlapping suites.
     seen = set()
     out = []

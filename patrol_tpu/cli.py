@@ -62,13 +62,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--wire-mode",
-        choices=["aggregate", "compat", "delta"],
-        default="aggregate",
-        help="outgoing replication wire form: dual-payload aggregate "
-        "headers (flag-day vs pre-lane-trailer builds), compat raw "
-        "own-lane headers for rolling upgrades, or delta-interval "
-        "batched datagrams to v2-capable peers with aggregate fallback "
-        "(see ops/wire.py and net/delta.py)",
+        choices=["delta", "full", "aggregate", "compat"],
+        default="delta",
+        help="outgoing replication wire form. Default 'delta': batched "
+        "delta-interval datagrams (wire v2) to peers that answer the "
+        "capability handshake, full-state aggregate datagrams to "
+        "everyone else — so mixed v1/v2 clusters stay safe with no "
+        "flags. 'full' (alias 'aggregate') opts out back to the "
+        "per-take full-state plane; 'compat' additionally rewrites to "
+        "raw own-lane headers for rolling upgrades from pre-lane-"
+        "trailer builds (see ops/wire.py and net/delta.py)",
     )
     p.add_argument(
         "--http-front",

@@ -40,12 +40,14 @@ class Command:
     # "native" = C++ recvmmsg/sendmmsg path, "asyncio" = pure python,
     # "auto" = native when the toolchain built it, else asyncio.
     udp_backend: str = "auto"
-    # Outgoing wire form: "aggregate" (dual-payload; flag-day upgrade from
-    # pre-lane-trailer patrol_tpu builds), "compat" (raw own-lane headers
-    # for rolling upgrades), or "delta" (wire-v2 batched delta-interval
-    # datagrams to capability-advertising peers, aggregate to the rest).
-    # See ops/wire.py module docs and net/delta.py.
-    wire_mode: str = "aggregate"
+    # Outgoing wire form: "delta" (the DEFAULT since the wire-v2 bake:
+    # batched delta-interval datagrams to capability-advertising peers,
+    # aggregate full-state to the rest — the handshake keeps mixed
+    # v1/v2 clusters safe), "full"/"aggregate" (the per-take full-state
+    # opt-out; dual-payload headers, flag-day upgrade from
+    # pre-lane-trailer patrol_tpu builds), or "compat" (raw own-lane
+    # headers for rolling upgrades). See ops/wire.py and net/delta.py.
+    wire_mode: str = "delta"
     # HTTP front: "native" = C++ epoll front (net/native_http.py) — the
     # /take decision runs entirely in-process for host-resident buckets
     # (the reference's performance class, api.go:51-86) and h2c clients

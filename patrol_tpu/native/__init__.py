@@ -46,12 +46,24 @@ class NativeEffect(NamedTuple):
       explorer uses this to judge lock-protocol legality.
     * ``callback_safe`` — pure compute on caller-owned buffers: no
       locks, no syscalls that block, safe from a jax host callback.
+    * ``owns_buffers`` / ``borrows_until`` — buffer-ownership contract
+      (patrol-race, ``analysis/race.py``). Most symbols *borrow* their
+      numpy arguments for the duration of the call only
+      (``borrows_until="call"``); a symbol that RETAINS the pointers
+      past its return (``owns_buffers=True``) names the releasing
+      symbol in ``borrows_until`` — until that release runs, the Python
+      side must never rebind or resize those arrays (the .so would keep
+      reading freed storage: use-after-recycle). The static ownership
+      pass checks both directions against its declared retained-buffer
+      registry, PTA005-style.
     """
 
     blocks: bool
     takes_host_mu: bool
     requires_host_mu: bool
     callback_safe: bool
+    owns_buffers: bool = False
+    borrows_until: str = "call"
 
 
 _E = NativeEffect
@@ -69,7 +81,13 @@ NATIVE_EFFECTS: Dict[str, NativeEffect] = {
     "pt_decode_batch": _E(False, False, False, True),
     "pt_encode_batch": _E(False, False, False, True),
     # -- directory / rx fast path --
-    "pt_dir_create": _E(False, False, False, False),
+    # pt_dir_create RETAINS name_bytes/name_len: the C++ directory
+    # verifies hash hits against those rows through the stored pointers
+    # until pt_dir_destroy. Rebinding either array use-after-frees.
+    "pt_dir_create": _E(
+        False, False, False, False,
+        owns_buffers=True, borrows_until="pt_dir_destroy",
+    ),
     "pt_dir_insert": _E(False, False, False, False),
     "pt_dir_insert_batch": _E(False, False, False, False),
     "pt_dir_delete": _E(False, False, False, False),
@@ -91,7 +109,13 @@ NATIVE_EFFECTS: Dict[str, NativeEffect] = {
     "pt_http_blast": _E(True, False, False, False),
     "pt_http_blast_h2": _E(True, False, False, False),
     # -- host-lane store (the engine's _host_mu lives here) --
-    "pt_hls_create": _E(False, False, False, False),
+    # pt_hls_create RETAINS cap_base/created/last_used (the directory's
+    # side arrays): the in-front take path reads refill baselines through
+    # the stored pointers until pt_hls_destroy.
+    "pt_hls_create": _E(
+        False, False, False, False,
+        owns_buffers=True, borrows_until="pt_hls_destroy",
+    ),
     "pt_hls_destroy": _E(False, False, False, False),
     "pt_hls_lock": _E(True, True, False, False),
     "pt_hls_unlock": _E(False, False, True, False),

@@ -1669,6 +1669,19 @@ int pt_http_poll(int h, int timeout_ms,
   if (!s) return -EBADF;
   std::unique_lock<std::mutex> lk(s->mu);
   if (s->take_q.empty() && s->other_q.empty() && timeout_ms > 0) {
+    auto pred = [&] {
+      return !s->take_q.empty() || !s->other_q.empty() || !s->running ||
+             (s->hls != nullptr &&
+              s->hls->events.load(std::memory_order_relaxed) !=
+                  s->hls_events_seen);
+    };
+#if defined(PT_STEADY_CV_WAIT)
+    // Modern toolchain (gcc >= 12 / llvm >= 14, probed by check.sh):
+    // the steady-clock wait_for is the correct form — immune to
+    // realtime clock jumps — and its pthread_cond_clockwait lowering is
+    // intercepted by these sanitizer runtimes.
+    s->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+#else
     // wait_until(system_clock) rather than wait_for: wait_for's
     // steady_clock lowers to pthread_cond_clockwait, which the gcc-10
     // libtsan doesn't intercept — TSan then never sees the mutex release
@@ -1679,12 +1692,8 @@ int pt_http_poll(int h, int timeout_ms,
         lk,
         std::chrono::system_clock::now() +
             std::chrono::milliseconds(timeout_ms),
-        [&] {
-      return !s->take_q.empty() || !s->other_q.empty() || !s->running ||
-             (s->hls != nullptr &&
-              s->hls->events.load(std::memory_order_relaxed) !=
-                  s->hls_events_seen);
-    });
+        pred);
+#endif
   }
   if (s->hls != nullptr)
     s->hls_events_seen = s->hls->events.load(std::memory_order_relaxed);

@@ -200,6 +200,8 @@ class DeltaPlane:
     # -- capability handshake (control channel) ------------------------------
 
     def _peer(self, addr: Addr) -> _PeerDelta:
+        """Get-or-create the per-peer state. Caller holds ``_mu`` (a
+        declared HOLDER contract in analysis/race.py::HOLDERS)."""
         st = self._peers.get(addr)
         if st is None:
             st = self._peers[addr] = _PeerDelta()

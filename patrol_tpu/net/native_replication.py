@@ -71,6 +71,8 @@ class NativeReplicator:
         self.node_addr = node_addr
         self.slots = slots
         self.log = log_ or log
+        if wire_mode == "full":
+            wire_mode = "aggregate"  # the CLI's opt-out alias
         if wire_mode not in ("aggregate", "compat", "delta"):
             raise ValueError(f"unknown wire_mode {wire_mode!r}")
         # "aggregate" = dual-payload wire form (flag-day vs pre-lane-trailer

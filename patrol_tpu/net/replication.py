@@ -429,6 +429,8 @@ class Replicator(asyncio.DatagramProtocol):
         self.node_addr = node_addr
         self.slots = slots
         self.log = log
+        if wire_mode == "full":
+            wire_mode = "aggregate"  # the CLI's opt-out alias
         if wire_mode not in ("aggregate", "compat", "delta"):
             raise ValueError(f"unknown wire_mode {wire_mode!r}")
         self.wire_mode = wire_mode

@@ -744,7 +744,11 @@ class DeviceEngine:
         # Host fast path: row → HostLanes for buckets currently served
         # in-process (µs-class) instead of on-device. The bool flag array
         # gives the rx hot path an O(1)/vectorized residency probe; dict
-        # and flags only ever change together, under _host_mu.
+        # and flags only ever change together, under _host_mu. This and
+        # the other shared-state disciplines in this class are no longer
+        # comment-level only: analysis/race.py::GUARDS registers each
+        # attribute→lock pair and check.sh stage 7 (patrol-race PTR003)
+        # flags any access outside the declared lock.
         self._hosted: Dict[int, HostLanes] = {}
         self._hosted_flag = np.zeros(config.buckets, dtype=bool)
         self._promote_pending: set = set()
@@ -1112,7 +1116,9 @@ class DeviceEngine:
         tick-ordered drain (pop+flip, then join, then _apply) preserves
         the atomicity argument: a take can only route device-ward AFTER
         the flag flips, and by then the join for its tick has landed.
-        Caller holds ``_host_mu``."""
+        Caller holds ``_host_mu`` (a declared HOLDER contract in
+        analysis/race.py — patrol-race checks this body as if the lock
+        were taken at entry)."""
         if row in self._hosted:
             self._promote_pending.add(row)
             with self._cond:

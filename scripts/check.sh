@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # patrol-check: the repo-wide static-analysis + sanitizer + prover gate.
 #
-# One command, one pass/fail exit code, five stages (plus one opt-in):
+# One command, one pass/fail exit code, seven stages (plus one opt-in):
 #
 #   lint    — repo-specific AST checks over patrol_tpu/ (clock seams,
 #             jit-reachable sync primitives, lock order, nanotoken dtype
@@ -41,6 +41,17 @@
 #             (e.g. resync-overwrites-instead-of-joins) demonstrably
 #             rejected (PTC005); plus the pytest -m protocol self-tests.
 #             Pure python, never skips.
+#   race    — patrol-race: the cross-seam concurrency prover + guarded-
+#             state static analysis (patrol_tpu/analysis/race.py,
+#             scripts/race_repo.py): exhaustive deterministic
+#             interleavings of the epoll-seam protocol model
+#             (pt_http_poll park/drain, completion-ring (slot, gen)
+#             tags) checking lost wakeups and completion-ring token
+#             conservation (PTR001-002, 3 seeded mutations rejected),
+#             plus the guarded-state / lock-graph / condvar-predicate /
+#             buffer-ownership AST passes over the engine/net thread
+#             ensemble (PTR003-005); and the pytest -m race self-tests.
+#             Pure python, never skips.
 #   asan-py — OPT-IN (never in the default set; select explicitly with
 #             --stage): the ctypes-facing pytest subset under
 #             LD_PRELOAD=libasan with an ASan-instrumented
@@ -53,29 +64,53 @@
 #                    check.sh --stage asan-py        # the opt-in seam check
 # The final line is machine-readable so an outer CI can assert that no
 # stage silently skipped (scripts/ci_gate.sh does exactly that):
-#                    PATROL_CHECK stages=6 pass=5 skip=1 fail=0 skipped=tidy failed=-
+#                    PATROL_CHECK stages=7 pass=6 skip=1 fail=0 skipped=tidy failed=-
 #
 # Prereqs and the lint/prove suppression format are documented in
 # README.md ("patrol-check").
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-DEFAULT_STAGES="lint,tidy,san,prove,abi,protocol"
+DEFAULT_STAGES="lint,tidy,san,prove,abi,protocol,race"
 STAGES="$DEFAULT_STAGES"
 while [[ $# -gt 0 ]]; do
   case "$1" in
     --stage|--stages) STAGES="$2"; shift 2 ;;
     --stage=*|--stages=*) STAGES="${1#*=}"; shift ;;
     -h|--help)
-      sed -n '2,59p' "$0" | sed 's/^# \{0,1\}//'
+      sed -n '2,72p' "$0" | sed 's/^# \{0,1\}//'
       exit 0 ;;
-    *) echo "unknown argument: $1 (try --stage lint,tidy,san,prove,abi,protocol,asan-py)" >&2
+    *) echo "unknown argument: $1 (try --stage lint,tidy,san,prove,abi,protocol,race,asan-py)" >&2
        exit 2 ;;
   esac
 done
 [[ "$STAGES" == "all" ]] && STAGES="$DEFAULT_STAGES"
 
 have_pytest() { python -c "import pytest" >/dev/null 2>&1; }
+
+# ---------------------------------------------------------------------------
+# Toolchain probe (carried hygiene item): gcc-10's libtsan cannot
+# intercept pthread_cond_clockwait, which forced the
+# wait_until(system_clock) workaround into pt_http_poll, and its ASan
+# CHECK-fails on jaxlib's static __cxa_throw, degrading asan-py to the
+# non-jit subset. gcc >= 12 (or clang >= 14 as the sanitizer compiler)
+# fixes both: the san stage then builds with -DPT_STEADY_CV_WAIT, which
+# reverts pt_http_poll to the steady-clock cv wait_for, and the asan-py
+# jax probe comes back full. One notice line either way so the state of
+# the workaround is never silent.
+GXX_MAJOR=$(g++ -dumpversion 2>/dev/null | cut -d. -f1 || echo 0)
+CLANG_MAJOR=$(clang --version 2>/dev/null | grep -oE 'version [0-9]+' | grep -oE '[0-9]+' | head -1 || true)
+SAN_CV_FLAGS=""
+if [[ "${GXX_MAJOR:-0}" -ge 12 || "${CLANG_MAJOR:-0}" -ge 14 ]]; then
+  SAN_CV_FLAGS="-DPT_STEADY_CV_WAIT"
+  echo "patrol-check: toolchain probe: g++ ${GXX_MAJOR:-?} / clang ${CLANG_MAJOR:--} — modern sanitizers:" \
+       "reverting the wait_until(system_clock) TSan workaround (steady-clock cv wait)" \
+       "and expecting the full asan-py jit subset"
+else
+  echo "patrol-check: toolchain probe: g++ ${GXX_MAJOR:-?} / clang ${CLANG_MAJOR:--} — pre-12/14 sanitizers:" \
+       "keeping the wait_until(system_clock) TSan workaround; asan-py degrades to the" \
+       "non-jit subset (see ROADMAP toolchain-blocked hygiene)"
+fi
 
 # Each stage runs in a subshell with its own `set -e`; exit 77 = skipped.
 
@@ -125,8 +160,10 @@ stage_san() (
       http) srcs="scripts/san_http_driver.cpp patrol_tpu/native/patrol_host.cpp patrol_tpu/native/patrol_http.cpp" ;;
     esac
     echo "-- $driver driver / $san --"
+    # SAN_CV_FLAGS (toolchain probe above) reverts the TSan condvar
+    # workaround on toolchains whose libtsan intercepts clockwait.
     # shellcheck disable=SC2086
-    g++ -std=c++17 -O1 -g -fsanitize="$san" $extra -fPIC -o "$bin" \
+    g++ -std=c++17 -O1 -g -fsanitize="$san" $extra $SAN_CV_FLAGS -fPIC -o "$bin" \
         $srcs -DPT_NO_MAIN -lpthread -ldl
     env "$runenv" "$bin"
   }
@@ -172,6 +209,18 @@ stage_protocol() (
       -p no:cacheprovider
   else
     echo "pytest unavailable: protocol self-tests skipped (checker itself ran)"
+  fi
+)
+
+stage_race() (
+  set -euo pipefail
+  echo "== patrol-check [race] cross-seam concurrency prover + guarded-state analysis =="
+  python scripts/race_repo.py
+  if have_pytest; then
+    env JAX_PLATFORMS=cpu python -m pytest tests/test_race.py -q -m race \
+      -p no:cacheprovider
+  else
+    echo "pytest unavailable: race self-tests skipped (prover itself ran)"
   fi
 )
 
@@ -238,11 +287,11 @@ run_stage() {
 IFS=',' read -r -a SELECTED <<<"$STAGES"
 for s in "${SELECTED[@]}"; do
   case "$s" in
-    lint|tidy|san|prove|abi|protocol|asan-py) ;;
-    *) echo "unknown stage: '$s' (valid: lint tidy san prove abi protocol asan-py)" >&2; exit 2 ;;
+    lint|tidy|san|prove|abi|protocol|race|asan-py) ;;
+    *) echo "unknown stage: '$s' (valid: lint tidy san prove abi protocol race asan-py)" >&2; exit 2 ;;
   esac
 done
-for s in lint tidy san prove abi protocol asan-py; do
+for s in lint tidy san prove abi protocol race asan-py; do
   for sel in "${SELECTED[@]}"; do
     if [[ "$sel" == "$s" ]]; then
       case "$s" in
@@ -252,6 +301,7 @@ for s in lint tidy san prove abi protocol asan-py; do
         prove)   run_stage prove   stage_prove ;;
         abi)     run_stage abi     stage_abi ;;
         protocol) run_stage protocol stage_protocol ;;
+        race)    run_stage race    stage_race ;;
         asan-py) run_stage asan-py stage_asan_py ;;
       esac
     fi
