@@ -1350,6 +1350,21 @@ def smoke_main() -> int:
             assert np.array_equal(el2, ref_el[live]), (
                 "feeder-path commit diverged from the host max-fold (elapsed)"
             )
+            # Device takes (patrol-fleet device-dispatch timing), AFTER
+            # the equivalence gate (takes mutate the state): the ingested
+            # buckets are device-resident (created by rx, never
+            # host-served), so these run the take_packed kernel and
+            # populate the device_take_ns / device_kernel_take_packed_ns
+            # histograms the stage gate below asserts non-empty.
+            from patrol_tpu.models.limiter import NANO as _NANO
+            from patrol_tpu.ops.rate import Rate as _Rate
+            from patrol_tpu.runtime.repo import TPURepo as _Repo
+
+            _repo = _Repo(engine, send_incast=None)
+            _take_rate = _Rate(freq=10**6, per_ns=3600 * _NANO)
+            for i in range(32):
+                _repo.take(f"k{int(bidx[i % len(bidx)])}", _take_rate, 1)
+            assert engine.flush(timeout=60), "engine flush timed out"
         finally:
             engine.stop()
 
@@ -1386,11 +1401,18 @@ def smoke_main() -> int:
         # (2) per-stage ingest latency breakdown, sourced from the live
         # histograms the engine/replication hot paths populated above —
         # the r06 capture's attribution evidence. Every stage must have
-        # recorded samples or the gate fails (rc != 0).
+        # recorded samples or the gate fails (rc != 0) — INCLUDING the
+        # patrol-fleet device-stage columns (device_commit_ns /
+        # device_take_ns: the completion-pipeline dispatch→ready deltas).
         breakdown = hist_mod.stage_breakdown()
         OUT["ingest_stage_breakdown"] = breakdown
         empty = [s for s, v in breakdown.items() if v["count"] == 0]
         assert not empty, f"ingest stages recorded no samples: {empty}"
+        OUT["device_kernel_breakdown"] = {
+            k: {"count": v["count"], "p99_ns": v["p99"]}
+            for k, v in hist_mod.kernel_breakdown().items()
+        }
+        assert OUT["device_kernel_breakdown"], "no per-kernel device histograms"
 
         # (3) /metrics text exposition parses under the strict minimal
         # parser (the same fixture the unit roundtrip test uses) and
@@ -1614,8 +1636,11 @@ def wire_main() -> int:
     OUT["wire_smoke"] = True
     t0 = time.time()
     # Manual pacing: the smoke drives flush ticks itself so the packing
-    # numbers are deterministic, not a race against a 20 ms timer.
+    # numbers are deterministic, not a race against a 20 ms timer. The
+    # fleet metrics gossip likewise stays manual — its background
+    # datagrams would jitter the per-take byte counts.
     os.environ["PATROL_DELTA_FLUSH_MS"] = "0"
+    os.environ["PATROL_FLEET_GOSSIP_MS"] = "0"
     try:
         import asyncio
         import socket as sk
@@ -1843,6 +1868,113 @@ def wire_main() -> int:
     return 0
 
 
+def trend_main() -> int:
+    """``bench.py --trend``: the perf-regression sentinel driver. Runs
+    the three seconds-class CI smokes (``--smoke`` / ``--wire-smoke`` /
+    ``--chaos-smoke``) as subprocesses (each owns its env/pacing), merges
+    their receipt lines, and compares the merged fields against the
+    pinned ``benchmarks/TREND_BASELINE.json`` with the noise-aware
+    thresholds in ``scripts/bench_gate.py`` — rc != 0 on any regression.
+    ``--pin`` rewrites the baseline from this run instead of gating
+    (use after an intentional perf change, with the receipts reviewed).
+    Emits the machine-greppable ``BENCH_TREND verdict=...`` line and the
+    one JSON receipt either way."""
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    OUT["metric"] = "bench trend gate (smoke receipts vs pinned baseline)"
+    OUT["unit"] = "fields"
+    OUT["trend"] = True
+    t0 = time.time()
+    here = os.path.dirname(os.path.abspath(__file__))
+    baseline_path = os.path.join(here, "benchmarks", "TREND_BASELINE.json")
+    pin = "--pin" in sys.argv
+    try:
+        sys.path.insert(0, os.path.join(here, "scripts"))
+        import bench_gate
+
+        merged: dict = {}
+        rcs = {}
+        for flag in ("--smoke", "--wire-smoke", "--chaos-smoke"):
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), flag],
+                capture_output=True,
+                text=True,
+                timeout=600,
+            )
+            rcs[flag] = proc.returncode
+            doc = None
+            for line in reversed(proc.stdout.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        doc = json.loads(line)
+                        break
+                    except ValueError:
+                        continue
+            if doc is None:
+                raise RuntimeError(
+                    f"{flag} emitted no JSON receipt (rc={proc.returncode}): "
+                    f"{proc.stderr[-500:]}"
+                )
+            merged.update(doc)
+            _log(f"{flag}: rc={proc.returncode}")
+        OUT["trend_smoke_rcs"] = rcs
+        bad_rc = [f for f, rc in rcs.items() if rc != 0]
+
+        if pin:
+            fields = dict(bench_gate.TREND_GATES)
+            pinned = {
+                k: merged[k] for k in fields if k in merged
+            }
+            pinned["_meta"] = {
+                "source": "bench.py --trend --pin",
+                "note": (
+                    "perf-regression baseline for the CI smoke gates; "
+                    "seeded from the BENCH_r05-era container class. "
+                    "Re-pin only after reviewing an intentional change."
+                ),
+            }
+            with open(baseline_path, "w") as f:
+                json.dump(pinned, f, indent=2, sort_keys=True)
+                f.write("\n")
+            OUT["trend_pinned"] = sorted(pinned)
+            print(f"BENCH_TREND verdict=pinned regressions=0 checked={len(pinned) - 1}")
+            OUT["bench_trend_verdict"] = "pinned"
+            _emit()
+            return 0 if not bad_rc else 1
+
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+        regressions, report = bench_gate.check_trend(baseline, merged)
+        for line in report:
+            _log(line)
+        OUT["bench_trend_verdict"] = "pass" if not regressions and not bad_rc else "fail"
+        OUT["bench_trend_regressions"] = regressions
+        OUT["bench_trend_checked"] = (
+            len(bench_gate.TREND_GATES)
+            + len(bench_gate.EXACT_GATES)
+            + len(bench_gate.DEVICE_STAGE_FIELDS)
+        )
+        OUT["value"] = OUT["bench_trend_checked"]
+        OUT["trend_seconds"] = round(time.time() - t0, 2)
+        OUT["stages_completed"] = 1
+        OUT["stages"] = ["trend"]
+        print(bench_gate.verdict_line(regressions))
+        if bad_rc:
+            _log(f"smoke stages failed: {bad_rc}")
+    except BaseException as e:
+        _log(f"trend gate failed: {type(e).__name__}: {e}")
+        OUT["error"] = f"{type(e).__name__}: {e}"
+        OUT["bench_trend_verdict"] = "error"
+        print("BENCH_TREND verdict=error regressions=-1 checked=0")
+        _emit()
+        if not isinstance(e, Exception):
+            raise
+        return 1
+    _emit()
+    return 1 if (regressions or bad_rc) else 0
+
+
 if __name__ == "__main__":
     if "--smoke" in sys.argv:
         sys.exit(smoke_main())
@@ -1850,4 +1982,6 @@ if __name__ == "__main__":
         sys.exit(chaos_main())
     if "--wire-smoke" in sys.argv:
         sys.exit(wire_main())
+    if "--trend" in sys.argv:
+        sys.exit(trend_main())
     main()

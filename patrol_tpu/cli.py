@@ -33,6 +33,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--api-addr", type=_addr, default="127.0.0.1:8080", help="HTTP API address")
     p.add_argument("--node-addr", type=_addr, default="127.0.0.1:16000", help="replication UDP address")
     p.add_argument(
+        "--node-name",
+        default="",
+        help="human-meaningful node identity for fleet views "
+        "(/debug/vars histogram summaries, /cluster/* lane labels); "
+        "defaults to --node-addr",
+    )
+    p.add_argument(
         "--peer-addr",
         type=_addr,
         action="append",
@@ -141,6 +148,7 @@ def main(argv=None) -> int:
     cmd = Command(
         api_addr=args.api_addr,
         node_addr=args.node_addr,
+        node_name=args.node_name,
         peer_addrs=args.peer_addrs,
         clock=offset_clock(offset_ns) if offset_ns else system_clock,
         shutdown_timeout_s=shutdown_ns / 1e9,

@@ -31,6 +31,10 @@ class Command:
 
     api_addr: str = "127.0.0.1:8080"
     node_addr: str = "127.0.0.1:16000"
+    # Human-meaningful node identity for fleet views (patrol-fleet lane
+    # attribution: /debug/vars histogram summaries, /cluster/* labels).
+    # Defaults to node_addr.
+    node_name: str = ""
     peer_addrs: List[str] = dataclasses.field(default_factory=list)
     clock: ClockFn = system_clock  # the injected-clock seam (command.go:23)
     shutdown_timeout_s: float = 30.0
@@ -92,6 +96,12 @@ class Command:
         slots = SlotTable(
             self.node_addr, self.peer_addrs, max_slots=self.config.nodes
         )
+        from patrol_tpu.utils import histogram as hist_mod
+
+        # Node identity rides every histogram summary and gossip packet,
+        # so merged fleet views attribute lanes without guessing.
+        node_name = self.node_name or self.node_addr
+        hist_mod.set_node_identity(slots.self_slot, node_name)
         http_front = self.http_front
         if http_front == "auto":
             from patrol_tpu.net import native_http as _nh
@@ -136,6 +146,8 @@ class Command:
         repo = TPURepo(engine, send_incast=replicator.send_incast_request)
         replicator.repo = repo
         engine.on_broadcast = replicator.broadcast_states
+        if getattr(replicator, "fleet", None) is not None:
+            replicator.fleet.set_identity(node_name)
 
         from patrol_tpu.runtime import checkpoint as ckpt
 
@@ -185,6 +197,9 @@ class Command:
             }
 
         api = API(repo, log=log, stats=stats)
+        # /cluster/* (patrol-fleet): served from the replicator's gossip
+        # store — any node answers for the fleet.
+        api.fleet = getattr(replicator, "fleet", None)
         host, _, port = self.api_addr.rpartition(":")
         native_front = None
         server = None

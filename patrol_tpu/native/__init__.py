@@ -131,6 +131,12 @@ NATIVE_EFFECTS: Dict[str, NativeEffect] = {
 }
 
 PACKET = 256
+# recvmmsg rx-ring row width (and the unicast tx bound): sized to the
+# delta-interval datagram bound (ops/wire.py DELTA_PACKET_SIZE) so the
+# compiled path accepts full 8-KiB intervals — the 256-B rows it had
+# before ROADMAP 3b silently truncated them and forced the backend to
+# advertise a v1-sized rx bound.
+RX_RING_ROW = 8192
 PATH_MAX = 2048  # kPathMax in patrol_http.cpp
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "patrol_host.cpp")
@@ -197,16 +203,18 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pt_udp_port.restype = ctypes.c_int
         lib.pt_udp_close.argtypes = [ctypes.c_int]
         lib.pt_recv_batch.argtypes = [
-            ctypes.c_int, _u8p, ctypes.c_int, _i32p, _u32p, _u16p, ctypes.c_int,
+            ctypes.c_int, _u8p, ctypes.c_int, ctypes.c_int, _i32p, _u32p,
+            _u16p, ctypes.c_int,
         ]
         lib.pt_recv_batch.restype = ctypes.c_int
         lib.pt_send_fanout.argtypes = [
-            ctypes.c_int, _u8p, _i32p, ctypes.c_int, _u32p, _u16p, ctypes.c_int,
+            ctypes.c_int, _u8p, _i32p, ctypes.c_int, ctypes.c_int, _u32p,
+            _u16p, ctypes.c_int,
         ]
         lib.pt_send_fanout.restype = ctypes.c_int
         lib.pt_decode_batch.argtypes = [
-            _u8p, _i32p, ctypes.c_int, _f64p, _f64p, _u64p, _u8p, _i32p, _i32p,
-            _i64p, _i64p, _i64p, _u64p, _i32p,
+            _u8p, _i32p, ctypes.c_int, ctypes.c_int, _f64p, _f64p, _u64p,
+            _u8p, _i32p, _i32p, _i64p, _i64p, _i64p, _u64p, _i32p,
         ]
         lib.pt_decode_batch.restype = ctypes.c_int
         lib.pt_encode_batch.argtypes = [
@@ -335,9 +343,12 @@ def load() -> Optional[ctypes.CDLL]:
 
 
 class NativeSocket:
-    """One UDP socket, native recv/send batch ops, numpy in/out."""
+    """One UDP socket, native recv/send batch ops, numpy in/out. The rx
+    ring rows are ``RX_RING_ROW`` (8 KiB) wide so full delta-interval
+    datagrams arrive untruncated on the compiled path."""
 
-    def __init__(self, ip: str, port: int, max_batch: int = 512):
+    def __init__(self, ip: str, port: int, max_batch: int = 512,
+                 row: int = RX_RING_ROW):
         lib = load()
         if lib is None:
             raise RuntimeError("native library unavailable")
@@ -346,7 +357,8 @@ class NativeSocket:
         if self.fd < 0:
             raise OSError(-self.fd, os.strerror(-self.fd))
         self.max_batch = max_batch
-        self._rx_buf = np.zeros((max_batch, PACKET), np.uint8)
+        self.row = max(row, PACKET)
+        self._rx_buf = np.zeros((max_batch, self.row), np.uint8)
         self._rx_sizes = np.zeros(max_batch, np.int32)
         self._rx_ips = np.zeros(max_batch, np.uint32)
         self._rx_ports = np.zeros(max_batch, np.uint16)
@@ -356,9 +368,9 @@ class NativeSocket:
         return self.lib.pt_udp_port(self.fd)
 
     def recv_batch(self, timeout_ms: int = 100):
-        """→ (packets[n,256] uint8 view, sizes[n], src_ips[n], src_ports[n])."""
+        """→ (packets[n,row] uint8 view, sizes[n], src_ips[n], src_ports[n])."""
         n = self.lib.pt_recv_batch(
-            self.fd, self._rx_buf, self.max_batch, self._rx_sizes,
+            self.fd, self._rx_buf, self.max_batch, self.row, self._rx_sizes,
             self._rx_ips, self._rx_ports, timeout_ms,
         )
         if n < 0:
@@ -374,11 +386,13 @@ class NativeSocket:
                     peer_ips: np.ndarray, peer_ports: np.ndarray) -> int:
         if len(payloads) == 0 or len(peer_ips) == 0:
             return 0
+        payloads = np.ascontiguousarray(payloads, np.uint8)
         n = self.lib.pt_send_fanout(
             self.fd,
-            np.ascontiguousarray(payloads, np.uint8),
+            payloads,
             np.ascontiguousarray(sizes, np.int32),
             len(payloads),
+            payloads.shape[1],  # row stride: (n,256) matrices or wide rows
             np.ascontiguousarray(peer_ips, np.uint32),
             np.ascontiguousarray(peer_ports, np.uint16),
             len(peer_ips),
@@ -426,11 +440,14 @@ def decode_batch_raw(
     n = len(packets)
     if buf is None or len(buf.added) < n:
         buf = DecodeBuffers(n)
+    packets = np.ascontiguousarray(packets, np.uint8)
+    in_stride = packets.shape[1] if packets.ndim == 2 and n else PACKET
     lib.pt_decode_batch(
-        np.ascontiguousarray(packets, np.uint8),
+        packets,
         np.ascontiguousarray(sizes, np.int32),
-        n, buf.added, buf.taken, buf.elapsed, buf.names, buf.name_lens,
-        buf.slots, buf.caps, buf.lane_a, buf.lane_t, buf.hashes, buf.multi,
+        n, in_stride, buf.added, buf.taken, buf.elapsed, buf.names,
+        buf.name_lens, buf.slots, buf.caps, buf.lane_a, buf.lane_t,
+        buf.hashes, buf.multi,
     )
     return buf, n
 

@@ -130,12 +130,19 @@ int pt_udp_port(int fd) {
 
 void pt_udp_close(int fd) { close(fd); }
 
-// Receive up to max_packets datagrams (≤256B each) in one recvmmsg sweep.
-// buf: max_packets*256 bytes; sizes/src_ips/src_ports: per-packet outputs.
-// Waits up to timeout_ms for the first datagram. Returns n ≥ 0 or -errno.
-int pt_recv_batch(int fd, uint8_t* buf, int max_packets, int* sizes,
-                  uint32_t* src_ips, uint16_t* src_ports, int timeout_ms) {
+// Receive up to max_packets datagrams (≤row_stride bytes each) in one
+// recvmmsg sweep. buf: max_packets*row_stride bytes; sizes/src_ips/
+// src_ports: per-packet outputs. row_stride was fixed at 256 (the v1
+// packet bound) until ROADMAP 3b: delta-interval datagrams are up to
+// 8 KiB, and a 256-B ring row silently truncated them — the backend had
+// to advertise a v1-sized rx bound. Callers now size the ring rows to
+// the delta bound. Waits up to timeout_ms for the first datagram.
+// Returns n ≥ 0 or -errno.
+int pt_recv_batch(int fd, uint8_t* buf, int max_packets, int row_stride,
+                  int* sizes, uint32_t* src_ips, uint16_t* src_ports,
+                  int timeout_ms) {
   if (max_packets > kMaxBatch) max_packets = kMaxBatch;
+  if (row_stride < kPacketSize) return -EINVAL;
   pollfd pfd{fd, POLLIN, 0};
   int pr = poll(&pfd, 1, timeout_ms);
   if (pr < 0) return -errno;
@@ -146,7 +153,8 @@ int pt_recv_batch(int fd, uint8_t* buf, int max_packets, int* sizes,
   sockaddr_in addrs[kMaxBatch];
   std::memset(msgs, 0, sizeof(mmsghdr) * max_packets);
   for (int i = 0; i < max_packets; i++) {
-    iovs[i] = {buf + i * kPacketSize, kPacketSize};
+    iovs[i] = {buf + static_cast<size_t>(i) * row_stride,
+               static_cast<size_t>(row_stride)};
     msgs[i].msg_hdr.msg_iov = &iovs[i];
     msgs[i].msg_hdr.msg_iovlen = 1;
     msgs[i].msg_hdr.msg_name = &addrs[i];
@@ -163,11 +171,14 @@ int pt_recv_batch(int fd, uint8_t* buf, int max_packets, int* sizes,
 }
 
 // Send every payload to every peer: n_payloads × n_peers datagrams, flushed
-// through sendmmsg in chunks. payloads: n_payloads*256B (sizes per payload).
-// Returns datagrams handed to the kernel, or -errno on hard failure.
+// through sendmmsg in chunks. payloads: n_payloads rows of row_stride bytes
+// (sizes per payload; a delta-interval unicast is one 8-KiB row, the v1
+// broadcast matrix stays 256-B rows). Returns datagrams handed to the
+// kernel, or -errno on hard failure.
 int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes,
-                   int n_payloads, const uint32_t* peer_ips,
+                   int n_payloads, int row_stride, const uint32_t* peer_ips,
                    const uint16_t* peer_ports, int n_peers) {
+  if (row_stride <= 0) return -EINVAL;
   mmsghdr msgs[kMaxBatch];
   iovec iovs[kMaxBatch];
   sockaddr_in addrs[kMaxBatch];
@@ -200,7 +211,8 @@ int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes,
       }
       int i = queued++;
       std::memset(&msgs[i], 0, sizeof(mmsghdr));
-      iovs[i] = {const_cast<uint8_t*>(payloads) + p * kPacketSize,
+      iovs[i] = {const_cast<uint8_t*>(payloads) +
+                     static_cast<size_t>(p) * row_stride,
                  static_cast<size_t>(sizes[p])};
       addrs[i] = sockaddr_in{};
       addrs[i].sin_family = AF_INET;
@@ -219,7 +231,10 @@ int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes,
 
 // ------------------------------------------------------------------ codec
 
-// Decode n packets (each ≤256B at 256B stride). Outputs per packet:
+// Decode n packets (each at in_stride bytes per row; rows may be the
+// 8-KiB rx ring's — a row's decodable prefix is sizes[i] bytes, and
+// oversized control-channel payloads like delta intervals simply decode
+// as zero-state packets for their reserved name). Outputs per packet:
 //   added/taken (float64 tokens), elapsed (uint64 ns, two's complement),
 //   name bytes copied into names at 256B stride with name_lens set,
 //   origin_slots (-1 when no valid v2 trailer), caps (sender capacity base
@@ -232,14 +247,17 @@ int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes,
 //   through the Python codec.
 // Malformed packets get name_lens[i] = -1. Returns count of valid packets.
 int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
-                    double* added, double* taken, uint64_t* elapsed,
-                    uint8_t* names, int* name_lens, int* origin_slots,
-                    int64_t* caps, int64_t* lane_added, int64_t* lane_taken,
-                    uint64_t* name_hashes, int* multi_flags) {
+                    int in_stride, double* added, double* taken,
+                    uint64_t* elapsed, uint8_t* names, int* name_lens,
+                    int* origin_slots, int64_t* caps, int64_t* lane_added,
+                    int64_t* lane_taken, uint64_t* name_hashes,
+                    int* multi_flags) {
+  if (in_stride < kPacketSize) return 0;
   int ok = 0;
   for (int i = 0; i < n; i++) {
-    const uint8_t* p = packets + i * kPacketSize;
+    const uint8_t* p = packets + static_cast<size_t>(i) * in_stride;
     int sz = sizes[i];
+    if (sz > in_stride) sz = in_stride;
     origin_slots[i] = -1;
     caps[i] = -1;
     lane_added[i] = -1;

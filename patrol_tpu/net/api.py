@@ -126,6 +126,7 @@ _STATUS_TEXT = {
     409: "Conflict",
     429: "Too Many Requests",
     500: "Internal Server Error",
+    503: "Service Unavailable",
 }
 
 
@@ -137,6 +138,9 @@ class API:
         self.repo = repo
         self.log = log
         self.stats = stats or (lambda: {})
+        # patrol-fleet: the replicator's metrics-gossip plane (set by the
+        # supervisor); None ⇒ /cluster/* answers 503 (no fleet view).
+        self.fleet = None
         self.started_at = time.time()  # patrol-lint: clock-seam (uptime)
         self._batcher = (
             _TakeBatcher(repo)
@@ -158,6 +162,10 @@ class API:
             return await self._tokens(path[len("/tokens/") :])
         if path.startswith("/debug/") or path == "/metrics":
             return await self._debug(method, path, query)
+        if path.startswith("/cluster/"):
+            if method != "GET":
+                return 405, b"method not allowed\n", "text/plain"
+            return self._cluster(path)
         return 404, b"not found\n", "text/plain"
 
     # -- the hot route (api.go:51-86) ---------------------------------------
@@ -266,6 +274,8 @@ class API:
                 "/debug/trace/spans              cross-node take spans JSON (&trace_id=N to filter)\n"
                 "/debug/vars                     engine stats JSON (incl. histogram summaries)\n"
                 "/metrics                        prometheus text exposition (gauges + latency histograms)\n"
+                "/cluster/metrics                fleet-merged exposition, node-labeled lanes (patrol-fleet gossip)\n"
+                "/cluster/vars                   fleet-merged summaries JSON (patrol-fleet gossip)\n"
             )
             return 200, index.encode(), "text/plain"
         if path == "/debug/pprof/profile":
@@ -371,6 +381,27 @@ class API:
                 "(open in xprof/tensorboard; see /debug/jax/trace)\n".encode(),
                 "text/plain",
             )
+        return 404, b"not found\n", "text/plain"
+
+    def _cluster(self, path: str) -> Tuple[int, bytes, str]:
+        """patrol-fleet fleet views (net/fleet.py): ``/cluster/metrics``
+        is the MERGED Prometheus exposition — every gossiped node's
+        counter and histogram lanes, ``node``-labeled, strictly
+        parseable — and ``/cluster/vars`` the JSON summary form. Served
+        from the local gossip store: any node answers for the fleet."""
+        from patrol_tpu.utils import histogram as hist_mod
+
+        if self.fleet is None:
+            return 503, b"no fleet gossip plane on this node\n", "text/plain"
+        if path == "/cluster/metrics":
+            body = hist_mod.render_fleet_exposition(self.fleet.store).encode()
+            return 200, body, "text/plain; version=0.0.4"
+        if path == "/cluster/vars":
+            body = json.dumps(
+                {**self.fleet.store.summary(), "gossip": self.fleet.stats()},
+                indent=2,
+            ).encode()
+            return 200, body, "application/json"
         return 404, b"not found\n", "text/plain"
 
     def _metrics(self) -> bytes:

@@ -116,17 +116,22 @@ class NativeReplicator:
         self.faultnet = None
         from patrol_tpu.net.antientropy import AntiEntropy
         from patrol_tpu.net.delta import DeltaPlane
+        from patrol_tpu.net.fleet import FleetPlane
 
         self.antientropy = AntiEntropy(self)
-        # The recvmmsg rx ring is PACKET-sized rows: this backend can only
-        # RECEIVE v1-sized delta datagrams, and its (1, 256) unicast
-        # staging bounds tx the same way — both advertised/handled by the
-        # plane, so asyncio peers never send us what we would truncate.
+        # The recvmmsg rx ring rows are DELTA-sized (native.RX_RING_ROW =
+        # 8 KiB since ROADMAP 3b): the compiled path receives full delta
+        # intervals, so this backend advertises the same rx bound as the
+        # asyncio one and unicast tx is row-sized per datagram.
         self.delta = DeltaPlane(
-            self, tx_mtu=native.PACKET, rx_mtu=native.PACKET
+            self, tx_mtu=native.RX_RING_ROW, rx_mtu=native.RX_RING_ROW
         )
         if self.wire_mode == "delta":
             self.delta.start()
+        # patrol-fleet metrics-lattice gossip (net/fleet.py).
+        self.fleet = FleetPlane(self, tx_mtu=native.RX_RING_ROW)
+        if peers:
+            self.fleet.start()
         self._probe_bytes = wire.encode(
             wire.WireState(name=PROBE_NAME, added=0.0, taken=0.0, elapsed_ns=0)
         )
@@ -188,7 +193,7 @@ class NativeReplicator:
                         self._ingest_py(payload, addr)
                 self._health_tick()
                 continue
-            if n == 0 or self.repo is None:
+            if n == 0:
                 self._health_tick()
                 continue
             self.rx_packets += n
@@ -258,14 +263,17 @@ class NativeReplicator:
                 unresolved = need & (slots < 0)
                 self.rx_errors += int(unresolved.sum())
             slots[~deltas] = -1  # the classify keep-filter drops these
-            if deltas.any():
+            # Data paths need the repo wired; control-channel handling
+            # below does not (parity with the asyncio backend, which
+            # dispatches control packets before its repo check).
+            if deltas.any() and self.repo is not None:
                 self.repo.engine.ingest_wire_batch(
                     dbuf, n, slots, no_trailer.view(np.uint8)
                 )
                 # rx→apply for the whole batch: decode start to engine
                 # queue handoff.
                 hist.RX_APPLY.record(time.perf_counter_ns() - t_batch0)
-            if multi2.any():
+            if multi2.any() and self.repo is not None:
                 for i in np.flatnonzero(multi2):
                     st = wire.decode(bytes(packets[i][: sizes[i]]))
                     if st.lanes is None:
@@ -298,6 +306,11 @@ class NativeReplicator:
                             self.delta.on_packet(
                                 bytes(packets[i][: sizes[i]]), addr_i
                             )
+                        elif name == wire.METRICS_CHANNEL_NAME:
+                            # patrol-fleet metrics gossip: same envelope.
+                            self.fleet.on_packet(
+                                bytes(packets[i][: sizes[i]]), addr_i
+                            )
                         else:
                             # Probe pings / anti-entropy: never a bucket.
                             self._handle_control(name, addr_i)
@@ -310,7 +323,7 @@ class NativeReplicator:
                             int(dbuf.multi[i]) >= 1,  # requester's multi advert
                         )
                     )
-                if incasts:
+                if incasts and self.repo is not None:
                     self._reply_incasts(incasts)
             self._health_tick()
 
@@ -341,6 +354,9 @@ class NativeReplicator:
         if state.is_zero() and state.name.startswith(CTRL_PREFIX):
             if state.name == wire.DELTA_CHANNEL_NAME:
                 self.delta.on_packet(data, addr)
+                return
+            if state.name == wire.METRICS_CHANNEL_NAME:
+                self.fleet.on_packet(data, addr)
                 return
             self._handle_control(state.name, addr)
             return
@@ -461,10 +477,12 @@ class NativeReplicator:
     # -- send path ----------------------------------------------------------
 
     def unicast(self, data: bytes, addr: Tuple[str, int]) -> None:
-        """Thread-safe single-datagram send (probes, acks, anti-entropy)."""
+        """Thread-safe single-datagram send (probes, acks, anti-entropy,
+        delta intervals, metrics gossip). The staging row is sized to the
+        datagram — the old fixed (1, 256) row capped unicast at the v1
+        packet size and would have truncated 8-KiB delta intervals."""
         n = len(data)
-        pkts = np.zeros((1, 256), np.uint8)
-        pkts[0, :n] = np.frombuffer(data, np.uint8)
+        pkts = np.frombuffer(data, np.uint8).reshape(1, n)
         try:
             sent = self.sock.send_fanout(
                 pkts,
@@ -620,6 +638,8 @@ class NativeReplicator:
         self._stopped.set()
         if self.delta is not None:
             self.delta.close()
+        if self.fleet is not None:
+            self.fleet.close()
         if self.antientropy is not None:
             self.antientropy.close()
         self._rx_thread.join(timeout=2)
@@ -640,6 +660,8 @@ class NativeReplicator:
         out.update(self.health.stats())
         if self.delta is not None:
             out.update(self.delta.stats())
+        if self.fleet is not None:
+            out.update(self.fleet.stats())
         if self.antientropy is not None:
             out.update(self.antientropy.stats())
         if self.faultnet is not None:

@@ -468,6 +468,7 @@ class Replicator(asyncio.DatagramProtocol):
         self.faultnet = None
         from patrol_tpu.net.antientropy import AntiEntropy
         from patrol_tpu.net.delta import DeltaPlane
+        from patrol_tpu.net.fleet import FleetPlane
 
         self.antientropy = AntiEntropy(self)
         # Wire-v2 delta-interval plane (net/delta.py): tx gated on
@@ -475,6 +476,12 @@ class Replicator(asyncio.DatagramProtocol):
         self.delta = DeltaPlane(self)
         if self.wire_mode == "delta":
             self.delta.start()
+        # patrol-fleet metrics-lattice gossip (net/fleet.py): paced
+        # join-decompositions of the histogram/counter lattices on the
+        # control channel. Gossip only runs when there is a fleet.
+        self.fleet = FleetPlane(self)
+        if self.peers:
+            self.fleet.start()
         self._health_task: Optional[asyncio.Task] = None
         self._health_tick_s = 0.1
         self._probe_bytes = wire.encode(
@@ -616,6 +623,10 @@ class Replicator(asyncio.DatagramProtocol):
                 # v2 delta-interval datagram: the payload rides AFTER the
                 # reserved name, invisible to the v1 decode above.
                 self.delta.on_packet(data, addr)
+                return
+            if state.name == wire.METRICS_CHANNEL_NAME and self.fleet is not None:
+                # patrol-fleet metrics gossip: same envelope trick.
+                self.fleet.on_packet(data, addr)
                 return
             self._handle_control(state.name, addr)
             return
@@ -807,6 +818,8 @@ class Replicator(asyncio.DatagramProtocol):
             self._health_task = None
         if self.delta is not None:
             self.delta.close()
+        if self.fleet is not None:
+            self.fleet.close()
         if self.antientropy is not None:
             self.antientropy.close()
         if self.transport is not None:
@@ -826,6 +839,8 @@ class Replicator(asyncio.DatagramProtocol):
         out.update(self.health.stats())
         if self.delta is not None:
             out.update(self.delta.stats())
+        if self.fleet is not None:
+            out.update(self.fleet.stats())
         if self.antientropy is not None:
             out.update(self.antientropy.stats())
         if self.faultnet is not None:

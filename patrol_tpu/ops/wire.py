@@ -685,3 +685,277 @@ def is_delta_packet(data: bytes) -> bool:
         and data[FIXED_SIZE:_DELTA_BASE] == _DELTA_NAME_BYTES
         and data[:24] == b"\x00" * 24
     )
+
+
+# ---------------------------------------------------------------------------
+# patrol-fleet: metrics-lattice gossip datagrams (``\x00pt!mtr``).
+#
+# The histograms in utils/histogram.py are G-Counter lattices (per-node
+# monotone lanes, join = per-lane-per-bucket max) and the profiling
+# counters are monotone scalars — so cluster-wide aggregation is exactly
+# the delta-mutation move of Almeida et al. (arXiv:1410.2803): ship
+# join-decompositions of the CURRENT lattice state, pairwise, on a paced
+# cadence, and let receivers max-join. Dup/reorder/stale delivery are
+# no-ops by construction; a dropped packet is subsumed by the next flush.
+#
+# Envelope: identical invisibility argument as the dv2 delta channel —
+# the first 25+L bytes form a v1 zero-state packet for a reserved name a
+# real bucket can never have, so reference peers read an incast request
+# for an unknown bucket and stay silent, and pre-fleet patrol builds
+# dispatch it to the control channel and ignore the unknown name.
+#
+# Payload (after the 32-byte envelope, all big-endian):
+#
+#   u8  version (= 1)
+#   u16 sender_slot
+#   u8  K  | K × (u16 slot | u8 len | name)          node-name map
+#   u16 Nc | Nc × (u8 len | name | u16 slot | u64 value)   counter lanes
+#   u16 Nh | Nh × (u8 len | name | u8 ulen | unit | u16 slot |
+#                  u64 sum | u8 B | B × (u8 bucket | u64 count))
+#   u8  checksum (sum of payload bytes mod 256)
+#
+# A histogram-lane entry may carry ANY SUBSET of its buckets: each
+# (histogram, lane, bucket) count is itself a join-decomposition under
+# the per-bucket max, so a lane too large for one datagram splits across
+# several and the receiver's joins reassemble it exactly. Validation is
+# all-or-nothing, like the dv2 framing.
+
+METRICS_CHANNEL_NAME = "\x00pt!mtr"
+_METRICS_NAME_BYTES = METRICS_CHANNEL_NAME.encode()
+_METRICS_BASE = FIXED_SIZE + len(_METRICS_NAME_BYTES)  # payload offset (32)
+METRICS_VERSION = 1
+_MTR_HEAD = struct.Struct(">BH")  # version | sender_slot
+_MTR_U16 = struct.Struct(">H")
+_MTR_LANE_VAL = struct.Struct(">HQ")  # slot | u64 value
+_MTR_BUCKET = struct.Struct(">BQ")  # bucket index | u64 count
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricsLane:
+    """One histogram lane's join-decomposition: the ABSOLUTE monotone
+    bucket counts (possibly a subset) plus the lane's value sum."""
+
+    name: str
+    unit: str
+    slot: int
+    sum: int
+    buckets: Tuple[Tuple[int, int], ...]  # ((bucket_index, count), ...)
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricsPacket:
+    sender_slot: int
+    node_names: Tuple[Tuple[int, str], ...]
+    counters: Tuple[Tuple[str, int, int], ...]  # (name, slot, value)
+    hists: Tuple[MetricsLane, ...]
+
+
+def _mtr_envelope() -> bytearray:
+    env = bytearray(_METRICS_BASE)
+    env[24] = len(_METRICS_NAME_BYTES)
+    env[FIXED_SIZE:] = _METRICS_NAME_BYTES
+    return env
+
+
+def metrics_lane_size(name: str, unit: str, n_buckets: int) -> int:
+    """Encoded size of one histogram-lane entry carrying n_buckets."""
+    return (
+        1 + len(name.encode("utf-8", "surrogateescape"))
+        + 1 + len(unit.encode())
+        + _MTR_LANE_VAL.size + 1 + n_buckets * _MTR_BUCKET.size
+    )
+
+
+def encode_metrics_packets(
+    sender_slot: int,
+    node_names: Sequence[Tuple[int, str]],
+    counters: Sequence[Tuple[str, int, int]],
+    hists: Sequence[MetricsLane],
+    max_size: int = DELTA_PACKET_SIZE,
+) -> List[bytes]:
+    """Pack the metric lattice's join-decompositions into as many
+    ``\\x00pt!mtr`` datagrams as fit under ``max_size``. Histogram lanes
+    whose buckets overflow the packet split across packets (per-bucket
+    counts are independent join-decompositions); an entry that cannot fit
+    even in an otherwise-empty packet is dropped (never truncated into an
+    undecodable tail). The node-name map rides every packet."""
+    out: List[bytes] = []
+    name_map = []
+    for slot, nm in node_names:
+        raw = nm.encode("utf-8", "surrogateescape")[:64]
+        name_map.append((slot & 0xFFFF, raw))
+    name_map = name_map[:255]
+    map_bytes = bytearray([len(name_map)])
+    for slot, raw in name_map:
+        map_bytes += _MTR_U16.pack(slot)
+        map_bytes.append(len(raw))
+        map_bytes += raw
+    head_cost = (
+        _METRICS_BASE + _MTR_HEAD.size + len(map_bytes)
+        + 2 * _MTR_U16.size + 1  # the two section counts + checksum
+    )
+    budget0 = max_size - head_cost
+    if budget0 <= 0:
+        raise ValueError(f"metrics packet head exceeds max_size {max_size}")
+
+    c_todo = list(counters)
+    h_todo = [
+        (lane, list(lane.buckets)) for lane in hists
+    ]  # (lane, remaining buckets)
+    while c_todo or h_todo:
+        budget = budget0
+        c_now: List[Tuple[bytes, int, int]] = []
+        while c_todo:
+            nm, slot, val = c_todo[0]
+            raw = nm.encode("utf-8", "surrogateescape")
+            sz = 1 + len(raw) + _MTR_LANE_VAL.size
+            if sz > budget:
+                if not c_now and sz > budget0:
+                    c_todo.pop(0)  # undeliverable at this MTU: drop whole
+                    continue
+                break
+            c_todo.pop(0)
+            c_now.append((raw, slot, val))
+            budget -= sz
+        h_now: List[Tuple[MetricsLane, bytes, bytes, List[Tuple[int, int]]]] = []
+        while h_todo and len(h_now) < 0xFFFF:
+            lane, rem = h_todo[0]
+            raw = lane.name.encode("utf-8", "surrogateescape")
+            uraw = lane.unit.encode()
+            head = 1 + len(raw) + 1 + len(uraw) + _MTR_LANE_VAL.size + 1
+            if head > budget0:
+                h_todo.pop(0)  # name/unit can never fit: drop whole
+                continue
+            if head + _MTR_BUCKET.size > budget and rem:
+                if head + _MTR_BUCKET.size > budget0:
+                    h_todo.pop(0)  # never fits with even one bucket: drop
+                    continue
+                break  # not even one bucket fits this packet
+            fit = min(
+                len(rem),
+                max(0, (budget - head) // _MTR_BUCKET.size),
+                255,
+            )
+            if head > budget:
+                break
+            take_b, rest = rem[:fit], rem[fit:]
+            h_now.append((lane, raw, uraw, take_b))
+            budget -= head + len(take_b) * _MTR_BUCKET.size
+            if rest:
+                h_todo[0] = (lane, rest)
+                break  # packet is full (or nearly): ship it
+            h_todo.pop(0)
+        if not c_now and not h_now:
+            break  # nothing fit (all undeliverable): stop, never spin
+        body = bytearray(
+            _MTR_HEAD.pack(METRICS_VERSION, sender_slot & 0xFFFF)
+        )
+        body += map_bytes
+        body += _MTR_U16.pack(len(c_now))
+        for raw, slot, val in c_now:
+            body.append(len(raw))
+            body += raw
+            body += _MTR_LANE_VAL.pack(
+                slot & 0xFFFF, min(max(val, 0), _INT64_MAX)
+            )
+        body += _MTR_U16.pack(len(h_now))
+        for lane, raw, uraw, buckets in h_now:
+            body.append(len(raw))
+            body += raw
+            body.append(len(uraw))
+            body += uraw
+            body += _MTR_LANE_VAL.pack(
+                lane.slot & 0xFFFF, min(max(lane.sum, 0), _INT64_MAX)
+            )
+            body.append(len(buckets))
+            for b, c in buckets:
+                body += _MTR_BUCKET.pack(b & 0xFF, min(max(c, 0), _INT64_MAX))
+        body.append(sum(body) & 0xFF)
+        out.append(bytes(_mtr_envelope()) + bytes(body))
+    return out
+
+
+def decode_metrics_packet(data: bytes) -> Optional[MetricsPacket]:
+    """Strict all-or-nothing decode of a metrics-gossip datagram; ``None``
+    for anything malformed — a corrupted lattice delta must never be
+    partially joined."""
+    end = len(data) - 1
+    if end < _METRICS_BASE + _MTR_HEAD.size + 1 + 2 * _MTR_U16.size:
+        return None
+    if (
+        data[:24] != b"\x00" * 24
+        or data[24] != len(_METRICS_NAME_BYTES)
+        or data[FIXED_SIZE:_METRICS_BASE] != _METRICS_NAME_BYTES
+    ):
+        return None
+    if data[end] != sum(data[_METRICS_BASE:end]) & 0xFF:
+        return None
+    version, sender_slot = _MTR_HEAD.unpack_from(data, _METRICS_BASE)
+    if version != METRICS_VERSION:
+        return None
+    off = _METRICS_BASE + _MTR_HEAD.size
+    try:
+        k = data[off]
+        off += 1
+        names = []
+        for _ in range(k):
+            (slot,) = _MTR_U16.unpack_from(data, off)
+            off += _MTR_U16.size
+            ln = data[off]
+            off += 1
+            if off + ln > end:
+                return None
+            names.append(
+                (slot, data[off : off + ln].decode("utf-8", "surrogateescape"))
+            )
+            off += ln
+        (nc,) = _MTR_U16.unpack_from(data, off)
+        off += _MTR_U16.size
+        counters = []
+        for _ in range(nc):
+            ln = data[off]
+            off += 1
+            if off + ln + _MTR_LANE_VAL.size > end:
+                return None
+            nm = data[off : off + ln].decode("utf-8", "surrogateescape")
+            off += ln
+            slot, val = _MTR_LANE_VAL.unpack_from(data, off)
+            off += _MTR_LANE_VAL.size
+            if val > _INT64_MAX:
+                return None
+            counters.append((nm, slot, val))
+        (nh,) = _MTR_U16.unpack_from(data, off)
+        off += _MTR_U16.size
+        hists = []
+        for _ in range(nh):
+            ln = data[off]
+            off += 1
+            if off + ln + 1 > end:
+                return None
+            nm = data[off : off + ln].decode("utf-8", "surrogateescape")
+            off += ln
+            ul = data[off]
+            off += 1
+            if off + ul + _MTR_LANE_VAL.size + 1 > end:
+                return None
+            unit = data[off : off + ul].decode("utf-8", "surrogateescape")
+            off += ul
+            slot, total = _MTR_LANE_VAL.unpack_from(data, off)
+            off += _MTR_LANE_VAL.size
+            nb = data[off]
+            off += 1
+            if off + nb * _MTR_BUCKET.size > end or total > _INT64_MAX:
+                return None
+            buckets = []
+            for _ in range(nb):
+                b, c = _MTR_BUCKET.unpack_from(data, off)
+                off += _MTR_BUCKET.size
+                if c > _INT64_MAX:
+                    return None
+                buckets.append((b, c))
+            hists.append(MetricsLane(nm, unit, slot, total, tuple(buckets)))
+    except (IndexError, struct.error):
+        return None
+    if off != end:
+        return None  # trailing garbage ⇒ reject whole
+    return MetricsPacket(sender_slot, tuple(names), tuple(counters), tuple(hists))

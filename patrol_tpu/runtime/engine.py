@@ -27,6 +27,7 @@ to preserve the unique-rows kernel invariant (sequential semantics).
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import logging
 import os
@@ -90,6 +91,29 @@ COMMIT_BLOCKS = max(1, int(os.environ.get("PATROL_COMMIT_BLOCKS", 4)))
 # bound back-pressures the feeder so a slow completer can't buffer
 # device results without limit.
 DISPATCH_AHEAD = max(2, int(os.environ.get("PATROL_DISPATCH_AHEAD", 8)))
+
+# patrol-fleet device-dispatch timing (ROADMAP item 1's r06 capture,
+# instrumentation half): every commit/take dispatch gets a device-side
+# dispatch→ready duration measured on the completion pipeline
+# (block_until_ready / result-readback deltas) into the
+# ``device_commit_ns``/``device_take_ns`` stage histograms plus a
+# per-kernel ``device_kernel_<name>_ns`` histogram. Default on (the
+# observation rides the completer thread, which blocks on device results
+# anyway); opt out for overhead experiments.
+DEVICE_TIMING = os.environ.get("PATROL_DEVICE_TIMING", "1") != "0"
+# Optional jax.profiler dispatch annotations: names the engine's kernel
+# dispatches inside an XPlane capture (/debug/jax/trace) so the r06
+# device trace attributes time to commit/take/fold kernels directly.
+DEVICE_ANNOTATIONS = os.environ.get("PATROL_DEVICE_ANNOTATIONS", "0") != "0"
+
+
+def _annotate(kernel: str):
+    """Context for one dispatch: a jax.profiler TraceAnnotation when
+    enabled, else a free nullcontext (no per-dispatch cost)."""
+    if DEVICE_ANNOTATIONS:
+        return jax.profiler.TraceAnnotation(f"patrol.{kernel}")
+    return contextlib.nullcontext()
+
 
 BroadcastFn = Callable[[List[wire.WireState]], None]
 
@@ -1899,8 +1923,10 @@ class DeviceEngine:
                 taken_nt=jnp.asarray(taken_p),
                 elapsed_ns=jnp.asarray(elapsed_p),
             )
-            with self._state_mu:
+            t0 = time.perf_counter_ns()
+            with self._state_mu, _annotate("delta_fold"):
                 self.state = delta_ops.delta_fold_jit(self.state, batch)
+            self._observe_device_commit("delta_fold", t0, n)
             self._ticks += 1
             self.directory.unpin_rows(rows[live])
             accepted += n
@@ -2897,7 +2923,8 @@ class DeviceEngine:
                 else pallas_merge.auto_pick(deltas.rows, self.config.buckets)
             )
             if use_pallas:
-                with self._state_mu:
+                t0 = time.perf_counter_ns()
+                with self._state_mu, _annotate("merge_pallas"):
                     self.state = pallas_merge.merge_batch_pallas(
                         self.state,
                         deltas.rows,
@@ -2906,6 +2933,7 @@ class DeviceEngine:
                         deltas.taken_nt,
                         deltas.elapsed_ns,
                     )
+                self._observe_device_commit("merge_pallas", t0, len(deltas))
                 self._ticks += 1
                 return
         # Tick-level fold default: ON for accelerator backends, where the
@@ -2940,7 +2968,7 @@ class DeviceEngine:
             )
             _obs_stage(hist.STAGE_H2D, t0, trace_mod.EV_H2D_PUT, len(deltas))
             t0 = time.perf_counter_ns()
-            with self._state_mu:
+            with self._state_mu, _annotate("merge_folded"):
                 if dense_dev is not None:
                     self.state = _jit_merge_rows_dense()(
                         self.state, *dense_dev
@@ -2953,6 +2981,7 @@ class DeviceEngine:
                 hist.STAGE_DISPATCH, t0, trace_mod.EV_COMMIT_DISPATCH,
                 len(deltas),
             )
+            self._observe_device_commit("merge_folded", t0, len(deltas))
             self._ticks += 1
             return
         n = len(deltas)
@@ -2967,9 +2996,10 @@ class DeviceEngine:
         packed_dev = jax.device_put(packed)  # staged ahead of the lock
         _obs_stage(hist.STAGE_H2D, t0, trace_mod.EV_H2D_PUT, n)
         t0 = time.perf_counter_ns()
-        with self._state_mu:
+        with self._state_mu, _annotate("merge_packed"):
             self.state = _jit_merge_packed()(self.state, packed_dev)
         _obs_stage(hist.STAGE_DISPATCH, t0, trace_mod.EV_COMMIT_DISPATCH, n)
+        self._observe_device_commit("merge_packed", t0, n)
         self._ticks += 1
 
     def _commit_coalesced(self, deltas: DeltaArrays) -> None:
@@ -2997,7 +3027,7 @@ class DeviceEngine:
             packed_dev = jax.device_put(packed)
             _obs_stage(hist.STAGE_H2D, t0, trace_mod.EV_H2D_PUT, len(ur))
             t0 = time.perf_counter_ns()
-            with self._state_mu:
+            with self._state_mu, _annotate("merge_folded"):
                 self.state = _jit_merge_packed_folded()(
                     self.state, packed_dev
                 )
@@ -3005,6 +3035,7 @@ class DeviceEngine:
                 hist.STAGE_DISPATCH, t0, trace_mod.EV_COMMIT_DISPATCH,
                 len(ur),
             )
+            self._observe_device_commit("merge_folded", t0, len(ur))
         else:
             shape = commit_mod.commit_shape(len(ur), MAX_MERGE_ROWS)
             buf = self._staging.lease(shape)
@@ -3015,16 +3046,55 @@ class DeviceEngine:
             dev = jax.device_put(buf)
             _obs_stage(hist.STAGE_H2D, t0, trace_mod.EV_H2D_PUT, len(ur))
             t0 = time.perf_counter_ns()
-            with self._state_mu:
+            with self._state_mu, _annotate("commit_blocks"):
                 self.state = _jit_commit_packed()(self.state, dev)
             _obs_stage(
                 hist.STAGE_DISPATCH, t0, trace_mod.EV_COMMIT_DISPATCH,
                 len(ur),
             )
+            self._observe_device_commit("commit_blocks", t0, len(ur))
             self._release_when_shipped(dev, buf)
         self._ticks += 1
         profiling.COUNTERS.inc("commit_blocks_coalesced", blocks_in)
         profiling.COUNTERS.inc("commit_dispatches")
+
+    def _device_marker(self):
+        """A tiny device value depending on the just-dispatched state —
+        ``block_until_ready`` on it observes the kernel's completion
+        without touching the (donation-chained) state buffers themselves:
+        the marker is a fresh output, so later ticks donating the state
+        away can never invalidate it."""
+        try:
+            return self.state.elapsed[:1]
+        except Exception:  # pragma: no cover - observability only
+            return None
+
+    def _observe_device_commit(
+        self, kernel: str, t_dispatch_ns: int, n: int
+    ) -> None:
+        """patrol-fleet device-dispatch timing: ride the completion
+        pipeline to record this commit dispatch's device-side
+        dispatch→ready duration into the ``device_commit_ns`` stage
+        histogram and the per-kernel histogram. The wait runs on the
+        completer thread (which blocks on device results anyway);
+        dispatch-ahead keeps the feeder unblocked."""
+        if not DEVICE_TIMING:
+            return
+        marker = self._device_marker()
+        if marker is None:
+            return
+        kh = hist.kernel_histogram(kernel)
+
+        def done() -> None:
+            jax.block_until_ready(marker)
+            dur = time.perf_counter_ns() - t_dispatch_ns
+            hist.STAGE_DEVICE_COMMIT.record(dur)
+            kh.record(dur)
+            tr = trace_mod.TRACE
+            if tr.enabled:
+                tr.record(trace_mod.EV_DEVICE_READY, dur, n)
+
+        self._enqueue_completion(done, (), {})
 
     def _release_when_shipped(self, dev, buf: np.ndarray) -> None:
         """Queue a transfer completion: return the staging buffer to the
@@ -3124,6 +3194,7 @@ class DeviceEngine:
         Chunks batches past the padded-shape cap — _pad_size clamps at
         MAX_MERGE_ROWS, so a bigger batch would otherwise overflow its
         packed matrix and fail the whole tick."""
+        t0 = time.perf_counter_ns()
         for lo in range(0, len(deltas), MAX_MERGE_ROWS):
             chunk = DeltaArrays(*(a[lo : lo + MAX_MERGE_ROWS] for a in deltas))
             n = len(chunk)
@@ -3134,11 +3205,12 @@ class DeviceEngine:
             packed[2, :n] = chunk.added_nt
             packed[3, :n] = chunk.taken_nt
             packed[4, :n] = chunk.elapsed_ns
-            with self._state_mu:
+            with self._state_mu, _annotate("merge_scalar"):
                 self.state = _jit_merge_scalar_packed()(
                     self.state, jnp.asarray(packed)
                 )
             self._ticks += 1
+        self._observe_device_commit("merge_scalar", t0, len(deltas))
 
     def _apply_takes(self, tickets: Sequence[TakeTicket]) -> None:
         keys, groups = self._group_tickets(tickets)
@@ -3163,7 +3235,7 @@ class DeviceEngine:
         packed_dev = jax.device_put(packed)  # staged ahead of the lock
         _obs_stage(hist.STAGE_H2D, t0, trace_mod.EV_H2D_PUT, len(keys))
         t0 = time.perf_counter_ns()
-        with self._state_mu:
+        with self._state_mu, _annotate("take_packed"):
             self.state, out = _jit_take_packed(self.node_slot)(
                 self.state, packed_dev
             )
@@ -3171,9 +3243,20 @@ class DeviceEngine:
             hist.STAGE_DISPATCH, t0, trace_mod.EV_COMMIT_DISPATCH, len(keys)
         )
         self._ticks += 1
+        t_dispatch = t0
+        n_keys = len(keys)
 
         def complete() -> None:
             res = np.asarray(out)  # one D2H transfer; blocks until device done
+            if DEVICE_TIMING:
+                # Device-side take duration: dispatch → results readable
+                # (the completion-pipeline readback delta, patrol-fleet).
+                dur = time.perf_counter_ns() - t_dispatch
+                hist.STAGE_DEVICE_TAKE.record(dur)
+                hist.kernel_histogram("take_packed").record(dur)
+                tr = trace_mod.TRACE
+                if tr.enabled:
+                    tr.record(trace_mod.EV_DEVICE_READY, dur, n_keys)
             # Device done ⇒ the staged request matrix is consumed on any
             # backend: recycle it.
             self._staging.release(packed)

@@ -16,6 +16,7 @@ the host router places every take in its row's home (replica, shard) block
 from __future__ import annotations
 
 import logging
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -24,6 +25,7 @@ import numpy as np
 from patrol_tpu.models.limiter import NANO, LimiterConfig
 from patrol_tpu.parallel import topology as topo
 from patrol_tpu.runtime.bucket import ClockFn, system_clock
+from patrol_tpu.runtime import engine as engine_mod
 from patrol_tpu.runtime.engine import (
     BroadcastFn,
     DeltaArrays,
@@ -32,6 +34,7 @@ from patrol_tpu.runtime.engine import (
     _jit_merge_packed,
     _pad_size,
 )
+from patrol_tpu.utils import histogram as hist
 
 log = logging.getLogger("patrol.mesh")
 
@@ -219,12 +222,19 @@ class MeshEngine(DeviceEngine):
         )
 
         req, mb = topo.route_requests(plan, takes, delta_arrays, k_take, k_merge)
+        t_dispatch = time.perf_counter_ns()
         with self._state_mu:
             self.state, res = self._step(self.state, mb, req)
         self._ticks += 1
 
         if not keys:
             jax.block_until_ready(self.state.pn)
+            if engine_mod.DEVICE_TIMING:
+                # Fused mesh step (merge-only tick): dispatch→ready delta
+                # (patrol-fleet device-dispatch timing).
+                dur = time.perf_counter_ns() - t_dispatch
+                hist.STAGE_DEVICE_COMMIT.record(dur)
+                hist.kernel_histogram("mesh_step").record(dur)
             return
 
         def complete() -> None:

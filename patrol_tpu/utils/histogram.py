@@ -204,8 +204,14 @@ class HistogramRegistry:
 
     def snapshot(self) -> Dict[str, dict]:
         """name → summary for every registered histogram (the
-        /debug/vars ``histograms`` field)."""
-        return {name: h.summary() for name, h in self.items()}
+        /debug/vars ``histograms`` field), plus a reserved ``node`` key
+        carrying this process's cluster identity (slot + configured
+        name; :func:`set_node_identity`) so merged fleet views can
+        attribute the lanes without guessing — no histogram can collide
+        with it (stage/kernel names never equal ``node``)."""
+        out: Dict[str, dict] = {"node": node_identity()}
+        out.update({name: h.summary() for name, h in self.items()})
+        return out
 
 
 HISTOGRAMS = HistogramRegistry()
@@ -222,6 +228,12 @@ TAKE_SERVICE = HISTOGRAMS.get("take_service_ns")
 RX_APPLY = HISTOGRAMS.get("replication_rx_apply_ns")
 AE_JOB = HISTOGRAMS.get("ae_job_ns")
 FRONT_WAIT = HISTOGRAMS.get("http_front_wait_ns")
+# Device-side stage histograms (patrol-fleet, ROADMAP item 1's r06
+# capture): dispatch→ready wall time of the engine's commit and take
+# kernels, measured on the completion pipeline (block_until_ready /
+# result-readback deltas in runtime/engine.py).
+STAGE_DEVICE_COMMIT = HISTOGRAMS.get("device_commit_ns")
+STAGE_DEVICE_TAKE = HISTOGRAMS.get("device_take_ns")
 
 # The bench's per-stage attribution set (benchmarks/PROBES.md).
 INGEST_STAGES = (
@@ -233,12 +245,38 @@ INGEST_STAGES = (
     "ingest_fold_ns",
 )
 
+# Device-side columns of the same breakdown (the r06 capture evidence:
+# what the DEVICE spent, not what the host waited).
+DEVICE_STAGES = (
+    "device_commit_ns",
+    "device_take_ns",
+)
+
+# Per-kernel device-duration histograms (``device_kernel_<name>_ns``):
+# one per dispatched kernel family, created on first dispatch and cached
+# here so hot paths never re-enter the registry lock per tick.
+_kernel_mu = threading.Lock()
+_kernel_hists: Dict[str, LatticeHistogram] = {}
+
+
+def kernel_histogram(kernel: str) -> LatticeHistogram:
+    h = _kernel_hists.get(kernel)
+    if h is None:
+        with _kernel_mu:
+            h = _kernel_hists.get(kernel)
+            if h is None:
+                h = HISTOGRAMS.get(f"device_kernel_{kernel}_ns")
+                _kernel_hists[kernel] = h
+    return h
+
 
 def stage_breakdown(registry: HistogramRegistry = HISTOGRAMS) -> Dict[str, dict]:
     """The ``ingest_stage_breakdown`` bench section: every ingest stage's
-    count/p50/p99 from the live histograms."""
+    count/p50/p99 from the live histograms, plus the device-side commit/
+    take columns (``device_*``, runtime/engine.py's completion-pipeline
+    block_until_ready deltas)."""
     out = {}
-    for name in INGEST_STAGES:
+    for name in INGEST_STAGES + DEVICE_STAGES:
         h = registry.get(name)
         out[name] = {
             "count": h.count,
@@ -246,6 +284,33 @@ def stage_breakdown(registry: HistogramRegistry = HISTOGRAMS) -> Dict[str, dict]
             "p99_ns": h.quantile(0.99),
         }
     return out
+
+
+def kernel_breakdown(registry: HistogramRegistry = HISTOGRAMS) -> Dict[str, dict]:
+    """Per-kernel device-duration summaries (``device_kernel_*_ns``)."""
+    return {
+        name: h.summary()
+        for name, h in registry.items()
+        if name.startswith("device_kernel_")
+    }
+
+
+# -- node identity (patrol-fleet lane attribution) ---------------------------
+
+_node_identity = {"slot": 0, "name": ""}
+
+
+def set_node_identity(slot: int, name: str) -> None:
+    """Declare this process's cluster identity (node slot + configured
+    name). Carried by the ``/debug/vars`` histogram summaries and the
+    metrics gossip so merged fleet views attribute lanes without
+    guessing. Settable once at startup (command.py)."""
+    _node_identity["slot"] = int(slot)
+    _node_identity["name"] = str(name)
+
+
+def node_identity() -> dict:
+    return dict(_node_identity)
 
 
 # -- Prometheus text exposition ----------------------------------------------
@@ -345,32 +410,109 @@ def parse_exposition(text: str) -> dict:
 
 
 def _validate_histograms(types: Dict[str, str], samples: Dict[tuple, float]) -> None:
+    """Validate every histogram series-group. Buckets are grouped by
+    their non-``le`` label set (the fleet exposition labels each node's
+    lane with ``node="<slot>"``); each group must be cumulative with a
+    matching ``_count``/``_sum`` carrying the SAME label set — the
+    unlabeled single-group case is exactly the old behavior."""
     for name, typ in types.items():
         if typ != "histogram":
             continue
-        buckets = []
-        inf = None
+        groups: Dict[tuple, dict] = {}
         for (sname, labels), val in samples.items():
             if sname == f"{name}_bucket":
+                rest = tuple(l for l in labels if l[0] != "le")
                 le = dict(labels).get("le")
                 if le is None:
                     raise ValueError(f"{name}: bucket without le label")
+                g = groups.setdefault(rest, {"buckets": [], "inf": None})
                 if le == "+Inf":
-                    inf = val
+                    g["inf"] = val
                 else:
-                    buckets.append((float(le), val))
-        if inf is None:
+                    g["buckets"].append((float(le), val))
+        if not groups:
             raise ValueError(f"{name}: histogram without +Inf bucket")
-        buckets.sort()
-        prev = 0.0
-        for le, val in buckets:
-            if val < prev:
-                raise ValueError(f"{name}: non-cumulative bucket at le={le}")
-            prev = val
-        if buckets and inf < buckets[-1][1]:
-            raise ValueError(f"{name}: +Inf below last bucket")
-        count = samples.get((f"{name}_count", ()))
-        if count is None or count != inf:
-            raise ValueError(f"{name}: _count missing or != +Inf bucket")
-        if (f"{name}_sum", ()) not in samples:
-            raise ValueError(f"{name}: _sum missing")
+        for rest, g in groups.items():
+            tag = f"{name}{dict(rest) if rest else ''}"
+            if g["inf"] is None:
+                raise ValueError(f"{tag}: histogram without +Inf bucket")
+            g["buckets"].sort()
+            prev = 0.0
+            for le, val in g["buckets"]:
+                if val < prev:
+                    raise ValueError(f"{tag}: non-cumulative bucket at le={le}")
+                prev = val
+            if g["buckets"] and g["inf"] < g["buckets"][-1][1]:
+                raise ValueError(f"{tag}: +Inf below last bucket")
+            count = samples.get((f"{name}_count", rest))
+            if count is None or count != g["inf"]:
+                raise ValueError(f"{tag}: _count missing or != +Inf bucket")
+            if (f"{name}_sum", rest) not in samples:
+                raise ValueError(f"{tag}: _sum missing")
+
+
+# -- fleet exposition (GET /cluster/metrics) ---------------------------------
+
+_LABEL_SAFE = re.compile(r"[^0-9A-Za-z_.:\-]")
+
+
+def _label_value(raw: str) -> str:
+    """Sanitized label value: the strict parser's label grammar has no
+    escape sequences, so identity labels are reduced to a safe subset."""
+    return _LABEL_SAFE.sub("_", raw)[:64]
+
+
+def render_fleet_exposition(store) -> str:
+    """Prometheus text exposition of a :class:`patrol_tpu.net.fleet.
+    FleetStore`: every gossiped counter lane as a ``node``-labeled gauge
+    and every histogram lane as a ``node``-labeled cumulative histogram —
+    strictly parseable by :func:`parse_exposition` (per-label-set
+    validation). Only non-empty lanes are emitted."""
+    lines: List[str] = []
+    snap = store.lattice_snapshot()
+    node_names = snap["node_names"]
+
+    def node_label(slot: int) -> str:
+        nm = node_names.get(slot)
+        if nm:
+            return f'node="{slot}",node_name="{_label_value(nm)}"'
+        return f'node="{slot}"'
+
+    if node_names:
+        lines.append("# TYPE patrol_cluster_node_info gauge")
+        for slot in sorted(node_names):
+            lines.append(f"patrol_cluster_node_info{{{node_label(slot)}}} 1")
+    for cname in sorted(snap["counters"]):
+        name = _metric_name("cluster_" + cname)
+        if name is None:
+            continue
+        lines.append(f"# TYPE {name} gauge")
+        for slot in sorted(snap["counters"][cname]):
+            val = snap["counters"][cname][slot]
+            lines.append(f"{name}{{{node_label(slot)}}} {val}")
+    for hname in sorted(snap["hists"]):
+        name = _metric_name("cluster_" + hname)
+        if name is None:
+            continue
+        lanes = snap["hists"][hname]
+        emitted_type = False
+        for slot in sorted(lanes):
+            counts, total = lanes[slot]
+            n = sum(counts)
+            if n == 0:
+                continue
+            if not emitted_type:
+                lines.append(f"# TYPE {name} histogram")
+                emitted_type = True
+            lbl = node_label(slot)
+            acc = 0
+            top = max((b for b, c in enumerate(counts) if c), default=-1)
+            for b in range(top + 1):
+                acc += counts[b]
+                lines.append(
+                    f'{name}_bucket{{{lbl},le="{(1 << b) - 1}"}} {acc}'
+                )
+            lines.append(f'{name}_bucket{{{lbl},le="+Inf"}} {n}')
+            lines.append(f"{name}_sum{{{lbl}}} {total}")
+            lines.append(f"{name}_count{{{lbl}}} {n}")
+    return "\n".join(lines) + "\n"

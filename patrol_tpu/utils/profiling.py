@@ -233,7 +233,12 @@ class CounterRegistry:
       ``wire_fullstate_fallbacks`` — wire-v2 delta plane (net/delta.py):
       bucket join-decompositions packed into delta-interval datagrams,
       expired intervals re-shipped, and peers dropped back to full-state
-      repair (anti-entropy) after ack loss or heal.
+      repair (anti-entropy) after ack loss or heal;
+    * ``fleet_packets_tx`` / ``fleet_packets_rx`` — patrol-fleet metrics
+      gossip datagrams shipped and joined (net/fleet.py);
+    * ``slo_breaches`` — SLO sentinel breach classes fired (take-latency
+      burn rate / stage-budget overrun, utils/slo.py — each also freezes
+      a flight-recorder anomaly snapshot).
 
     Monotonic counts + high-water gauges only; all call sites are
     per-tick/per-batch (kHz), so one mutex is noise-level overhead.
@@ -262,6 +267,9 @@ class CounterRegistry:
         "wire_deltas_batched",
         "wire_interval_retransmits",
         "wire_fullstate_fallbacks",
+        "fleet_packets_tx",
+        "fleet_packets_rx",
+        "slo_breaches",
     )
 
     def __init__(self):

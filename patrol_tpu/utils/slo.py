@@ -1,0 +1,161 @@
+"""SLO sentinel: burn-rate / stage-budget watchdogs over the patrol-scope
+latency histograms, auto-firing the flight recorder's anomaly snapshots.
+
+patrol-scope records *what happened*; this module decides *when it is
+bad enough to freeze evidence*. Two breach classes, both computed from
+cumulative histogram deltas between checks (so a check is O(histograms ×
+buckets) integer work — no sampling, no timers):
+
+* **take-latency burn rate** — the fraction of takes in the window since
+  the last check that exceeded the take budget. A window burning past
+  ``max_burn`` fires ``anomaly("slo.take_burn")``, which snapshots every
+  thread's flight-recorder ring (damped to 1/reason/s by the recorder).
+* **stage-budget overrun** — any commit-pipeline or device stage whose
+  window p99 exceeds its budget fires ``anomaly("slo.stage_budget")``.
+
+Budgets default OFF (0 = disabled) so an unconfigured process never
+snapshots itself; set them via environment (``PATROL_SLO_TAKE_P99_NS``,
+``PATROL_SLO_STAGE_P99_NS``) or programmatically (tests, operators).
+The check is driven by the fleet gossip flusher (net/fleet.py) — the
+same paced observability tick that ships the histograms — and by
+``bench.py --trend``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from patrol_tpu.utils import histogram as hist
+from patrol_tpu.utils import profiling
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+# Observations in buckets strictly ABOVE this index are guaranteed over
+# the budget (bucket b holds [2^(b-1), 2^b)); the budget's own bucket may
+# contain under-budget values, so it is not counted — conservative, never
+# a false breach from bucketing.
+def _over_bucket(budget_ns: int) -> int:
+    return hist.bucket_of(max(budget_ns, 0))
+
+
+class SloSentinel:
+    """Windowed breach detector. ``check()`` compares each watched
+    histogram's cumulative bucket counts against the last check's
+    snapshot; the difference is the window. Thread-safe; one instance
+    per process (``SENTINEL``)."""
+
+    def __init__(
+        self,
+        take_budget_ns: Optional[int] = None,
+        stage_budget_ns: Optional[int] = None,
+        max_burn: float = 0.10,
+        min_samples: int = 16,
+    ):
+        self.take_budget_ns = (
+            _env_int("PATROL_SLO_TAKE_P99_NS", 0)
+            if take_budget_ns is None
+            else take_budget_ns
+        )
+        self.stage_budget_ns = (
+            _env_int("PATROL_SLO_STAGE_P99_NS", 0)
+            if stage_budget_ns is None
+            else stage_budget_ns
+        )
+        self.max_burn = max_burn
+        self.min_samples = min_samples
+        self._mu = threading.Lock()
+        self._last: Dict[str, List[int]] = {}
+        self.breaches = 0
+
+    def configure(
+        self,
+        take_budget_ns: Optional[int] = None,
+        stage_budget_ns: Optional[int] = None,
+        max_burn: Optional[float] = None,
+        min_samples: Optional[int] = None,
+    ) -> None:
+        with self._mu:
+            if take_budget_ns is not None:
+                self.take_budget_ns = take_budget_ns
+            if stage_budget_ns is not None:
+                self.stage_budget_ns = stage_budget_ns
+            if max_burn is not None:
+                self.max_burn = max_burn
+            if min_samples is not None:
+                self.min_samples = min_samples
+
+    def _window(self, name: str, counts: List[int]) -> List[int]:
+        """Per-bucket deltas since the last check (counts are cumulative
+        monotone, so the delta is exact). First sight seeds the baseline
+        and reports an empty window — budgets judge fresh traffic only."""
+        last = self._last.get(name)
+        self._last[name] = list(counts)
+        if last is None:
+            return [0] * len(counts)
+        return [max(0, c - l) for c, l in zip(counts, last)]
+
+    def _burn(self, window: List[int], budget_ns: int) -> tuple:
+        total = sum(window)
+        over = sum(window[_over_bucket(budget_ns) + 1 :])
+        return total, (over / total if total else 0.0)
+
+    def check(
+        self, registry: Optional[hist.HistogramRegistry] = None
+    ) -> List[dict]:
+        """One sentinel pass; returns the breaches found (and fires an
+        anomaly snapshot per breach class)."""
+        from patrol_tpu.utils import trace as trace_mod
+
+        reg = registry if registry is not None else hist.HISTOGRAMS
+        breaches: List[dict] = []
+        with self._mu:
+            if self.take_budget_ns > 0:
+                h = reg.get("take_service_ns")
+                total, burn = self._burn(
+                    self._window("take_service_ns", h._merged_counts()),
+                    self.take_budget_ns,
+                )
+                if total >= self.min_samples and burn > self.max_burn:
+                    breaches.append(
+                        {
+                            "kind": "take_burn",
+                            "stage": "take_service_ns",
+                            "window": total,
+                            "burn": round(burn, 4),
+                            "budget_ns": self.take_budget_ns,
+                        }
+                    )
+            if self.stage_budget_ns > 0:
+                for name in hist.INGEST_STAGES + hist.DEVICE_STAGES:
+                    h = reg.get(name)
+                    window = self._window(name, h._merged_counts())
+                    total, burn = self._burn(window, self.stage_budget_ns)
+                    if total >= self.min_samples and burn > 0.01:
+                        # p99 over budget ⇔ >1% of the window's samples
+                        # landed in buckets strictly above it.
+                        breaches.append(
+                            {
+                                "kind": "stage_budget",
+                                "stage": name,
+                                "window": total,
+                                "burn": round(burn, 4),
+                                "budget_ns": self.stage_budget_ns,
+                            }
+                        )
+            if breaches:
+                self.breaches += len(breaches)
+        for kind in sorted({b["kind"] for b in breaches}):
+            profiling.COUNTERS.inc("slo_breaches")
+            trace_mod.anomaly(f"slo.{kind}")
+        return breaches
+
+
+SENTINEL = SloSentinel()

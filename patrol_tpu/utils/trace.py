@@ -68,6 +68,7 @@ EV_ANOMALY = 12  # anomaly marker (snapshot trigger)
 EV_DELTA_PACK = 13  # delta-plane flush: intervals packed (arg = datagrams)
 EV_DELTA_ACK = 14  # delta ack vector sent/processed (arg = acks)
 EV_DELTA_RETRANSMIT = 15  # expired intervals re-shipped (arg = intervals)
+EV_DEVICE_READY = 16  # device dispatch→ready observed (arg = work rows)
 
 EVENT_NAMES = {
     EV_TICK: "engine.tick",
@@ -85,6 +86,7 @@ EVENT_NAMES = {
     EV_DELTA_PACK: "delta.pack",
     EV_DELTA_ACK: "delta.ack",
     EV_DELTA_RETRANSMIT: "delta.retransmit",
+    EV_DEVICE_READY: "device.ready",
 }
 
 AE_PHASES = {"trigger": 1, "digest": 2, "fetch": 3}

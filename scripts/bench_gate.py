@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""patrol-fleet perf-regression sentinel (``BENCH_TREND``).
+
+The BENCH_r* receipts were write-only: every round pinned numbers into
+the repo, and nothing ever compared the next run against them — a
+regression shipped silently as a slightly different JSON line. This
+gate turns the seconds-class CI smokes (``bench.py --smoke`` /
+``--wire-smoke`` / ``--chaos-smoke``) into a *trend*:
+
+* ``benchmarks/TREND_BASELINE.json`` pins the receipt fields (seeded
+  from the BENCH_r05-era gates on this container class; re-pin by
+  running ``bench.py --trend --pin``);
+* this script compares a current run's merged fields against the
+  baseline with **noise-aware thresholds** — each numeric gate carries a
+  direction (higher-/lower-is-better), a relative tolerance sized to
+  the field's observed run-to-run noise on shared CI, and an absolute
+  floor below which a delta is never a regression;
+* boolean gates (bit-exactness, convergence, cross-mode fixpoint) and
+  the device-stage non-emptiness are hard: any flip is a regression;
+* the verdict prints as one machine-greppable line
+  (``BENCH_TREND verdict=... regressions=N checked=M``) and the exit
+  code is nonzero on regression — CI pins the verdict line.
+
+Usage::
+
+    python scripts/bench_gate.py --baseline benchmarks/TREND_BASELINE.json \
+        smoke.json wire.json chaos.json
+
+Multiple current files merge (later files win on key collisions);
+``bench.py --trend`` runs the three smokes itself and calls
+:func:`check_trend` in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Tuple
+
+# Numeric gates: direction, relative tolerance (fraction of baseline the
+# current value may regress by before it counts), and an absolute floor
+# (deltas smaller than this are noise regardless of ratio). Tolerances
+# are sized to shared-CI noise: packing ratios are highly stable
+# (deterministic seeded workloads), wall-clock-adjacent fields are not.
+TREND_GATES: Dict[str, dict] = {
+    # wire-smoke: deterministic seeded churn — tight.
+    "wire_deltas_per_packet": {"direction": "higher", "rel_tol": 0.5},
+    "wire_packet_reduction_x": {"direction": "higher", "rel_tol": 0.5},
+    "wire_tx_bytes_per_admitted_take": {
+        "direction": "lower", "rel_tol": 1.0, "abs_floor": 10.0,
+    },
+    # smoke: the workload size is pinned by the script, so a shrink means
+    # the gate itself was weakened.
+    "ingest_commit_smoke_deltas": {"direction": "higher", "rel_tol": 0.01},
+    # disabled-recorder branch cost: wall-clock-class on shared CI, so a
+    # wide ratio + an absolute floor; the smoke separately hard-fails at
+    # 1 µs.
+    "trace_off_branch_ns": {
+        "direction": "lower", "rel_tol": 4.0, "abs_floor": 500.0,
+    },
+}
+
+# Hard boolean/exactness gates: value must equal the expectation.
+EXACT_GATES: Dict[str, object] = {
+    "ingest_commit_equivalence": "bit-exact",
+    "metrics_exposition": "parsed",
+    "wire_fixpoint_equal": True,
+    "wire_converged_delta": True,
+    "wire_converged_full": True,
+    "wire_default_mode": "delta",
+    "chaos_converged": True,
+}
+
+# Device-stage columns (patrol-fleet device-dispatch timing): the smoke's
+# ingest_stage_breakdown must carry samples in these — an empty column
+# means the instrumentation half of the r06 capture silently died.
+DEVICE_STAGE_FIELDS = ("device_commit_ns", "device_take_ns")
+
+
+def merge_receipts(currents: List[dict]) -> dict:
+    out: dict = {}
+    for c in currents:
+        out.update(c)
+    return out
+
+
+def check_trend(baseline: dict, current: dict) -> Tuple[List[dict], List[str]]:
+    """→ (regressions, report lines). A regression dict names the field,
+    the values, and why it tripped."""
+    regressions: List[dict] = []
+    report: List[str] = []
+
+    for field, expect in EXACT_GATES.items():
+        got = current.get(field)
+        if got is None:
+            regressions.append(
+                {"field": field, "why": "missing", "expected": expect}
+            )
+            report.append(f"FAIL {field}: missing (expected {expect!r})")
+        elif got != expect:
+            regressions.append(
+                {"field": field, "why": "exact", "got": got, "expected": expect}
+            )
+            report.append(f"FAIL {field}: {got!r} != {expect!r}")
+        else:
+            report.append(f"ok   {field} = {got!r}")
+
+    breakdown = current.get("ingest_stage_breakdown") or {}
+    for stage in DEVICE_STAGE_FIELDS:
+        cnt = (breakdown.get(stage) or {}).get("count", 0)
+        if not cnt:
+            regressions.append(
+                {"field": f"ingest_stage_breakdown.{stage}", "why": "empty"}
+            )
+            report.append(f"FAIL device stage {stage}: no samples")
+        else:
+            report.append(f"ok   device stage {stage}: {cnt} samples")
+
+    for field, gate in TREND_GATES.items():
+        base = baseline.get(field)
+        cur = current.get(field)
+        if cur is None:
+            regressions.append({"field": field, "why": "missing"})
+            report.append(f"FAIL {field}: missing from current receipts")
+            continue
+        if base is None or not isinstance(base, (int, float)):
+            report.append(f"new  {field} = {cur} (no baseline; pin to adopt)")
+            continue
+        if not isinstance(cur, (int, float)) or isinstance(cur, bool):
+            regressions.append(
+                {"field": field, "why": "non-numeric", "got": cur}
+            )
+            report.append(f"FAIL {field}: non-numeric {cur!r}")
+            continue
+        rel_tol = gate.get("rel_tol", 0.25)
+        abs_floor = gate.get("abs_floor", 0.0)
+        if gate["direction"] == "higher":
+            limit = base * (1.0 - rel_tol)
+            bad = cur < limit and (base - cur) > abs_floor
+        else:
+            limit = base * (1.0 + rel_tol)
+            bad = cur > limit and (cur - base) > abs_floor
+        if bad:
+            regressions.append(
+                {
+                    "field": field,
+                    "why": "trend",
+                    "got": cur,
+                    "baseline": base,
+                    "limit": round(limit, 4),
+                    "direction": gate["direction"],
+                }
+            )
+            report.append(
+                f"FAIL {field}: {cur} vs baseline {base} "
+                f"({gate['direction']}-is-better, limit {limit:.4g})"
+            )
+        else:
+            report.append(f"ok   {field}: {cur} (baseline {base})")
+    return regressions, report
+
+
+def verdict_line(regressions: List[dict]) -> str:
+    checked = len(TREND_GATES) + len(EXACT_GATES) + len(DEVICE_STAGE_FIELDS)
+    verdict = "pass" if not regressions else "fail"
+    return (
+        f"BENCH_TREND verdict={verdict} regressions={len(regressions)} "
+        f"checked={checked}"
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--baseline",
+        default="benchmarks/TREND_BASELINE.json",
+        help="pinned receipts (benchmarks/TREND_BASELINE.json)",
+    )
+    ap.add_argument(
+        "currents",
+        nargs="+",
+        help="current receipt JSON files (smoke/wire-smoke/chaos-smoke "
+        "output lines; later files win on collisions)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as exc:
+        print(f"cannot read baseline {args.baseline}: {exc}", file=sys.stderr)
+        print("BENCH_TREND verdict=error regressions=-1 checked=0")
+        return 2
+    currents = []
+    for path in args.currents:
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError as exc:
+            print(f"cannot read {path}: {exc}", file=sys.stderr)
+            print("BENCH_TREND verdict=error regressions=-1 checked=0")
+            return 2
+        # A smoke's stdout may carry log lines; the receipt is the last
+        # JSON object line.
+        doc = None
+        for line in reversed(text.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    doc = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+        if doc is None:
+            print(f"no JSON receipt line in {path}", file=sys.stderr)
+            print("BENCH_TREND verdict=error regressions=-1 checked=0")
+            return 2
+        currents.append(doc)
+    regressions, report = check_trend(baseline, merge_receipts(currents))
+    for line in report:
+        print(line)
+    print(verdict_line(regressions))
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,13 +23,14 @@ extern "C" {
 int pt_udp_open(const char* ip, uint16_t port);
 int pt_udp_port(int fd);
 void pt_udp_close(int fd);
-int pt_recv_batch(int fd, uint8_t* buf, int max_packets, int* sizes,
-                  uint32_t* ips, uint16_t* ports, int timeout_ms);
+int pt_recv_batch(int fd, uint8_t* buf, int max_packets, int row_stride,
+                  int* sizes, uint32_t* ips, uint16_t* ports, int timeout_ms);
 int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes, int n,
-                   const uint32_t* peer_ips, const uint16_t* peer_ports,
-                   int n_peers);
+                   int row_stride, const uint32_t* peer_ips,
+                   const uint16_t* peer_ports, int n_peers);
 int pt_decode_batch(const uint8_t* packets, const int* sizes, int n,
-                    double* added, double* taken, uint64_t* elapsed,
+                    int in_stride, double* added, double* taken,
+                    uint64_t* elapsed,
                     uint8_t* names, int* name_lens, int* origin_slots,
                     int64_t* caps, int64_t* lane_added, int64_t* lane_taken,
                     uint64_t* name_hashes, int* multi_flags);
@@ -186,7 +187,7 @@ int main() {
       }
       pt_encode_batch(added, taken, elapsed, names, name_lens, slots, caps,
                       lane_a, lane_t, BATCH, out, sizes);
-      pt_send_fanout(tx, out, sizes, BATCH, &loop_ip, &rx_port, 1);
+      pt_send_fanout(tx, out, sizes, BATCH, PACKET, &loop_ip, &rx_port, 1);
     }
   };
 
@@ -203,10 +204,10 @@ int main() {
     uint64_t hashes[BATCH];
     int multi[BATCH];
     while (!stop.load()) {
-      int n = pt_recv_batch(rx, buf, BATCH, sizes, ips, ports, 50);
+      int n = pt_recv_batch(rx, buf, BATCH, PACKET, sizes, ips, ports, 50);
       if (n <= 0) continue;
-      pt_decode_batch(buf, sizes, n, added, taken, elapsed, names, name_lens,
-                      slots, caps, lane_a, lane_t, hashes, multi);
+      pt_decode_batch(buf, sizes, n, PACKET, added, taken, elapsed, names,
+                      name_lens, slots, caps, lane_a, lane_t, hashes, multi);
       received.fetch_add(n);
     }
   };

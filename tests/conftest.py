@@ -13,6 +13,15 @@ import re
 # TPU tunnel, where every test-sized compile costs ~20s. Unit/integration
 # tests always run on the virtual CPU mesh; only bench.py uses the chip.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# patrol-fleet metrics gossip stays MANUALLY paced under test: the chaos
+# suite's seeded faultnet streams are per-link packet-for-packet
+# deterministic, and a background 1 Hz gossip flusher interleaving extra
+# datagrams would consume rng draws at wall-clock-dependent points and
+# un-seed the schedules. Gossip behavior itself is covered by
+# tests/test_fleet.py, which drives plane.flush() explicitly (and one
+# test exercises the real flusher thread with a tight interval).
+os.environ.setdefault("PATROL_FLEET_GOSSIP_MS", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 _m = re.search(r"--xla_force_host_platform_device_count=(\d+)", _flags)
 if _m is None or int(_m.group(1)) < 8:

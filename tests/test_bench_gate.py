@@ -1,0 +1,152 @@
+"""Perf-regression sentinel tests (scripts/bench_gate.py): the trend
+gate must reject seeded regressed receipts, pass healthy ones, and stay
+noise-tolerant within the declared thresholds."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts")
+)
+import bench_gate  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def healthy_receipts():
+    """A receipt set shaped like a real --smoke/--wire-smoke/--chaos-smoke
+    merge, at the pinned baseline's values."""
+    base = json.load(open(os.path.join(REPO, "benchmarks", "TREND_BASELINE.json")))
+    out = {k: v for k, v in base.items() if not k.startswith("_")}
+    out.update(
+        {
+            "ingest_commit_equivalence": "bit-exact",
+            "metrics_exposition": "parsed",
+            "wire_fixpoint_equal": True,
+            "wire_converged_delta": True,
+            "wire_converged_full": True,
+            "wire_default_mode": "delta",
+            "chaos_converged": True,
+            "ingest_stage_breakdown": {
+                "device_commit_ns": {"count": 3, "p50_ns": 1, "p99_ns": 2},
+                "device_take_ns": {"count": 32, "p50_ns": 1, "p99_ns": 2},
+            },
+        }
+    )
+    return out
+
+
+class TestCheckTrend:
+    def test_healthy_receipts_pass(self):
+        base = json.load(
+            open(os.path.join(REPO, "benchmarks", "TREND_BASELINE.json"))
+        )
+        regressions, report = bench_gate.check_trend(base, healthy_receipts())
+        assert regressions == [], report
+        assert "verdict=pass" in bench_gate.verdict_line(regressions)
+
+    def test_seeded_regression_rejected(self):
+        """The acceptance fixture: a packing-ratio collapse far past the
+        tolerance must trip the gate."""
+        base = json.load(
+            open(os.path.join(REPO, "benchmarks", "TREND_BASELINE.json"))
+        )
+        bad = healthy_receipts()
+        bad["wire_deltas_per_packet"] = base["wire_deltas_per_packet"] * 0.2
+        regressions, _ = bench_gate.check_trend(base, bad)
+        assert any(r["field"] == "wire_deltas_per_packet" for r in regressions)
+        assert "verdict=fail" in bench_gate.verdict_line(regressions)
+
+    def test_boolean_gate_flip_rejected(self):
+        base = {"wire_deltas_per_packet": 200.0}
+        bad = healthy_receipts()
+        bad["wire_fixpoint_equal"] = False
+        regressions, _ = bench_gate.check_trend(base, bad)
+        assert any(r["field"] == "wire_fixpoint_equal" for r in regressions)
+
+    def test_empty_device_stage_rejected(self):
+        bad = healthy_receipts()
+        bad["ingest_stage_breakdown"]["device_take_ns"]["count"] = 0
+        regressions, _ = bench_gate.check_trend({}, bad)
+        assert any("device_take_ns" in r["field"] for r in regressions)
+
+    def test_noise_within_tolerance_passes(self):
+        base = json.load(
+            open(os.path.join(REPO, "benchmarks", "TREND_BASELINE.json"))
+        )
+        noisy = healthy_receipts()
+        # 20% worse packing and 2x off-branch cost: inside the declared
+        # noise envelopes, NOT a regression.
+        noisy["wire_deltas_per_packet"] = base["wire_deltas_per_packet"] * 0.8
+        noisy["trace_off_branch_ns"] = base["trace_off_branch_ns"] * 2
+        regressions, report = bench_gate.check_trend(base, noisy)
+        assert regressions == [], report
+
+    def test_missing_required_field_rejected(self):
+        good = healthy_receipts()
+        del good["chaos_converged"]
+        regressions, _ = bench_gate.check_trend({}, good)
+        assert any(r["field"] == "chaos_converged" for r in regressions)
+
+    def test_absolute_floor_guards_small_deltas(self):
+        base = {"trace_off_branch_ns": 20.0}
+        cur = healthy_receipts()
+        # 10x ratio but only an 80 ns delta — under the 500 ns floor.
+        cur["trace_off_branch_ns"] = 100.0
+        regressions, _ = bench_gate.check_trend(base, cur)
+        assert not any(
+            r["field"] == "trace_off_branch_ns" for r in regressions
+        ), regressions
+
+
+class TestCliEntry:
+    def _run(self, receipts: dict, tmp_path):
+        cur = tmp_path / "current.json"
+        cur.write_text("log line\n" + json.dumps(receipts) + "\n")
+        return subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "bench_gate.py"),
+                "--baseline",
+                os.path.join(REPO, "benchmarks", "TREND_BASELINE.json"),
+                str(cur),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+
+    def test_cli_pass_and_verdict_line(self, tmp_path):
+        proc = self._run(healthy_receipts(), tmp_path)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "BENCH_TREND verdict=pass" in proc.stdout
+
+    def test_cli_rejects_regressed_fixture(self, tmp_path):
+        bad = healthy_receipts()
+        bad["wire_deltas_per_packet"] = 3.0
+        bad["chaos_converged"] = False
+        proc = self._run(bad, tmp_path)
+        assert proc.returncode == 1
+        assert "BENCH_TREND verdict=fail" in proc.stdout
+
+    def test_cli_unreadable_baseline_is_an_error(self, tmp_path):
+        cur = tmp_path / "c.json"
+        cur.write_text(json.dumps(healthy_receipts()))
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "bench_gate.py"),
+                "--baseline",
+                str(tmp_path / "missing.json"),
+                str(cur),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 2
+        assert "verdict=error" in proc.stdout

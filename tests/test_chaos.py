@@ -490,8 +490,14 @@ class TestPartitionHealAntiEntropy:
             time.sleep(0.3)  # let intra-side replication settle
             # Counters are cumulative over the module-scoped cluster:
             # assert DELTAS across the heal window.
+            # patrol-fleet metrics gossip is constant-rate background
+            # traffic (paced, bounded) — the budget below asserts the
+            # HEAL exchange's cost, so gossip datagrams are netted out.
             before = [cmd.replicator.stats() for cmd in cluster.commands]
-            tx_before = sum(s["replication_tx_packets"] for s in before)
+            tx_before = sum(
+                s["replication_tx_packets"] - s.get("fleet_packets_tx", 0)
+                for s in before
+            )
             for fn in nets:
                 fn.heal()
             # NO take traffic from here: probes revive the dead links,
@@ -501,6 +507,7 @@ class TestPartitionHealAntiEntropy:
             assert view == (100 * NANO, 10 * NANO, 0)
             tx_spent = sum(
                 cmd.replicator.stats()["replication_tx_packets"]
+                - cmd.replicator.stats().get("fleet_packets_tx", 0)
                 for cmd in cluster.commands
             ) - tx_before
             # Budget: probes + acks + digests + fetches + pushes for ONE

