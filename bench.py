@@ -1868,6 +1868,404 @@ def wire_main() -> int:
     return 0
 
 
+def mesh_main() -> int:
+    """``bench.py --mesh [--smoke]``: the pod-scale sharded-serving
+    stage. Sweeps the fused merge+take+tree-converge step across device
+    counts (bucket rows sharded over the ``"b"`` axis), measuring
+    aggregate merges/s and take-rps per mesh size, and gates the
+    correctness invariants hard (rc != 0 on any failure):
+
+    * **MeshEngine ≡ DeviceEngine fixpoint** — the same seeded workload
+      (takes + replication deltas, frozen clocks, host fast path OFF so
+      every take rides the fused device path) must land both engines on
+      bit-exact per-bucket digests;
+    * **tree ≡ flat converge** — the hierarchical (butterfly) replica
+      reduce must match the flat all_gather join bit-for-bit on device;
+    * **device-kernel attribution** — the ``mesh_step`` kernel histogram
+      must carry samples (the patrol-fleet timing plane covers the mesh
+      path), emitted as ``mesh_kernel_step_samples``.
+
+    Scaling is REPORTED with an honest basis label: on the CI host the
+    "devices" are XLA host-platform threads sharing one core
+    (``--smoke`` forces a 4-way CPU mesh), so near-linear compute
+    scaling is not observable there — the smoke gates bit-exactness and
+    field presence, while real-chip runs gate the ≥3x aggregate target
+    at 8 devices (``mesh_scaling_verdict``). Full mode sweeps B from 1M
+    toward 100M+ as memory allows."""
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    smoke = "--smoke" in sys.argv
+    # Backend forcing must precede the first jax import. --smoke pins the
+    # seconds-class forced 4-way CPU host-device mesh (CI); full mode
+    # keeps real devices, forcing an 8-way CPU mesh only when already on
+    # the CPU backend.
+    want_devices = 4 if smoke else 8
+    if smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        import re as _re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = _re.sub(
+            r"--xla_force_host_platform_device_count=\d+\s*", "", flags
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={want_devices}"
+        ).strip()
+    # The gate is about the MESH path: host-fastpath residency would
+    # serve cold buckets in-process and keep takes off the fused step.
+    os.environ["PATROL_HOST_FASTPATH"] = "0"
+    os.environ.setdefault("PATROL_FLEET_GOSSIP_MS", "0")
+
+    OUT["metric"] = "pod-scale mesh serving (sharded fused-step scaling + fixpoint gate)"
+    OUT["unit"] = "merges/s"
+    OUT["mesh"] = True
+    OUT["mesh_smoke"] = smoke
+    t_start = time.time()
+    try:
+        import hashlib
+
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+
+        import patrol_tpu  # noqa: F401  (enables x64)
+        from patrol_tpu.models.limiter import NANO, LimiterConfig
+        from patrol_tpu.ops.rate import Rate
+        from patrol_tpu.parallel import topology as topo
+        from patrol_tpu.runtime.engine import DeviceEngine
+        from patrol_tpu.runtime.mesh_engine import MeshEngine
+        from patrol_tpu.utils import histogram as hist_mod
+
+        OUT["platform"] = jax.default_backend()
+        devices = jax.devices()
+        ndev = len(devices)
+        OUT["mesh_devices_available"] = ndev
+        on_accel = jax.default_backend() != "cpu"
+        OUT["mesh_scaling_basis"] = (
+            "device" if on_accel else "cpu-simulated-shared-core"
+        )
+
+        # -- stage 1: fused-step scaling sweep ---------------------------
+        N = 4
+        if smoke:
+            b_list = [1 << 18]
+        else:
+            b_list = [1 << 20, 1 << 24, 1 << 27]  # 1M → 16M → 134M buckets
+        d_list = [d for d in (1, 2, 4, 8) if d <= ndev]
+        k = 1 << 10  # routed rows per (replica, shard) block per dispatch
+        iters = 8 if smoke else 16
+        scaling: dict = {}
+
+        def time_step(mesh, plan, state, step, takes, deltas):
+            """Time ``iters`` fused dispatches of a fixed routed batch
+            (separate executions — no cross-dispatch CSE) and force
+            completion through the donated state at the end."""
+            take_mat, merge_mat, _ = topo.route_packed(
+                plan, takes, deltas, k, k
+            )
+            sh = topo.batch_sharding(mesh)
+            take_dev = jax.device_put(take_mat, sh)
+            merge_dev = jax.device_put(merge_mat, sh)
+            state, _ = step(state, take_dev, merge_dev)  # compile + warm
+            jax.block_until_ready(state.pn)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state, _out = step(state, take_dev, merge_dev)
+            jax.block_until_ready(state.pn)
+            return time.perf_counter() - t0, state
+
+        for B in b_list:
+            for d in d_list:
+                if B % d:
+                    continue
+                cell = {"B": B, "devices": d}
+                try:
+                    cfg = LimiterConfig(buckets=B, nodes=N)
+                    mesh = topo.make_mesh(replicas=1, devices=devices[:d])
+                    plan = topo.plan_for(mesh, cfg)
+                    state = topo.init_sharded_state(cfg, mesh)
+                    step = topo.build_cluster_step_packed(mesh, 0)
+                    blocks = plan.blocks
+                    rps = plan.rows_per_shard
+                    idx = np.arange(blocks * k, dtype=np.int64)
+                    # Block-balanced rows: shard round-robin by index,
+                    # pseudo-random local row — every block fills to
+                    # exactly k (the router raises on overflow, so an
+                    # unbalanced hash here would abort the cell).
+                    rows_bal = (idx % blocks) * rps + (idx * 2654435761) % rps
+                    deltas = (
+                        rows_bal,
+                        (idx * 40503) % N,
+                        (idx * 7919) % (10 * NANO),
+                        (idx * 104729) % (10 * NANO),
+                        (idx * 1299709) % (100 * NANO),
+                    )
+                    # merge-heavy dispatch (no takes)
+                    dt_m, state = time_step(mesh, plan, state, step, [], deltas)
+                    cell["merges_per_s"] = int(blocks * k * iters / max(dt_m, 1e-9))
+                    # take-heavy dispatch (block-balanced UNIQUE rows,
+                    # nreq=4); freq far above what the steps drain so
+                    # every step admits+commits.
+                    n_tk = min(blocks * k, 4096)
+                    takes = [
+                        (int((i % blocks) * rps + (i // blocks)),
+                         1000 * NANO, 1_000_000, NANO, NANO, 4,
+                         100 * NANO, 0)
+                        for i in range(n_tk)
+                    ]
+                    dt_t, state = time_step(mesh, plan, state, step, takes, None)
+                    served = sum(t[5] for t in takes)
+                    cell["take_rps"] = int(served * iters / max(dt_t, 1e-9))
+                    del state
+                except Exception as exc:  # OOM/unsupported cell: record, move on
+                    cell["error"] = f"{type(exc).__name__}: {exc}"
+                scaling[f"B{B}_d{d}"] = cell
+                _log(f"mesh scaling {cell}")
+                if _left() < 120 and not smoke:
+                    OUT["truncated"] = True
+                    break
+            if _left() < 120 and not smoke:
+                break
+        OUT["mesh_scaling"] = scaling
+
+        # Aggregate scaling ratios at the largest measured B: max-devices
+        # vs 1 device (the acceptance lens; honest basis label above).
+        d_max = max(
+            (c["devices"] for c in scaling.values() if "merges_per_s" in c),
+            default=1,
+        )
+        B_big = max(
+            (c["B"] for c in scaling.values()
+             if c["devices"] == d_max and "merges_per_s" in c),
+            default=0,
+        )
+        base = next(
+            (c for c in scaling.values()
+             if c["devices"] == 1 and c["B"] == B_big and "merges_per_s" in c),
+            None,
+        )
+        top = next(
+            (c for c in scaling.values()
+             if c["devices"] == d_max and c["B"] == B_big), None,
+        )
+        if base and top and base is not top:
+            OUT["mesh_scaling_merges_x"] = round(
+                top["merges_per_s"] / max(base["merges_per_s"], 1), 2
+            )
+            OUT["mesh_scaling_take_x"] = round(
+                top["take_rps"] / max(base["take_rps"], 1), 2
+            )
+        if top:
+            OUT["mesh_smoke_merges_per_s"] = top.get("merges_per_s", 0)
+            OUT["mesh_smoke_take_rps"] = top.get("take_rps", 0)
+        OUT["mesh_devices_max"] = d_max
+        # The ≥3x-at-8-devices acceptance target is only PROVABLE where
+        # devices are real compute (ICI-attached chips): label the smoke
+        # honestly instead of fabricating a verdict from shared-core
+        # threads.
+        if on_accel and d_max >= 8 and B_big >= 10_000_000:
+            ok3 = (
+                OUT.get("mesh_scaling_merges_x", 0) >= 3.0
+                and OUT.get("mesh_scaling_take_x", 0) >= 3.0
+            )
+            OUT["mesh_scaling_verdict"] = "pass" if ok3 else "below-target"
+        else:
+            OUT["mesh_scaling_verdict"] = "reported-only (simulated devices)"
+
+        # -- stage 2: tree-vs-flat converge equality on device -----------
+        replicas = 2 if ndev >= 2 else 1
+        cfg_tf = LimiterConfig(buckets=1 << 10, nodes=N)
+        mesh2 = topo.make_mesh(
+            replicas=replicas, devices=devices[: max(replicas, 2)]
+        )
+        plan2 = topo.plan_for(mesh2, cfg_tf)
+        rng = np.random.default_rng(2026)
+        kk = 256  # wide enough for 256 round-robin deltas on 2 blocks
+        takes2 = [
+            (int(r), 1000 * NANO, 100, NANO, NANO, 2, 100 * NANO, 0)
+            for r in rng.choice(cfg_tf.buckets, size=32, replace=False)
+        ]
+        deltas2 = (
+            rng.integers(0, cfg_tf.buckets, 256),
+            rng.integers(0, N, 256),
+            rng.integers(0, 10 * NANO, 256),
+            rng.integers(0, 10 * NANO, 256),
+            rng.integers(0, 100 * NANO, 256),
+        )
+        req2, mb2 = topo.route_requests(plan2, takes2, deltas2, kk, kk)
+        from functools import partial as _partial
+
+        from patrol_tpu.ops.take import TakeResult as _TR
+
+        def build2(conv_replicas):
+            fn = topo._shard_map(
+                _partial(
+                    topo.cluster_step, node_slot=0, replicas=conv_replicas
+                ),
+                mesh=mesh2,
+                in_specs=(
+                    topo.STATE_SPEC,
+                    type(mb2)(*(topo.BATCH_SPEC,) * 5),
+                    type(req2)(*(topo.BATCH_SPEC,) * 8),
+                ),
+                out_specs=(topo.STATE_SPEC, _TR(*(topo.BATCH_SPEC,) * 7)),
+                **{topo._SM_CHECK_KW: False},
+            )
+            return jax.jit(fn)
+
+        s_tree, res_tree = build2(replicas)(
+            topo.init_sharded_state(cfg_tf, mesh2), mb2, req2
+        )
+        s_flat, res_flat = build2(None)(
+            topo.init_sharded_state(cfg_tf, mesh2), mb2, req2
+        )
+        tree_ok = (
+            np.array_equal(np.asarray(s_tree.pn), np.asarray(s_flat.pn))
+            and np.array_equal(
+                np.asarray(s_tree.elapsed), np.asarray(s_flat.elapsed)
+            )
+            and np.array_equal(
+                np.asarray(res_tree.admitted), np.asarray(res_flat.admitted)
+            )
+        )
+        OUT["mesh_tree_vs_flat"] = "bit-exact" if tree_ok else "DIVERGED"
+        assert tree_ok, "tree converge diverged from the flat all_gather join"
+
+        # -- stage 3: MeshEngine ≡ DeviceEngine fixpoint ------------------
+        class _Clock:
+            def __init__(self):
+                self.now = 1_000_000
+
+            def __call__(self):
+                return self.now
+
+        cfg_e = LimiterConfig(buckets=1 << 13, nodes=N)
+        rate = Rate(freq=1000, per_ns=3600 * NANO)
+        n_buckets = 300
+        n_takes = 1500
+        n_deltas = 20_000
+        take_seq = rng.integers(0, n_buckets, n_takes)
+        d_names = [f"mx{int(i)}" for i in rng.integers(0, n_buckets, n_deltas)]
+        d_slots = rng.integers(0, N, n_deltas).astype(np.int64)
+        d_added = rng.integers(0, 1 << 40, n_deltas)
+        d_taken = rng.integers(0, 1 << 40, n_deltas)
+        d_elapsed = rng.integers(0, 1 << 40, n_deltas)
+
+        def drive(engine) -> dict:
+            try:
+                clk = engine.clock
+                for i, b in enumerate(take_seq):
+                    engine.take(f"mx{int(b)}", rate, 1)
+                    if i % 100 == 99:
+                        clk.now += NANO
+                engine.ingest_deltas_batch(
+                    d_names, d_slots, d_added, d_taken, d_elapsed
+                )
+                assert engine.flush(timeout=120), "engine flush timed out"
+                digests = {}
+                names = [f"mx{i}" for i in range(n_buckets)]
+                rows = [engine.directory.lookup(nm) for nm in names]
+                live = [(nm, r) for nm, r in zip(names, rows) if r is not None]
+                pn, el = engine.read_rows([r for _, r in live])
+                for j, (nm, _r) in enumerate(live):
+                    h = hashlib.blake2b(digest_size=8)
+                    h.update(pn[j].tobytes())
+                    h.update(int(el[j]).to_bytes(8, "little"))
+                    digests[nm] = h.hexdigest()
+                return digests
+            finally:
+                engine.stop()
+
+        mesh_replicas = 2 if ndev >= 4 else 1
+        t_fix = time.time()
+        dig_mesh = drive(
+            MeshEngine(cfg_e, replicas=mesh_replicas, node_slot=0, clock=_Clock())
+        )
+        dig_dev = drive(DeviceEngine(cfg_e, node_slot=0, clock=_Clock()))
+        fix_ok = dig_mesh == dig_dev
+        OUT["mesh_fixpoint_equal"] = bool(fix_ok)
+        OUT["mesh_fixpoint_buckets"] = len(dig_mesh)
+        OUT["mesh_fixpoint_seconds"] = round(time.time() - t_fix, 2)
+        assert fix_ok, (
+            "MeshEngine and DeviceEngine diverged on the seeded workload: "
+            + str(
+                [k for k in dig_mesh if dig_mesh[k] != dig_dev.get(k)][:5]
+            )
+        )
+
+        # -- stage 4: attribution + receipt fields ------------------------
+        kb = hist_mod.kernel_breakdown()
+        mesh_k = kb.get("device_kernel_mesh_step_ns", {"count": 0})
+        OUT["mesh_kernel_step_samples"] = int(mesh_k.get("count", 0))
+        OUT["mesh_kernel_step_p99_ns"] = mesh_k.get("p99", 0)
+        assert OUT["mesh_kernel_step_samples"] > 0, (
+            "mesh_step device-kernel histogram recorded no samples"
+        )
+        # Engine-declared constraints/attribution (satellites): the
+        # documented-and-gated demotion hole + converge kernel + tick
+        # accounting from the fixpoint engine run.
+        probe = MeshEngine(
+            cfg_e, replicas=mesh_replicas, node_slot=0, clock=_Clock()
+        )
+        try:
+            st = probe.stats()
+            # The demotion-gate measurement (satellite): what one idle-
+            # demotion window would cost against SHARDED planes — the
+            # per-row gather + zero-scatter pair resharding across the
+            # mesh. This is the number the `mesh_demotion: unsupported`
+            # receipt is justified by (and what enabling it would pay).
+            from patrol_tpu.ops.merge import zero_rows_jit
+
+            rows64 = np.arange(64, dtype=np.int32)
+            probe.read_rows(rows64)  # compile
+            reps = 10
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                probe.read_rows(rows64)
+                with probe._state_mu:
+                    probe.state = zero_rows_jit(
+                        probe.state, jnp.asarray(rows64)
+                    )
+                jax.block_until_ready(probe.state.elapsed)
+            dt_dz = time.perf_counter() - t0
+            OUT["mesh_demotion_gather_zero_us_per_row"] = round(
+                dt_dz / (reps * len(rows64)) * 1e6, 2
+            )
+        finally:
+            probe.stop()
+        OUT["mesh_demotion"] = st["mesh_demotion"]
+        OUT["mesh_converge_kernel"] = (
+            "tree" if mesh_replicas > 1 else st["mesh_converge_kernel"]
+        )
+        OUT["mesh_commit_blocks"] = st["mesh_commit_blocks"]
+        OUT["mesh_warm_max"] = st["mesh_warm_max"]
+        OUT["mesh_replicas"] = mesh_replicas
+
+        OUT["value"] = OUT.get("mesh_smoke_merges_per_s", 0)
+        OUT["mesh_seconds"] = round(time.time() - t_start, 2)
+        OUT["stages_completed"] = 1
+        OUT["stages"] = ["mesh-smoke" if smoke else "mesh"]
+        print(
+            f"BENCH_MESH verdict=pass devices={d_max} "
+            f"merges_x={OUT.get('mesh_scaling_merges_x', 1.0)} "
+            f"take_x={OUT.get('mesh_scaling_take_x', 1.0)} "
+            f"fixpoint=bit-exact tree=bit-exact"
+        )
+    except BaseException as e:
+        _log(f"mesh stage failed: {type(e).__name__}: {e}")
+        OUT["error"] = f"{type(e).__name__}: {e}"
+        OUT.setdefault("mesh_fixpoint_equal", False)
+        print("BENCH_MESH verdict=fail")
+        _emit()
+        if not isinstance(e, Exception):
+            raise
+        return 1
+    _emit()
+    return 0
+
+
 def trend_main() -> int:
     """``bench.py --trend``: the perf-regression sentinel driver. Runs
     the three seconds-class CI smokes (``--smoke`` / ``--wire-smoke`` /
@@ -1894,9 +2292,15 @@ def trend_main() -> int:
 
         merged: dict = {}
         rcs = {}
-        for flag in ("--smoke", "--wire-smoke", "--chaos-smoke"):
+        for flags in (
+            ("--smoke",),
+            ("--wire-smoke",),
+            ("--chaos-smoke",),
+            ("--mesh", "--smoke"),
+        ):
+            flag = " ".join(flags)
             proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), flag],
+                [sys.executable, os.path.abspath(__file__), *flags],
                 capture_output=True,
                 text=True,
                 timeout=600,
@@ -1976,6 +2380,8 @@ def trend_main() -> int:
 
 
 if __name__ == "__main__":
+    if "--mesh" in sys.argv:  # before --smoke: "--mesh --smoke" is a mode
+        sys.exit(mesh_main())
     if "--smoke" in sys.argv:
         sys.exit(smoke_main())
     if "--chaos-smoke" in sys.argv:

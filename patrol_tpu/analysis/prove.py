@@ -657,6 +657,119 @@ def _model_dense_join(
     return findings
 
 
+def _model_tree_converge(
+    root: ProveRoot, fn: Callable, site: Tuple[str, int]
+) -> List[Finding]:
+    """The hierarchical-converge model (parallel.topology.tree_reduce_states):
+    for each replica fan-in R — power-of-two (the distributed butterfly
+    schedule) AND ragged (the fallback, where a biased tree could silently
+    drop the tail) — the tree reduce of R stacked replica states must
+
+    * equal the FLAT elementwise-max join bit-exactly (PTP002: any
+      divergence means a tree-converged replica would disagree with the
+      all-gather join the mesh is checked against),
+    * be invariant under leaf permutation (PTP002: reduction-tree shape
+      and replica order cannot matter),
+    * absorb a duplicated leaf (PTP003: a replica counted twice — the
+      delta-CRDT re-fold property interior nodes rely on),
+    * upper-bound every leaf (PTP004: converge can only move replicas up
+      the lattice).
+    """
+    import jax
+
+    findings: List[Finding] = []
+    B, N = 1, 2
+
+    def enum_states(vals) -> Tuple[np.ndarray, np.ndarray]:
+        elems = B * N * 2 + B
+        combos = np.array(list(itertools.product(vals, repeat=elems)), np.int64)
+        pn = combos[:, : B * N * 2].reshape(-1, B, N, 2)
+        el = combos[:, B * N * 2 :].reshape(-1, B)
+        return pn, el
+
+    pn0, el0 = enum_states((0, 1, 3))
+    M = len(pn0)
+
+    def app(spn, sel):
+        def one(p, e):
+            out = fn(p, e)
+            return out.pn, out.elapsed
+
+        return jax.jit(jax.vmap(one))(spn, sel)
+
+    for R in (2, 3, 4, 8):
+        # Deterministic sliding-window stacks: every state leads one stack,
+        # with its successors (mod M) as the other leaves — M stacks per R,
+        # covering every state in every leaf position across the sweep.
+        idx = (np.arange(M)[:, None] + np.arange(R)[None, :]) % M
+        S_pn = pn0[idx]  # [M, R, B, N, 2]
+        S_el = el0[idx]  # [M, R, B]
+        want = (S_pn.max(axis=1), S_el.max(axis=1))
+        got = _chunked(app, [S_pn, S_el])
+
+        if "PTP002" in root.obligations:
+            i = _first_bad(_states_eq(got, want))
+            if i is not None:
+                findings.append(
+                    Finding(
+                        "PTP002",
+                        *site,
+                        f"[{root.name}] tree converge diverges from the flat "
+                        f"join at R={R}: reducing "
+                        f"pn={S_pn[i].reshape(R, -1).tolist()} through the "
+                        "tree != the elementwise max (replicas on different "
+                        "reduction paths would disagree)",
+                    )
+                )
+            perm = np.roll(np.arange(R), 1)
+            got_p = _chunked(app, [S_pn[:, perm], S_el[:, perm]])
+            i = _first_bad(_states_eq(got_p, got))
+            if i is not None:
+                findings.append(
+                    Finding(
+                        "PTP002",
+                        *site,
+                        f"[{root.name}] tree converge is leaf-order "
+                        f"dependent at R={R}: permuting the replica stack "
+                        "changed the join (reduction-tree shape must not "
+                        "matter)",
+                    )
+                )
+
+        if "PTP003" in root.obligations:
+            dup_pn = np.concatenate([S_pn, S_pn[:, :1]], axis=1)
+            dup_el = np.concatenate([S_el, S_el[:, :1]], axis=1)
+            got_d = _chunked(app, [dup_pn, dup_el])
+            i = _first_bad(_states_eq(got_d, want))
+            if i is not None:
+                findings.append(
+                    Finding(
+                        "PTP003",
+                        *site,
+                        f"[{root.name}] tree converge is not idempotent "
+                        f"under a duplicated leaf at R={R}+1 (a replica "
+                        "heard twice through two tree paths would inflate "
+                        "the join)",
+                    )
+                )
+
+        if "PTP004" in root.obligations:
+            ok_pn = (got[0][:, None] >= S_pn).all(axis=(1, 2, 3, 4))
+            ok_el = (got[1][:, None] >= S_el).all(axis=(1, 2))
+            i = _first_bad(ok_pn & ok_el)
+            if i is not None:
+                findings.append(
+                    Finding(
+                        "PTP004",
+                        *site,
+                        f"[{root.name}] tree converge is not an upper bound "
+                        f"of its replica inputs at R={R} (converge rolled a "
+                        "replica's state back down the lattice)",
+                    )
+                )
+    return findings
+
+
 def _model_take_monotone(
     root: ProveRoot, fn: Callable, site: Tuple[str, int]
 ) -> List[Finding]:
@@ -1088,6 +1201,7 @@ def _model_delta_roundtrip(
 
 _MODELS: Dict[str, Callable] = {
     "dense_join": _model_dense_join,
+    "tree_converge": _model_tree_converge,
     "take_monotone": _model_take_monotone,
     "scalar_monotone": _model_scalar_monotone,
     "rate_algebra": _model_rate_algebra,

@@ -634,6 +634,7 @@ class Guard:
 # The files whose thread ensemble the guarded-state pass analyzes.
 RACE_FILES: Tuple[str, ...] = (
     "patrol_tpu/runtime/engine.py",
+    "patrol_tpu/runtime/mesh_engine.py",
     "patrol_tpu/net/replication.py",
     "patrol_tpu/net/native_replication.py",
     "patrol_tpu/net/delta.py",
@@ -675,6 +676,15 @@ GUARDS: Dict[str, Dict[str, Dict[str, Guard]]] = {
             "_promoting": Guard("_host_mu", "mutate"),
             # Graceful-shutdown flush bookkeeping.
             "_dirty_names": Guard("_dirty_mu", "rw"),
+        },
+    },
+    "patrol_tpu/runtime/mesh_engine.py": {
+        "MeshEngine": {
+            # Pod-scale tick accounting: the feeder mutates it after each
+            # fused dispatch batch, API/stats threads read it — a leaf
+            # lock of its own (never nested with the engine's shared
+            # locks), so it adds no ordering edge.
+            "_mesh_metrics": Guard("_mesh_mu", "rw"),
         },
     },
     "patrol_tpu/net/replication.py": {

@@ -186,6 +186,10 @@ class Command:
                 "engine_demotions": engine.demotions,
                 "buckets": len(engine.directory),
                 "node_slot": slots.self_slot,
+                # Mesh serving (MeshEngine only): replica/shard geometry,
+                # fused-dispatch accounting, and the machine-readable
+                # `mesh_demotion: unsupported` residency constraint.
+                **(engine.stats() if hasattr(engine, "stats") else {}),
                 # Device-commit pipeline counters (staging reuse, commit
                 # coalescing, dispatch-ahead depth, rx staging).
                 **profiling.COUNTERS.snapshot(),

@@ -129,6 +129,20 @@ def _trace_merge_dense(fn) -> Trace:
     return _mk_trace(fn, _state(), _state())
 
 
+_R = 2  # traced replica fan-in: power of two ⇒ the butterfly (tree) path
+
+
+def _trace_tree_converge(fn) -> Trace:
+    # Stacked replica planes in, one converged state out: both invars are
+    # state-tainted; the leading R dim disappears, so shapes don't match.
+    return _mk_trace(
+        fn,
+        _S((_R, _B, _N, 2), jnp.int64),
+        _S((_R, _B), jnp.int64),
+        shapes_match=False,
+    )
+
+
 def _trace_read_rows(fn) -> Trace:
     return _mk_trace(fn, _state(), _vec(jnp.int32), shapes_match=False)
 
@@ -261,6 +275,17 @@ PROVE_ROOTS: Tuple[ProveRoot, ...] = (
         "ops.merge.merge_dense", "patrol_tpu.ops.merge", "merge_dense",
         _ALL, structural="join", model="dense_join",
         tracer=_trace_merge_dense,
+    ),
+    ProveRoot(
+        # The mesh converge tree (pod-scale serving): the pure butterfly-
+        # schedule twin of topology._tree_allreduce_max, model-checked for
+        # flat-vs-tree equivalence, leaf-permutation/duplication freedom,
+        # and monotonicity across power-of-two AND ragged fan-ins — the
+        # laws that make a hierarchical reduction path (Tascade,
+        # arXiv:2311.15810) bit-exact for CRDT joins (arXiv:1410.2803).
+        "parallel.topology.tree_reduce_states", "patrol_tpu.parallel.topology",
+        "tree_reduce_states", _ALL, structural="join",
+        model="tree_converge", tracer=_trace_tree_converge,
     ),
     ProveRoot(
         "ops.merge.merge_scalar_batch", "patrol_tpu.ops.merge",

@@ -106,18 +106,90 @@ def init_sharded_state(config: LimiterConfig, mesh: Mesh) -> LimiterState:
 
 
 def _allreduce_max(x: jax.Array) -> jax.Array:
-    """Max all-reduce over the replica axis, expressed as all_gather +
+    """FLAT max all-reduce over the replica axis, expressed as all_gather +
     local max: real TPU compile paths (v5e AOT, BENCH r2) reject non-Sum
     s64 all-reduces ("Supported lowering only of Sum all reduce") while
     all-gather lowers everywhere. One replica step's extra HBM is
-    replicas × block, transient, and XLA fuses the reduction."""
+    replicas × block, transient, and XLA fuses the reduction. Kept as the
+    fallback converge (non-power-of-two replica counts) and as the
+    reference the tree path is checked bit-exact against."""
     g = jax.lax.all_gather(x, REPLICA_AXIS)
     return jnp.max(g, axis=0)
 
 
-def converge(state: LimiterState) -> LimiterState:
+def _tree_allreduce_max(x: jax.Array, replicas: int) -> jax.Array:
+    """Hierarchical tree max-reduce over the replica axis (Tascade's
+    coalescing-reduction shape, arXiv:2311.15810): log2(R) rounds of
+    recursive doubling — each round every replica exchanges its partial
+    join with the partner at XOR distance 2^k (``ppermute``, point-to-
+    point over ICI) and max-joins it locally, so interior "nodes" RE-FOLD
+    before forwarding. Total traffic is R·log2(R) blocks versus the flat
+    all_gather's R·(R−1), and each round moves one block per link instead
+    of gathering the whole replica set — at R=8 that is 24 vs 56 blocks,
+    and the gap widens superlinearly with R. Exactness is free: max is
+    associative/commutative/idempotent, so ANY reduction tree computes
+    the same join bit-for-bit (the delta-CRDT composition result,
+    arXiv:1410.2803) — machine-checked by the registered
+    :func:`tree_reduce_states` prove root and pinned on-device by
+    tests/test_topology.py's tree-vs-flat equality.
+
+    Requires a power-of-two ``replicas`` (the butterfly pairing);
+    :func:`converge` falls back to the flat path otherwise. ``ppermute``
+    is pure data movement, so the v5e "Sum all reduce only" s64 lowering
+    restriction (BENCH r2) does not apply."""
+    step = 1
+    while step < replicas:
+        perm = [(i, i ^ step) for i in range(replicas)]
+        peer = jax.lax.ppermute(x, REPLICA_AXIS, perm=perm)
+        x = jnp.maximum(x, peer)
+        step <<= 1
+    return x
+
+
+def tree_join_states(a: LimiterState, b: LimiterState) -> LimiterState:
+    """The tree's interior-node join: elementwise max of both CRDT
+    planes — one node of the converge tree, host-callable for tests."""
+    return LimiterState(
+        pn=jnp.maximum(a.pn, b.pn),
+        elapsed=jnp.maximum(a.elapsed, b.elapsed),
+    )
+
+
+def tree_reduce_states(pn: jax.Array, elapsed: jax.Array) -> LimiterState:
+    """Pure (collective-free) twin of the converge tree, THE registered
+    prove root (``parallel.topology.tree_reduce_states``): reduce R
+    stacked replica states (``pn[R, B, N, 2]``, ``elapsed[R, B]``) with
+    exactly the butterfly schedule :func:`_tree_allreduce_max` runs over
+    ICI — level k joins index i with index i XOR 2^k. patrol-prove
+    traces it (PTP001/PTP005) and model-checks flat-vs-tree equivalence,
+    permutation independence, duplicate-leaf idempotence, and
+    monotonicity over enumerated lattice domains (PTP002-004) — the
+    distributed path inherits the argument because the schedule is the
+    same join tree. Non-power-of-two R folds flat (the fallback
+    :func:`converge` takes on hardware)."""
+    r = pn.shape[0]
+    if r > 1 and r & (r - 1) == 0:
+        step = 1
+        while step < r:
+            idx = jnp.arange(r, dtype=jnp.int32) ^ step
+            pn = jnp.maximum(pn, pn[idx])
+            elapsed = jnp.maximum(elapsed, elapsed[idx])
+            step <<= 1
+        return LimiterState(pn=pn[0], elapsed=elapsed[0])
+    return LimiterState(pn=jnp.max(pn, axis=0), elapsed=jnp.max(elapsed, axis=0))
+
+
+def converge(state: LimiterState, replicas: Optional[int] = None) -> LimiterState:
     """Cross-replica CvRDT join over ICI — the collective that replaces the
-    reference's per-take UDP fan-out (repo.go:129-158)."""
+    reference's per-take UDP fan-out (repo.go:129-158). With a static
+    power-of-two ``replicas`` (the builders thread it from the mesh), the
+    join runs as a hierarchical tree reduce; otherwise the flat
+    all_gather+max fallback (bit-identical by the join laws)."""
+    if replicas is not None and replicas > 1 and replicas & (replicas - 1) == 0:
+        return LimiterState(
+            pn=_tree_allreduce_max(state.pn, replicas),
+            elapsed=_tree_allreduce_max(state.elapsed, replicas),
+        )
     return LimiterState(
         pn=_allreduce_max(state.pn),
         elapsed=_allreduce_max(state.elapsed),
@@ -129,6 +201,7 @@ def cluster_step(
     deltas: MergeBatch,
     reqs: TakeRequest,
     node_slot: int,
+    replicas: Optional[int] = None,
 ) -> Tuple[LimiterState, TakeResult]:
     """One SPMD update step, per (replica, shard) block: merge this block's
     replication deltas, apply this block's takes, converge replicas.
@@ -138,14 +211,18 @@ def cluster_step(
     (replica, shard) block and every other block carries padding."""
     state = merge_batch(state, deltas)
     state, res = take_batch(state, reqs, node_slot)
-    state = converge(state)
+    state = converge(state, replicas)
     return state, res
 
 
 def build_cluster_step(mesh: Mesh, node_slot: int):
     """jit(shard_map(cluster_step)) over the mesh, with donated state."""
     fn = _shard_map(
-        partial(cluster_step, node_slot=node_slot),
+        partial(
+            cluster_step,
+            node_slot=node_slot,
+            replicas=mesh.shape[REPLICA_AXIS],
+        ),
         mesh=mesh,
         in_specs=(
             STATE_SPEC,
@@ -153,13 +230,85 @@ def build_cluster_step(mesh: Mesh, node_slot: int):
             TakeRequest(*(BATCH_SPEC,) * 8),
         ),
         out_specs=(STATE_SPEC, TakeResult(*(BATCH_SPEC,) * 7)),
-        # converge() replicates its outputs by VALUE (all_gather over the
-        # replica axis, then a local reduce — every replica computes the
+        # converge() replicates its outputs by VALUE (tree reduce or
+        # all_gather over the replica axis — every replica computes the
         # identical join), but the static varying-axes checker can only
         # prove replication for collectives like pmax, which the v5e AOT
         # compile path rejects for s64 ("Supported lowering only of Sum
         # all reduce", BENCH r2). Replication is instead asserted by
         # tests/test_topology.py's cross-replica equality checks.
+        **{_SM_CHECK_KW: False},
+    )
+    return jax.jit(fn, donate_argnums=0)
+
+
+# Packed-matrix layouts for the staged mesh step (the device-commit
+# pipeline's transfer shape, PR 5): ONE int64[8, B·k] take matrix and ONE
+# int64[5, B·k] merge matrix per dispatch instead of 13 little arrays —
+# per-array transfer setup dominates host→device latency on this stack,
+# and a single matrix can ride a reusable StagingPool buffer.
+TAKE_MAT_ROWS = 8  # rows, now_ns, freq, per_ns, count_nt, nreq, cap, created
+MERGE_MAT_ROWS = 5  # rows, slots, added_nt, taken_nt, elapsed_ns
+
+
+def batch_sharding(mesh: Mesh):
+    """NamedSharding for the packed matrices: field dim replicated, the
+    block dim split (replica-major, shard-minor) over both mesh axes."""
+    return NamedSharding(mesh, P(None, (REPLICA_AXIS, BUCKET_AXIS)))
+
+
+def build_cluster_step_packed(mesh: Mesh, node_slot: int):
+    """jit(shard_map(...)) over the mesh taking the PACKED matrices:
+    ``(state, take_mat[8, B·k_t], merge_mat[5, B·k_m])`` →
+    ``(state, out[7, B·k_t])`` with donated state — merge + take +
+    tree-converge fused in one dispatch, unpacking on-device so the host
+    ships exactly two staged transfers per tick (no host round-trips
+    between the three phases). ``out`` rows mirror the single-device
+    ``_jit_take_packed`` result stack: have_nt, admitted, own_added_nt,
+    own_taken_nt, elapsed_ns, sum_added_nt, sum_taken_nt."""
+    replicas = mesh.shape[REPLICA_AXIS]
+    mat_spec = P(None, (REPLICA_AXIS, BUCKET_AXIS))
+
+    def step(state, take_mat, merge_mat):
+        mb = MergeBatch(
+            rows=merge_mat[0].astype(jnp.int32),
+            slots=merge_mat[1].astype(jnp.int32),
+            added_nt=merge_mat[2],
+            taken_nt=merge_mat[3],
+            elapsed_ns=merge_mat[4],
+        )
+        req = TakeRequest(
+            rows=take_mat[0].astype(jnp.int32),
+            now_ns=take_mat[1],
+            freq=take_mat[2],
+            per_ns=take_mat[3],
+            count_nt=take_mat[4],
+            nreq=take_mat[5],
+            cap_base_nt=take_mat[6],
+            created_ns=take_mat[7],
+        )
+        state, res = cluster_step(
+            state, mb, req, node_slot=node_slot, replicas=replicas
+        )
+        out = jnp.stack(
+            [
+                res.have_nt,
+                res.admitted,
+                res.own_added_nt,
+                res.own_taken_nt,
+                res.elapsed_ns,
+                res.sum_added_nt,
+                res.sum_taken_nt,
+            ]
+        )
+        return state, out
+
+    fn = _shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(STATE_SPEC, mat_spec, mat_spec),
+        out_specs=(STATE_SPEC, mat_spec),
+        # See build_cluster_step: converge() replicates by value.
         **{_SM_CHECK_KW: False},
     )
     return jax.jit(fn, donate_argnums=0)
@@ -212,13 +361,79 @@ def route_requests(
     lands on its row's home replica, making it visible to same-step takes
     (useful for deterministic tests and lowest staleness). Overflowing a
     block raises — the caller batches accordingly."""
-    B = plan.blocks
-    t = {name: np.zeros((B * k_take,), dtype=np.int64) for name in TakeRequest._fields}
-    t["rows"] = np.zeros((B * k_take,), dtype=np.int32)
-    d = {name: np.zeros((B * k_merge,), dtype=np.int64) for name in MergeBatch._fields}
-    d["rows"] = np.zeros((B * k_merge,), dtype=np.int32)
-    d["slots"] = np.zeros((B * k_merge,), dtype=np.int32)
+    take_mat, merge_mat, _placed = route_packed(
+        plan, takes, deltas, k_take, k_merge, deltas_to_home=deltas_to_home
+    )
+    return (
+        TakeRequest(
+            rows=jnp.asarray(take_mat[0], jnp.int32),
+            now_ns=jnp.asarray(take_mat[1]),
+            freq=jnp.asarray(take_mat[2]),
+            per_ns=jnp.asarray(take_mat[3]),
+            count_nt=jnp.asarray(take_mat[4]),
+            nreq=jnp.asarray(take_mat[5]),
+            cap_base_nt=jnp.asarray(take_mat[6]),
+            created_ns=jnp.asarray(take_mat[7]),
+        ),
+        MergeBatch(
+            rows=jnp.asarray(merge_mat[0], jnp.int32),
+            slots=jnp.asarray(merge_mat[1], jnp.int32),
+            added_nt=jnp.asarray(merge_mat[2]),
+            taken_nt=jnp.asarray(merge_mat[3]),
+            elapsed_ns=jnp.asarray(merge_mat[4]),
+        ),
+    )
 
+
+def delta_block_assignment(
+    plan: MeshPlan, rows_a: np.ndarray, deltas_to_home: bool = False
+) -> np.ndarray:
+    """The delta→block routing rule, exposed so callers that sub-tick a
+    drain (MeshEngine) can compute per-block fills BEFORE packing:
+    shard from the row, replica round-robin by arrival index (merges are
+    idempotent joins — any replica may ingest, converge spreads them) or
+    the row's home replica with ``deltas_to_home``."""
+    K = len(rows_a)
+    shard = rows_a // plan.rows_per_shard
+    replica = (
+        rows_a % plan.replicas
+        if deltas_to_home
+        else np.arange(K, dtype=np.int64) % plan.replicas
+    )
+    return replica * plan.shards + shard
+
+
+def route_packed(
+    plan: MeshPlan,
+    takes,
+    deltas,
+    k_take: int,
+    k_merge: int,
+    take_out: Optional[np.ndarray] = None,
+    merge_out: Optional[np.ndarray] = None,
+    deltas_to_home: bool = False,
+    delta_blocks: Optional[np.ndarray] = None,
+):
+    """Packing core shared by :func:`route_requests` and the MeshEngine's
+    staged tick: fills (or allocates) the int64 ``[TAKE_MAT_ROWS, B·k_take]``
+    / ``[MERGE_MAT_ROWS, B·k_merge]`` matrices in block layout and returns
+    ``(take_mat, merge_mat, placed)`` where ``placed`` is the
+    ``(block, slot-in-block)`` of each take in input order (the completion
+    path's result indices). Caller-leased ``*_out`` buffers (StagingPool)
+    are zeroed first — padding entries MUST read as no-ops."""
+    B = plan.blocks
+    if take_out is None:
+        take_mat = np.zeros((TAKE_MAT_ROWS, B * k_take), dtype=np.int64)
+    else:
+        take_mat = take_out
+        take_mat[:] = 0
+    if merge_out is None:
+        merge_mat = np.zeros((MERGE_MAT_ROWS, B * k_merge), dtype=np.int64)
+    else:
+        merge_mat = merge_out
+        merge_mat[:] = 0
+
+    placed: list = []
     fill_t = [0] * B
     for row, now_ns, freq, per_ns, count_nt, nreq, cap_base_nt, created_ns in takes:
         replica, shard, local = plan.locate(row)
@@ -227,15 +442,16 @@ def route_requests(
         if i >= k_take:
             raise ValueError(f"take block {blk} overflow (k_take={k_take})")
         at = blk * k_take + i
-        t["rows"][at] = local
-        t["now_ns"][at] = now_ns
-        t["freq"][at] = freq
-        t["per_ns"][at] = per_ns
-        t["count_nt"][at] = count_nt
-        t["nreq"][at] = nreq
-        t["cap_base_nt"][at] = cap_base_nt
-        t["created_ns"][at] = created_ns
+        take_mat[0, at] = local
+        take_mat[1, at] = now_ns
+        take_mat[2, at] = freq
+        take_mat[3, at] = per_ns
+        take_mat[4, at] = count_nt
+        take_mat[5, at] = nreq
+        take_mat[6, at] = cap_base_nt
+        take_mat[7, at] = created_ns
         fill_t[blk] += 1
+        placed.append((blk, i))
 
     # Deltas pack vectorized — thousands per tick ride this path (takes
     # are pre-coalesced to a few keys, so their loop stays Python).
@@ -250,14 +466,12 @@ def route_requests(
             arr = np.asarray(list(deltas), dtype=np.int64).T
             rows_a, slots_a, added_a, taken_a, elapsed_a = arr
         K = len(rows_a)
-        shard = rows_a // plan.rows_per_shard
         local = rows_a % plan.rows_per_shard
-        replica = (
-            rows_a % plan.replicas
-            if deltas_to_home
-            else np.arange(K, dtype=np.int64) % plan.replicas
+        blk = (
+            delta_blocks
+            if delta_blocks is not None
+            else delta_block_assignment(plan, rows_a, deltas_to_home)
         )
-        blk = replica * plan.shards + shard
         counts = np.bincount(blk, minlength=B)
         if counts.max(initial=0) > k_merge:
             raise ValueError(
@@ -267,13 +481,10 @@ def route_requests(
         sblk = blk[order]
         run_start = np.concatenate(([0], np.cumsum(counts)))[sblk]
         at = sblk * k_merge + (np.arange(K, dtype=np.int64) - run_start)
-        d["rows"][at] = local[order]
-        d["slots"][at] = slots_a[order]
-        d["added_nt"][at] = np.maximum(added_a[order], 0)
-        d["taken_nt"][at] = np.maximum(taken_a[order], 0)
-        d["elapsed_ns"][at] = np.maximum(elapsed_a[order], 0)
+        merge_mat[0, at] = local[order]
+        merge_mat[1, at] = slots_a[order]
+        merge_mat[2, at] = np.maximum(added_a[order], 0)
+        merge_mat[3, at] = np.maximum(taken_a[order], 0)
+        merge_mat[4, at] = np.maximum(elapsed_a[order], 0)
 
-    return (
-        TakeRequest(**{k: jnp.asarray(v) for k, v in t.items()}),
-        MergeBatch(**{k: jnp.asarray(v) for k, v in d.items()}),
-    )
+    return take_mat, merge_mat, placed

@@ -5,21 +5,47 @@ Same public surface and host protocol behavior as
 over a ``(replicas × shards)`` ``jax.sharding.Mesh``
 (:mod:`patrol_tpu.parallel.topology`): bucket rows partition across the
 ``"b"`` axis, full replicas along ``"r"`` ingest disjoint slices of each
-tick's work and converge with a max all-reduce — the intra-slice analogue of
-the reference's UDP broadcast (repo.go:123-158), riding ICI.
+tick's work and converge with a hierarchical tree max-reduce — the
+intra-slice analogue of the reference's UDP broadcast (repo.go:123-158),
+riding ICI as log2(R) ppermute rounds instead of a flat all-gather
+(topology._tree_allreduce_max; Tascade's coalescing-reduction shape).
 
 Each tick fuses merge + take + converge into ONE shard_map'd device call;
 the host router places every take in its row's home (replica, shard) block
 (single-writer lanes ⇒ exact convergence) and spreads merges round-robin.
+
+Pod-scale serving pipeline (this file's PR): the tick plumbing is the
+single-device device-commit pipeline inherited intact —
+
+* the feeder drains up to ``_commit_blocks`` × MAX_MERGE_ROWS deltas per
+  tick (no more opting down to one block) and FOLDS the whole drain once
+  on host (``DeviceEngine._fold_core``), so cross-block duplicate
+  (row, slot) keys coalesce before any routing;
+* the routed take/merge matrices fill reusable :class:`StagingPool`
+  buffers and ship via ``jax.device_put`` with the mesh sharding BEFORE
+  the state lock, so the H2D transfer overlaps the previous tick's
+  compute; buffers recycle on the completer once the transfer is ready;
+* completions ride the inherited dispatch-ahead pipeline
+  (``DISPATCH_AHEAD`` deep), so result readback + ticket fanout overlap
+  the next tick's device compute;
+* a drain whose densest (replica, shard) block would pad past the warmed
+  ``MESH_WARM_MAX`` diagonal splits into sub-dispatches on the ACTUAL
+  per-block fill (not the total count): all merge chunks dispatch first,
+  then take chunks (the last merge chunk shares a dispatch with the
+  first take chunk) — bit-exact versus an unsplit tick, because merges
+  are idempotent joins, every take key rides exactly one chunk after
+  every merge landed, and take rows are unique per tick.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from patrol_tpu.models.limiter import NANO, LimiterConfig
@@ -27,41 +53,55 @@ from patrol_tpu.parallel import topology as topo
 from patrol_tpu.runtime.bucket import ClockFn, system_clock
 from patrol_tpu.runtime import engine as engine_mod
 from patrol_tpu.runtime.engine import (
+    MAX_MERGE_ROWS,
     BroadcastFn,
     DeltaArrays,
     DeviceEngine,
     TakeTicket,
+    _annotate,
     _jit_merge_packed,
+    _jit_merge_scalar_packed,
+    _obs_stage,
     _pad_size,
 )
 from patrol_tpu.utils import histogram as hist
+from patrol_tpu.utils import trace as trace_mod
 
 log = logging.getLogger("patrol.mesh")
 
 
 # The largest (diagonal) block size warmup() pre-compiles AND the hard cap
-# on any runtime tick's padded block size. _apply splits a bigger tick into
-# sequential ≤MESH_WARM_MAX sub-ticks instead of padding past the warmed
-# set — merges are idempotent CRDT joins and each take key rides exactly
-# one sub-tick, so the split is semantically just several smaller ticks,
-# and no reachable FUSED-step tick shape can JIT a fresh variant mid-serve
-# (a multi-second p99 spike on a remote-compile TPU). Scope: this covers
-# the fused merge+take+converge step only — the rare scalar-interop kernel
-# (_jit_merge_scalar_packed) still compiles lazily on its first
-# reference-peer batch per pad size.
+# on any runtime dispatch's padded block size. _apply splits a tick whose
+# densest (replica, shard) block would exceed this into sequential
+# sub-dispatches instead of padding past the warmed set — merges are
+# idempotent CRDT joins applied before every take chunk and each take key
+# rides exactly one chunk, so the split is bit-exact versus an unsplit
+# tick, and no reachable tick shape can JIT a fresh variant mid-serve (a
+# multi-second p99 spike on a remote-compile TPU). Scope: the fused
+# merge+take+converge step AND (since this PR) the scalar-interop kernel
+# — warmup() pre-compiles _jit_merge_scalar_packed's pad diagonal too, so
+# a first reference-peer batch no longer compiles lazily mid-serve.
 MESH_WARM_MAX = 1 << 12
 
 
 class MeshEngine(DeviceEngine):
-    # Idle demotion stays off here: the per-row gather/zero pair runs
-    # against SHARDED planes, whose resharding cost/shape is unmeasured —
-    # promoted rows remain device-resident as in r4.
+    # Idle demotion stays off here — DOCUMENTED AND GATED, not silent:
+    # the per-row gather/zero pair would run against SHARDED planes,
+    # where each demotion's resharding (gather across "b", zero scatter
+    # back) costs a cross-device round per window — unmeasured, and the
+    # sharded zero_rows would reshard the gathered block through host
+    # memory on the tunnel transport. stats()/bench receipts carry
+    # ``mesh_demotion: unsupported`` so the Zipf-lifecycle work (ROADMAP
+    # item 4) sees the constraint machine-readably instead of finding a
+    # silently-disabled flag.
     _demotion_capable = False
 
-    # The coalesced commit ring is a single-device kernel; the fused
-    # shard_map step routes per block itself, so one tick drains exactly
-    # one block's budget here (the r5 behavior).
-    _commit_blocks = 1
+    # NOTE: _commit_blocks is INHERITED (PATROL_COMMIT_BLOCKS, default 4)
+    # since the pod-scale PR — the fused step's host router folds and
+    # splits per block itself, so a multi-block drain coalesces into the
+    # fewest dispatches the warmed diagonal allows (previously this class
+    # opted down to 1 block per tick and left the device idle between
+    # short ticks).
 
     def __init__(
         self,
@@ -79,9 +119,23 @@ class MeshEngine(DeviceEngine):
                 f"buckets ({config.buckets}) must divide over {shards} shards"
             )
         super().__init__(config, node_slot=node_slot, clock=clock, on_broadcast=on_broadcast)
+        # Host-side mesh tick accounting, read by stats() from API
+        # threads while the feeder mutates it — its own lock (leaf-only:
+        # never held together with the engine's shared locks), registered
+        # in analysis/race.py::GUARDS like every other shared attribute.
+        self._mesh_mu = threading.Lock()
+        self._mesh_metrics: Dict[str, int] = {
+            "mesh_fused_dispatches": 0,
+            "mesh_split_ticks": 0,
+            "mesh_sub_dispatches": 0,
+            "mesh_routed_takes": 0,
+            "mesh_routed_deltas": 0,
+            "mesh_folded_dupes": 0,
+        }
         try:
             self.plan = topo.plan_for(self.mesh, config)
-            self._step = topo.build_cluster_step(self.mesh, node_slot)
+            self._step = topo.build_cluster_step_packed(self.mesh, node_slot)
+            self._mat_sharding = topo.batch_sharding(self.mesh)
             with self._state_mu:
                 self.state = topo.place_state(self.state, self.mesh)
         except BaseException:
@@ -109,169 +163,260 @@ class MeshEngine(DeviceEngine):
             deltas = DeltaArrays(*(a[~sc] for a in deltas)) if not sc.all() else None
 
         keys, groups = self._group_tickets(tickets) if tickets else ([], {})
+        try:
+            self._apply_fused(deltas, keys, groups)
+        finally:
+            if scalar_subset is not None:
+                self._apply_scalar_merges(scalar_subset)
 
-        # Split a tick that could pad past the warmed shape set into
-        # sequential sub-ticks: a chunk of ≤MESH_WARM_MAX total keys or
-        # deltas can't fill any (replica, shard) block past MESH_WARM_MAX.
-        W = MESH_WARM_MAX
-        nd = len(deltas) if deltas is not None else 0
-        n_sub = max(
-            -(-len(keys) // W) if keys else 1, -(-nd // W) if nd else 1
-        )
-        if n_sub > 1:
-            for i in range(n_sub):
-                kchunk = keys[i * W : (i + 1) * W]
-                dchunk = (
-                    DeltaArrays(*(a[i * W : (i + 1) * W] for a in deltas))
-                    if nd > i * W
-                    else None
-                )
-                try:
-                    self._apply_block(
-                        dchunk,
-                        kchunk,
-                        {k: groups[k] for k in kchunk},
-                    )
-                except Exception:
-                    # Partial-failure discipline: earlier sub-ticks already
-                    # admitted takes and debited tokens on device — their
-                    # queued completions must stand. Fail ONLY the tickets
-                    # of this and later sub-ticks, and swallow (re-raising
-                    # would make the tick loop's catch-all race those live
-                    # completions with blanket failures). Scalar deltas are
-                    # independent of the fused step; break to apply them.
-                    log.exception(
-                        "mesh sub-tick %d/%d failed; failing undispatched "
-                        "takes only",
-                        i + 1,
-                        n_sub,
-                    )
-                    self._fail_tickets(
-                        [t for k in keys[i * W :] for t in groups[k]]
-                    )
-                    break
-        else:
-            self._apply_block(deltas if nd else None, keys, groups)
-        if scalar_subset is not None:
-            self._apply_scalar_merges(scalar_subset)
-
-    def _apply_block(
+    def _apply_fused(
         self,
         deltas: Optional[DeltaArrays],
         keys: List,
         groups: Dict,
     ) -> None:
-        """One fused sub-tick whose per-block fill is ≤ MESH_WARM_MAX."""
+        """The fused mesh tick: fold the whole (multi-block) drain once,
+        route per (replica, shard) block, dispatch the fewest
+        ≤MESH_WARM_MAX-padded fused steps that cover it — merge chunks
+        strictly before take chunks (sharing the boundary dispatch), so
+        the result is bit-exact versus one unsplit dispatch."""
         plan = self.plan
-        B = plan.blocks
+        W = MESH_WARM_MAX
 
-        # Per-block occupancy → padded block capacity. Take keys are
-        # pre-coalesced (few), deltas are bulk → vectorized bincount.
-        fill_t = [0] * B
-        placed: List[Tuple[int, int]] = []  # (block, slot-in-block) per key
+        # -- fold: the coalesced-commit analogue (device-commit pipeline).
+        # Cross-block duplicate (row, slot) keys max-join on host; the
+        # per-row elapsed fold rides the row's FIRST pair (zeros
+        # elsewhere join as no-ops).
+        folded = None
+        blk_m = msub = None
+        m = 0
+        raw_n = len(deltas) if deltas is not None else 0
+        if raw_n:
+            t0 = time.perf_counter_ns()
+            ur, us, ua, ut, er, e = DeviceEngine._fold_core(deltas)
+            first = np.flatnonzero(
+                np.concatenate(([True], ur[1:] != ur[:-1]))
+            )
+            el = np.zeros(len(ur), np.int64)
+            el[first] = e
+            folded = (ur, us, ua, ut, el)
+            _obs_stage(hist.STAGE_FOLD, t0, trace_mod.EV_FOLD, raw_n)
+            # Block assignment + within-block rank → sub-dispatch index.
+            blk_m = topo.delta_block_assignment(plan, ur)
+            counts = np.bincount(blk_m, minlength=plan.blocks)
+            order = np.argsort(blk_m, kind="stable")
+            run_start = np.concatenate(([0], np.cumsum(counts)))[blk_m[order]]
+            rank = np.empty(len(ur), np.int64)
+            rank[order] = np.arange(len(ur), dtype=np.int64) - run_start
+            msub = rank // W
+            m = int(msub.max()) + 1
+
+        # -- take placement: per-block arrival rank → (chunk, slot).
+        key_sub: List[int] = []
+        fill_t = [0] * plan.blocks
         for key in keys:
-            row = key[0]
-            replica, shard, _local = plan.locate(row)
+            replica, shard, _local = plan.locate(key[0])
             blk = plan.block_index(replica, shard)
-            placed.append((blk, fill_t[blk]))
+            key_sub.append(fill_t[blk] // W)
             fill_t[blk] += 1
-        k_take = _pad_size(max(fill_t) if fill_t else 1, lo=8, hi=MESH_WARM_MAX)
+        t = (max(key_sub) + 1) if keys else 0
 
-        if deltas is not None and len(deltas):
-            d_rows = np.asarray(deltas.rows, dtype=np.int64)
-            blk = (
-                np.arange(len(d_rows), dtype=np.int64) % plan.replicas
-            ) * plan.shards + d_rows // plan.rows_per_shard
-            max_fill = int(np.bincount(blk, minlength=B).max(initial=0))
-        else:
-            max_fill = 0
-        k_merge = _pad_size(max(max_fill, 1), lo=8, hi=MESH_WARM_MAX)
-        # Square the paddings: only DIAGONAL (k, k) shapes ever compile, so
-        # warmup's size sweep covers every runtime tick — an off-diagonal
-        # (k_take, k_merge) pair would JIT a fresh variant mid-serve (a
-        # multi-second p99 spike on a remote-compile TPU). Padded rows are
-        # no-ops, so the cost is a slightly wider batch, not extra steps.
-        k_take = k_merge = max(k_take, k_merge)
+        n_dispatch = m + t - (1 if m and t else 0)
+        if n_dispatch == 0:
+            return
+        if n_dispatch > 1:
+            log.debug(
+                "mesh tick split into %d sub-dispatches (%d merge chunks, "
+                "%d take chunks)",
+                n_dispatch, m, t,
+            )
 
-        takes = []
-        for key in keys:
-            ts = groups[key]
-            first = ts[0]
-            takes.append(
-                (
-                    first.row,
-                    min(t.now_ns for t in ts),
-                    first.rate.freq,
-                    first.rate.per_ns,
-                    first.count * NANO,
-                    len(ts),
-                    int(self.directory.cap_base_nt[first.row]),
-                    int(self.directory.created_ns[first.row]),
+        take_base = (m - 1) if m else 0  # dispatch index of take chunk 0
+        failed = False
+        for d in range(n_dispatch):
+            mi = d if d < m else None
+            ti = d - take_base if (t and d >= take_base) else None
+            keys_d = (
+                [k for j, k in enumerate(keys) if key_sub[j] == ti]
+                if ti is not None
+                else []
+            )
+            try:
+                self._dispatch_fused(folded, blk_m, msub, mi, keys_d, groups)
+            except Exception:
+                # Partial-failure discipline: earlier sub-dispatches
+                # already admitted takes and debited tokens on device —
+                # their queued completions must stand. Fail ONLY the
+                # tickets of this and later chunks, and swallow
+                # (re-raising would make the tick loop's catch-all race
+                # those live completions with blanket failures).
+                log.exception(
+                    "mesh sub-dispatch %d/%d failed; failing undispatched "
+                    "takes only",
+                    d + 1,
+                    n_dispatch,
                 )
+                later = [
+                    tk
+                    for j, key in enumerate(keys)
+                    if ti is None or key_sub[j] >= ti
+                    for tk in groups[key]
+                ]
+                self._fail_tickets(later)
+                failed = True
+                break
+
+        n_pairs = len(folded[0]) if folded is not None else 0
+        with self._mesh_mu:
+            mm = self._mesh_metrics
+            mm["mesh_fused_dispatches"] += n_dispatch
+            if n_dispatch > 1 and not failed:
+                mm["mesh_split_ticks"] += 1
+                mm["mesh_sub_dispatches"] += n_dispatch
+            mm["mesh_routed_takes"] += len(keys)
+            mm["mesh_routed_deltas"] += n_pairs
+            mm["mesh_folded_dupes"] += raw_n - n_pairs
+
+    def _dispatch_fused(
+        self,
+        folded,
+        blk_m: Optional[np.ndarray],
+        msub: Optional[np.ndarray],
+        mi: Optional[int],
+        keys_d: List,
+        groups: Dict,
+    ) -> None:
+        """One fused device dispatch: the selected merge chunk + take
+        chunk, square-padded to the warmed diagonal, staged through the
+        pool and shipped sharded before the state lock."""
+        plan = self.plan
+
+        deltas_d = None
+        blk_d = None
+        max_fill_m = 0
+        if mi is not None:
+            sel = msub == mi
+            deltas_d = tuple(a[sel] for a in folded)
+            blk_d = blk_m[sel]
+            max_fill_m = int(
+                np.bincount(blk_d, minlength=plan.blocks).max(initial=0)
             )
-        delta_arrays = (
-            (
-                np.asarray(deltas.rows, np.int64),
-                np.asarray(deltas.slots, np.int64),
-                np.asarray(deltas.added_nt, np.int64),
-                np.asarray(deltas.taken_nt, np.int64),
-                np.asarray(deltas.elapsed_ns, np.int64),
-            )
-            if deltas is not None and len(deltas)
-            else None
+
+        takes_d = []
+        max_fill_t = 0
+        if keys_d:
+            fill = [0] * plan.blocks
+            for key in keys_d:
+                ts = groups[key]
+                first = ts[0]
+                replica, shard, _local = plan.locate(first.row)
+                blk = plan.block_index(replica, shard)
+                fill[blk] += 1
+                takes_d.append(
+                    (
+                        first.row,
+                        min(tk.now_ns for tk in ts),
+                        first.rate.freq,
+                        first.rate.per_ns,
+                        first.count * NANO,
+                        len(ts),
+                        int(self.directory.cap_base_nt[first.row]),
+                        int(self.directory.created_ns[first.row]),
+                    )
+                )
+            max_fill_t = max(fill)
+
+        # Square the paddings: only DIAGONAL (k, k) shapes ever compile,
+        # so warmup's size sweep covers every runtime dispatch — an
+        # off-diagonal pair would JIT a fresh variant mid-serve (a
+        # multi-second p99 spike on a remote-compile TPU). Padded entries
+        # are no-ops, so the cost is a slightly wider batch.
+        k = _pad_size(max(max_fill_m, max_fill_t, 1), lo=8, hi=MESH_WARM_MAX)
+
+        take_buf = self._staging.lease((topo.TAKE_MAT_ROWS, plan.blocks * k))
+        merge_buf = self._staging.lease((topo.MERGE_MAT_ROWS, plan.blocks * k))
+        _tm, _mm, placed = topo.route_packed(
+            plan, takes_d, deltas_d, k, k,
+            take_out=take_buf, merge_out=merge_buf, delta_blocks=blk_d,
         )
-
-        req, mb = topo.route_requests(plan, takes, delta_arrays, k_take, k_merge)
-        t_dispatch = time.perf_counter_ns()
-        with self._state_mu:
-            self.state, res = self._step(self.state, mb, req)
+        # Stage both matrices on device (sharded) BEFORE the state lock:
+        # the H2D transfer overlaps the previous dispatch's compute, and
+        # device_put copies — the staging buffers recycle once the
+        # transfer is ready, on the completer.
+        t0 = time.perf_counter_ns()
+        take_dev = jax.device_put(take_buf, self._mat_sharding)
+        merge_dev = jax.device_put(merge_buf, self._mat_sharding)
+        _obs_stage(
+            hist.STAGE_H2D, t0, trace_mod.EV_H2D_PUT,
+            len(takes_d) + (len(deltas_d[0]) if deltas_d else 0),
+        )
+        t0 = time.perf_counter_ns()
+        with self._state_mu, _annotate("mesh_step"):
+            self.state, out = self._step(self.state, take_dev, merge_dev)
+        _obs_stage(
+            hist.STAGE_DISPATCH, t0, trace_mod.EV_COMMIT_DISPATCH,
+            len(takes_d),
+        )
         self._ticks += 1
+        t_dispatch = t0
+        self._release_when_shipped(take_dev, take_buf)
+        self._release_when_shipped(merge_dev, merge_buf)
 
-        if not keys:
-            jax.block_until_ready(self.state.pn)
-            if engine_mod.DEVICE_TIMING:
-                # Fused mesh step (merge-only tick): dispatch→ready delta
-                # (patrol-fleet device-dispatch timing).
-                dur = time.perf_counter_ns() - t_dispatch
-                hist.STAGE_DEVICE_COMMIT.record(dur)
-                hist.kernel_histogram("mesh_step").record(dur)
+        if not keys_d:
+            # Merge-only dispatch: device timing rides the completion
+            # pipeline (dispatch→ready on a fresh marker), like every
+            # single-device commit kernel.
+            self._observe_device_commit(
+                "mesh_step", t_dispatch,
+                len(deltas_d[0]) if deltas_d else 0,
+            )
             return
 
-        def complete() -> None:
-            have_all = np.asarray(res.have_nt)
-            adm_all = np.asarray(res.admitted)
-            own_a_all = np.asarray(res.own_added_nt)
-            own_t_all = np.asarray(res.own_taken_nt)
-            el_all = np.asarray(res.elapsed_ns)
-            sum_a_all = np.asarray(res.sum_added_nt)
-            sum_t_all = np.asarray(res.sum_taken_nt)
+        groups_d = {key: groups[key] for key in keys_d}
+        n_keys = len(keys_d)
 
-            at = [blk * k_take + slot for blk, slot in placed]
+        def complete() -> None:
+            res = np.asarray(out)  # one D2H gather; blocks until ready
+            if engine_mod.DEVICE_TIMING:
+                dur = time.perf_counter_ns() - t_dispatch
+                hist.STAGE_DEVICE_TAKE.record(dur)
+                hist.kernel_histogram("mesh_step").record(dur)
+                tr = trace_mod.TRACE
+                if tr.enabled:
+                    tr.record(trace_mod.EV_DEVICE_READY, dur, n_keys)
+            at = [blk * k + slot for blk, slot in placed]
             self._complete_groups(
-                keys,
-                groups,
-                have_all[at],
-                adm_all[at],
-                own_a_all[at],
-                own_t_all[at],
-                el_all[at],
-                sum_a_all[at],
-                sum_t_all[at],
+                keys_d,
+                groups_d,
+                res[0][at],
+                res[1][at],
+                res[2][at],
+                res[3][at],
+                res[4][at],
+                res[5][at],
+                res[6][at],
             )
 
-        self._enqueue_completion(complete, keys, groups)
+        self._enqueue_completion(complete, keys_d, groups_d)
 
     def warmup(self) -> None:
         """Pre-compile the fused step at each padded block size — the full
-        diagonal through MESH_WARM_MAX, which _apply never exceeds (bigger
-        ticks split into sub-ticks), so the fused serve path never
-        compiles mid-serve (scalar-interop batches still compile lazily;
-        see MESH_WARM_MAX note)."""
+        diagonal through MESH_WARM_MAX, which _apply never exceeds (denser
+        ticks split into sub-dispatches) — plus the promotion-drain merge
+        diagonal, the SCALAR-INTEROP diagonal (the deficit-attribution
+        kernel previously compiled lazily on its first reference-peer
+        batch per pad size: a multi-second p99 spike on a remote-compile
+        TPU), and the introspection gathers. After this, no reachable
+        serve-path shape compiles mid-serve."""
+        blocks = self.plan.blocks
         size = 8
         while size <= MESH_WARM_MAX:
-            req, mb = topo.route_requests(self.plan, [], [], size, size)
+            tb = np.zeros((topo.TAKE_MAT_ROWS, blocks * size), np.int64)
+            mb = np.zeros((topo.MERGE_MAT_ROWS, blocks * size), np.int64)
+            take_dev = jax.device_put(tb, self._mat_sharding)
+            merge_dev = jax.device_put(mb, self._mat_sharding)
             with self._state_mu:
-                self.state, _ = self._step(self.state, mb, req)
+                self.state, _ = self._step(self.state, take_dev, merge_dev)
             size <<= 1
         # The host-fast-path promotion drain (engine._drain_promotions)
         # batches ALL pending rows' lanes into _jit_merge_packed chunks of
@@ -279,15 +424,23 @@ class MeshEngine(DeviceEngine):
         # checkpoint-restore flush_hosted) can reach any power-of-two pad
         # size, and a first GSPMD compile mid-serve is the multi-second
         # stall this warmup exists to prevent — warm the full diagonal.
-        import jax.numpy as jnp
-
-        from patrol_tpu.runtime.engine import MAX_MERGE_ROWS
-
         size = 8
         hi = _pad_size(MAX_MERGE_ROWS)
         while size <= hi:
             with self._state_mu:
                 self.state = _jit_merge_packed()(
+                    self.state, jnp.zeros((5, size), jnp.int64)
+                )
+            size <<= 1
+        # Scalar-interop (reference-peer) kernel: _apply_scalar_merges
+        # chunks at MAX_MERGE_ROWS and pads each chunk — warm the same
+        # diagonal with all-zero batches (row 0 / slot 0 / zero values:
+        # deficit attribution of zero against non-negative lanes is a
+        # no-op scatter-max, so warmed state is untouched).
+        size = 8
+        while size <= hi:
+            with self._state_mu:
+                self.state = _jit_merge_scalar_packed()(
                     self.state, jnp.zeros((5, size), jnp.int64)
                 )
             size <<= 1
@@ -297,8 +450,23 @@ class MeshEngine(DeviceEngine):
             size <<= 1
         jax.block_until_ready(self.state.pn)
 
-    def stats(self) -> Dict[str, int]:
-        return {
-            "mesh_replicas": self.plan.replicas,
-            "mesh_shards": self.plan.shards,
-        }
+    def stats(self) -> Dict[str, object]:
+        with self._mesh_mu:
+            out: Dict[str, object] = dict(self._mesh_metrics)
+        out.update(
+            mesh_replicas=self.plan.replicas,
+            mesh_shards=self.plan.shards,
+            mesh_commit_blocks=self._commit_blocks,
+            mesh_warm_max=MESH_WARM_MAX,
+            # Machine-readable residency constraint (see _demotion_capable
+            # note): consumed by bench --mesh receipts and the ROADMAP
+            # item-4 lifecycle work.
+            mesh_demotion="unsupported",
+            mesh_converge_kernel=(
+                "tree"
+                if self.plan.replicas > 1
+                and self.plan.replicas & (self.plan.replicas - 1) == 0
+                else "flat"
+            ),
+        )
+        return out

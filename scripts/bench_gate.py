@@ -59,6 +59,11 @@ TREND_GATES: Dict[str, dict] = {
     "trace_off_branch_ns": {
         "direction": "lower", "rel_tol": 4.0, "abs_floor": 500.0,
     },
+    # mesh smoke (pod-scale serving): fused-step throughput on the forced
+    # 4-way CPU mesh — wall-clock-class on a shared single core, so very
+    # wide tolerances; the bit-exactness booleans below are the hard gate.
+    "mesh_smoke_merges_per_s": {"direction": "higher", "rel_tol": 0.75},
+    "mesh_smoke_take_rps": {"direction": "higher", "rel_tol": 0.75},
 }
 
 # Hard boolean/exactness gates: value must equal the expectation.
@@ -70,7 +75,19 @@ EXACT_GATES: Dict[str, object] = {
     "wire_converged_full": True,
     "wire_default_mode": "delta",
     "chaos_converged": True,
+    # mesh smoke: engine-level cross-topology fixpoint, tree-vs-flat
+    # converge equality, the converge-kernel attribution, and the
+    # documented-and-gated demotion constraint (ROADMAP item 4 reads it).
+    "mesh_fixpoint_equal": True,
+    "mesh_tree_vs_flat": "bit-exact",
+    "mesh_converge_kernel": "tree",
+    "mesh_demotion": "unsupported",
 }
+
+# Fields that must be present AND strictly positive (no baseline needed):
+# instrumentation liveness — a zero means the device-timing plane lost
+# the mesh path.
+NONZERO_GATES = ("mesh_kernel_step_samples",)
 
 # Device-stage columns (patrol-fleet device-dispatch timing): the smoke's
 # ingest_stage_breakdown must carry samples in these — an empty column
@@ -105,6 +122,16 @@ def check_trend(baseline: dict, current: dict) -> Tuple[List[dict], List[str]]:
             report.append(f"FAIL {field}: {got!r} != {expect!r}")
         else:
             report.append(f"ok   {field} = {got!r}")
+
+    for field in NONZERO_GATES:
+        got = current.get(field)
+        if not isinstance(got, (int, float)) or isinstance(got, bool) or got <= 0:
+            regressions.append(
+                {"field": field, "why": "not-positive", "got": got}
+            )
+            report.append(f"FAIL {field}: {got!r} (must be present and > 0)")
+        else:
+            report.append(f"ok   {field} = {got}")
 
     breakdown = current.get("ingest_stage_breakdown") or {}
     for stage in DEVICE_STAGE_FIELDS:
@@ -162,7 +189,12 @@ def check_trend(baseline: dict, current: dict) -> Tuple[List[dict], List[str]]:
 
 
 def verdict_line(regressions: List[dict]) -> str:
-    checked = len(TREND_GATES) + len(EXACT_GATES) + len(DEVICE_STAGE_FIELDS)
+    checked = (
+        len(TREND_GATES)
+        + len(EXACT_GATES)
+        + len(DEVICE_STAGE_FIELDS)
+        + len(NONZERO_GATES)
+    )
     verdict = "pass" if not regressions else "fail"
     return (
         f"BENCH_TREND verdict={verdict} regressions={len(regressions)} "

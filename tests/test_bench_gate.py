@@ -31,6 +31,11 @@ def healthy_receipts():
             "wire_converged_full": True,
             "wire_default_mode": "delta",
             "chaos_converged": True,
+            "mesh_fixpoint_equal": True,
+            "mesh_tree_vs_flat": "bit-exact",
+            "mesh_converge_kernel": "tree",
+            "mesh_demotion": "unsupported",
+            "mesh_kernel_step_samples": 1501,
             "ingest_stage_breakdown": {
                 "device_commit_ns": {"count": 3, "p50_ns": 1, "p99_ns": 2},
                 "device_take_ns": {"count": 32, "p50_ns": 1, "p99_ns": 2},
@@ -73,6 +78,31 @@ class TestCheckTrend:
         bad["ingest_stage_breakdown"]["device_take_ns"]["count"] = 0
         regressions, _ = bench_gate.check_trend({}, bad)
         assert any("device_take_ns" in r["field"] for r in regressions)
+
+    def test_mesh_fixpoint_flip_rejected(self):
+        """The pod-scale hard gate: a MeshEngine≡DeviceEngine divergence
+        (or a converge kernel silently reverting to flat) must fail."""
+        bad = healthy_receipts()
+        bad["mesh_fixpoint_equal"] = False
+        regressions, _ = bench_gate.check_trend({}, bad)
+        assert any(r["field"] == "mesh_fixpoint_equal" for r in regressions)
+        bad = healthy_receipts()
+        bad["mesh_converge_kernel"] = "flat"
+        regressions, _ = bench_gate.check_trend({}, bad)
+        assert any(r["field"] == "mesh_converge_kernel" for r in regressions)
+
+    def test_mesh_kernel_samples_must_be_positive(self):
+        bad = healthy_receipts()
+        bad["mesh_kernel_step_samples"] = 0
+        regressions, _ = bench_gate.check_trend({}, bad)
+        assert any(
+            r["field"] == "mesh_kernel_step_samples" for r in regressions
+        )
+        bad.pop("mesh_kernel_step_samples")
+        regressions, _ = bench_gate.check_trend({}, bad)
+        assert any(
+            r["field"] == "mesh_kernel_step_samples" for r in regressions
+        )
 
     def test_noise_within_tolerance_passes(self):
         base = json.load(

@@ -195,11 +195,13 @@ class TestMeshCommandCluster:
 
 
 class TestWarmupCoversAllTickShapes:
-    def test_oversized_tick_splits_without_new_compile(self):
+    def test_oversized_tick_folds_without_new_compile(self):
         """Regression (VERDICT r3 weak #5): a tick whose densest block
         exceeds the warmed diagonal used to JIT a fresh variant mid-serve.
-        Now _apply splits it into ≤MESH_WARM_MAX sub-ticks, so after
-        warmup() NO reachable tick shape compiles — pinned by the jit
+        The pod-scale tick plumbing folds the drain first — this
+        hot-key-shaped drain (every (row, slot) repeated ~100×) collapses
+        to 256 unique pairs and rides ONE fused dispatch — and after
+        warmup() NO reachable tick shape compiles, pinned by the jit
         cache size staying flat across a >MESH_WARM_MAX-delta tick."""
         import numpy as np
 
@@ -213,7 +215,7 @@ class TestWarmupCoversAllTickShapes:
             compiled = eng._step._cache_size()
             assert compiled > 0
 
-            n = MESH_WARM_MAX * 2 + 777  # 3 sub-ticks, last one ragged
+            n = MESH_WARM_MAX * 2 + 777
             rows = np.arange(n, dtype=np.int64) % CFG.buckets
             slots = np.arange(n, dtype=np.int64) % CFG.nodes
             deltas = DeltaArrays(
@@ -228,12 +230,210 @@ class TestWarmupCoversAllTickShapes:
             assert eng._step._cache_size() == compiled, (
                 "oversized tick compiled a fresh jit variant mid-serve"
             )
-            # The split tick still merged everything: every (row, slot)
+            # The folded tick still merged everything: every (row, slot)
             # lane saw the same value, so each touched lane joins to N.
             pn = np.asarray(eng.state.pn)
             touched = np.zeros((CFG.buckets, CFG.nodes), bool)
             touched[rows, slots] = True
             assert (pn[..., 0][touched] == N).all()
             assert int(pn[..., 0].sum()) == touched.sum() * N
+            st = eng.stats()
+            # The hot-key drain coalesced on host instead of splitting.
+            assert st["mesh_split_ticks"] == 0
+            assert st["mesh_folded_dupes"] == n - int(touched.sum())
+            assert st["mesh_routed_deltas"] == int(touched.sum())
+        finally:
+            eng.stop()
+
+
+CFG_WIDE = LimiterConfig(buckets=65536, nodes=4)
+
+
+class TestSubTickSplitBoundary:
+    """Pod-scale satellite: a tick that straddles the MESH_WARM_MAX
+    per-block cap must split into sub-dispatches WITHOUT a fresh compile
+    and produce bit-exact results versus the unsplit semantics — all
+    merges land before every take, each take key rides exactly one
+    chunk, and the take accounting (admitted counts, remaining ladder,
+    pin releases) is exact across the split."""
+
+    def test_straddling_tick_is_bit_exact_with_take_accounting(self):
+        import numpy as np
+
+        from patrol_tpu.ops.rate import Rate as R_
+        from patrol_tpu.runtime.engine import DeltaArrays, TakeTicket
+        from patrol_tpu.runtime.mesh_engine import MESH_WARM_MAX
+
+        eng = MeshEngine(CFG_WIDE, replicas=2, node_slot=0, clock=FakeClock())
+        try:
+            # UNIQUE (row, slot) pairs confined to shard 0 (< rows_per_shard)
+            # so the fold cannot collapse them and the round-robin replica
+            # split leaves each of the two targeted blocks fuller than the
+            # warmed diagonal — a genuine straddle.
+            n = MESH_WARM_MAX * 2 + 999
+            d_rows = 100 + np.arange(n, dtype=np.int64)
+            assert int(d_rows.max()) < eng.plan.rows_per_shard
+            deltas = DeltaArrays(
+                rows=d_rows,
+                slots=np.zeros(n, np.int64),
+                added_nt=np.full(n, 7, np.int64),
+                taken_nt=np.full(n, 3, np.int64),
+                elapsed_ns=np.full(n, 11, np.int64),
+                scalar=np.zeros(n, bool),
+            )
+            # Take tickets riding the SAME tick, on rows disjoint from the
+            # delta swath: 8 distinct buckets, one of them hit 3× with the
+            # same key (nreq coalescing — the remaining ladder must hold).
+            rate = R_(freq=10, per_ns=NANO)
+            now = 0
+            tickets = []
+            for i in range(8):
+                name = f"tk{i}"
+                row, _fresh = eng._assign_pinned(name, now)
+                eng.directory.init_cap_base(row, rate.freq * NANO)
+                reps = 3 if i == 0 else 1
+                for _ in range(reps):
+                    row2, _ = eng._assign_pinned(name, now)
+                    assert row2 == row
+                    tickets.append(TakeTicket(name, row, rate, 1, now))
+                eng.directory.unpin_rows([row])
+
+            eng._apply(deltas, tickets)
+            for t in tickets:
+                assert t.wait(30), "take lost across the sub-tick split"
+                assert t.ok
+            # Per-bucket accounting: bucket 0 served 3 identical takes
+            # (9, 8, 7 remaining in arrival order), the rest one each.
+            by_name = {}
+            for t in tickets:
+                by_name.setdefault(t.name, []).append(t.remaining)
+            assert by_name["tk0"] == [9, 8, 7]
+            for i in range(1, 8):
+                assert by_name[f"tk{i}"] == [9]
+
+            st = eng.stats()
+            assert st["mesh_split_ticks"] == 1, st
+            # 2 merge chunks; the single take chunk SHARES the boundary
+            # dispatch with the last merge chunk (merges apply first
+            # inside the kernel) — the minimal schedule.
+            assert st["mesh_sub_dispatches"] == 2
+            assert st["mesh_routed_takes"] == 8
+
+            # Merge plane is bit-exact vs the flat numpy join oracle.
+            pn, el = eng.read_rows(d_rows.astype(np.int32))
+            assert (pn[:, 0, 0] == 7).all()
+            assert (pn[:, 0, 1] == 3).all()
+            assert (el == 11).all()
+        finally:
+            eng.stop()
+
+
+class TestScalarWarmupCoversInteropBatches:
+    """Pod-scale satellite: the scalar-interop (reference-peer) kernel
+    used to JIT lazily on its first batch per pad size — a multi-second
+    p99 spike on a remote-compile TPU. warmup() now pre-compiles its pad
+    diagonal; a post-warmup scalar batch must not compile anything."""
+
+    def test_no_fresh_compile_on_post_warmup_scalar_batch(self):
+        import numpy as np
+
+        from patrol_tpu.runtime.engine import (
+            DeltaArrays,
+            _jit_merge_scalar_packed,
+        )
+
+        eng = MeshEngine(CFG, replicas=2, node_slot=0, clock=FakeClock())
+        try:
+            eng.warmup()
+            compiled = _jit_merge_scalar_packed()._cache_size()
+            assert compiled > 0
+            # A reference-peer batch at an awkward (non-warm-loop) size:
+            # pads to 1024, which only the warmup can have compiled.
+            n = 1000
+            deltas = DeltaArrays(
+                rows=np.arange(n, dtype=np.int64) % CFG.buckets,
+                slots=np.arange(n, dtype=np.int64) % CFG.nodes,
+                added_nt=np.full(n, 5 * NANO, np.int64),
+                taken_nt=np.zeros(n, np.int64),
+                elapsed_ns=np.zeros(n, np.int64),
+                scalar=np.ones(n, bool),
+            )
+            eng._apply(deltas, [])
+            assert _jit_merge_scalar_packed()._cache_size() == compiled, (
+                "post-warmup scalar-interop batch compiled a fresh variant"
+            )
+        finally:
+            eng.stop()
+
+
+class TestCommitPipelineInheritance:
+    """The MeshEngine no longer opts down to one commit block: it drains
+    multi-block ticks like the single-device engine (device-commit
+    pipeline, PR 5) and the feeder-path result is bit-exact vs the host
+    max-fold."""
+
+    def test_commit_blocks_inherited(self):
+        from patrol_tpu.runtime.engine import COMMIT_BLOCKS
+
+        eng = MeshEngine(CFG, replicas=2, node_slot=0, clock=FakeClock())
+        try:
+            assert eng._commit_blocks == COMMIT_BLOCKS
+            assert eng.stats()["mesh_commit_blocks"] == COMMIT_BLOCKS
+        finally:
+            eng.stop()
+
+    def test_multiblock_feeder_drain_bit_exact(self):
+        import numpy as np
+
+        from patrol_tpu.runtime.engine import MAX_MERGE_ROWS
+
+        eng = MeshEngine(CFG_WIDE, replicas=2, node_slot=0, clock=FakeClock())
+        try:
+            rng = np.random.default_rng(2026)
+            n = MAX_MERGE_ROWS + 4096  # > one block: multi-chunk ingest
+            bidx = rng.integers(0, 512, n)
+            names = [f"k{int(i)}" for i in bidx]
+            slots = rng.integers(0, CFG_WIDE.nodes, n)
+            added = rng.integers(0, 1 << 50, n)
+            taken = rng.integers(0, 1 << 50, n)
+            elapsed = rng.integers(0, 1 << 50, n)
+            eng.ingest_deltas_batch(names, slots.astype(np.int64), added, taken, elapsed)
+            assert eng.flush(timeout=60), "mesh engine flush timed out"
+            ref_pn = np.zeros((512, CFG_WIDE.nodes, 2), np.int64)
+            ref_el = np.zeros(512, np.int64)
+            np.maximum.at(ref_pn, (bidx, slots, 0), added)
+            np.maximum.at(ref_pn, (bidx, slots, 1), taken)
+            np.maximum.at(ref_el, bidx, elapsed)
+            live = np.unique(bidx)
+            rows = [eng.directory.lookup(f"k{int(i)}") for i in live]
+            assert all(r is not None for r in rows)
+            pn, el = eng.read_rows(rows)
+            assert np.array_equal(pn, ref_pn[live]), (
+                "mesh feeder-path commit diverged from the host max-fold (pn)"
+            )
+            assert np.array_equal(el, ref_el[live])
+        finally:
+            eng.stop()
+
+
+class TestMeshStatsContract:
+    """The documented-and-gated residency constraint plus converge-kernel
+    attribution the bench receipts and ROADMAP item-4 consumers read."""
+
+    def test_demotion_gated_and_converge_attributed(self):
+        eng = MeshEngine(CFG, replicas=2, node_slot=0, clock=FakeClock())
+        try:
+            st = eng.stats()
+            assert st["mesh_demotion"] == "unsupported"
+            assert eng._demotion_capable is False
+            assert st["mesh_converge_kernel"] == "tree"
+            assert st["mesh_warm_max"] > 0
+        finally:
+            eng.stop()
+
+    def test_single_replica_reports_flat(self):
+        eng = MeshEngine(CFG, replicas=1, node_slot=0, clock=FakeClock())
+        try:
+            assert eng.stats()["mesh_converge_kernel"] == "flat"
         finally:
             eng.stop()

@@ -357,6 +357,7 @@ class TestRepoIsProven:
         for required in (
             "ops.merge.merge_batch",
             "ops.merge.merge_dense",
+            "parallel.topology.tree_reduce_states",
             "ops.merge.merge_batch_folded",
             "ops.merge.merge_rows_dense",
             "ops.merge.read_rows",
@@ -426,3 +427,70 @@ class TestDeltaObligations:
 
         f = prove.prove_root(ROOTS["encode_delta_packet"], fn=checksum_off_by_one)
         assert codes(f) == ["PTP003"]
+
+
+def tail_dropping_tree_reduce(pn, elapsed):
+    """Seeded flat-vs-tree divergence (pod-scale converge): a 'tree' that
+    folds only the power-of-two replica prefix and silently drops the
+    ragged tail — bit-identical to the real tree at R∈{2,4,8}, wrong at
+    any ragged fan-in. The model's non-power-of-two sweep must catch it."""
+    from patrol_tpu.parallel import topology as topo
+
+    r = pn.shape[0]
+    p = 1
+    while p * 2 <= r:
+        p *= 2
+    return topo.tree_reduce_states(pn[:p], elapsed[:p])
+
+
+def add_tree_reduce(pn, elapsed):
+    """Interior tree nodes summing instead of max-joining: the classic
+    reduce-tree refactor mistake (correct for a sum all-reduce, a
+    disaster for a join)."""
+    return LimiterState(pn=pn.sum(axis=0), elapsed=elapsed.sum(axis=0))
+
+
+class TestTreeConvergeObligations:
+    """The pod-scale mesh converge root (parallel.topology.
+    tree_reduce_states): full obligation set, clean on the shipped
+    butterfly schedule, and the seeded flat-vs-tree divergence + sum-tree
+    mutations are demonstrably rejected."""
+
+    def test_tree_converge_proves_clean(self):
+        assert prove.prove_root(ROOTS["tree_reduce_states"]) == []
+
+    def test_tree_converge_full_obligations_declared(self):
+        assert set(ROOTS["tree_reduce_states"].obligations) == set(
+            prove.ALL_CODES
+        )
+
+    def test_tail_dropping_tree_rejected(self):
+        """The seeded flat-vs-tree divergence mutation: identical to the
+        real schedule at every power-of-two fan-in, so only the model's
+        ragged-R flat-equivalence check can reject it."""
+        f = prove.prove_root(
+            ROOTS["tree_reduce_states"], fn=tail_dropping_tree_reduce
+        )
+        got = codes(f)
+        assert "PTP002" in got, got
+        assert any("diverges from the flat join" in fi.message for fi in f)
+
+    def test_sum_tree_rejected_by_both_passes(self):
+        f = prove.prove_root(ROOTS["tree_reduce_states"], fn=add_tree_reduce)
+        got = codes(f)
+        # Structural taint (reduce_sum on a state plane) AND the model
+        # (dup-leaf idempotence breaks; result diverges from flat max).
+        assert "PTP001" in got and "PTP002" in got and "PTP003" in got
+
+    def test_tree_matches_flat_on_stacked_states(self):
+        """Direct spot check outside the model harness: random stacks at
+        every fan-in class reduce to the elementwise max bit-exactly."""
+        from patrol_tpu.parallel import topology as topo
+
+        rng = np.random.default_rng(7)
+        for r in (1, 2, 3, 4, 5, 8):
+            pn = rng.integers(0, 1 << 50, (r, 6, 3, 2))
+            el = rng.integers(0, 1 << 50, (r, 6))
+            out = topo.tree_reduce_states(jnp.asarray(pn), jnp.asarray(el))
+            assert np.array_equal(np.asarray(out.pn), pn.max(axis=0))
+            assert np.array_equal(np.asarray(out.elapsed), el.max(axis=0))

@@ -83,8 +83,100 @@ def oracle_step(state, takes, deltas, node_slot):
     return state, results
 
 
+class TestTreeConverge:
+    """The hierarchical converge path (pod-scale serving): the butterfly
+    tree reduce must be bit-exact against BOTH the flat all_gather join
+    and the plain numpy max, on the real shard_map'd collective."""
+
+    def _run_converge(self, mesh, replicas, pn_in, el_in, tree: bool):
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from patrol_tpu.models.limiter import LimiterState
+
+        def f(pn, el):
+            st = topo.converge(
+                LimiterState(pn=pn[0], elapsed=el[0]),
+                replicas if tree else None,
+            )
+            return st.pn[None], st.elapsed[None]
+
+        fn = topo._shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(P(topo.REPLICA_AXIS), P(topo.REPLICA_AXIS)),
+            out_specs=(P(topo.REPLICA_AXIS), P(topo.REPLICA_AXIS)),
+            **{topo._SM_CHECK_KW: False},
+        )
+        return jax.jit(fn)(jnp.asarray(pn_in), jnp.asarray(el_in))
+
+    @pytest.mark.parametrize("replicas", [2, 4, 8])
+    def test_tree_matches_flat_on_device(self, replicas):
+        rng = np.random.default_rng(31 + replicas)
+        mesh = topo.make_mesh(replicas=replicas)
+        pn = rng.integers(0, 1 << 50, (replicas, 8, 4, 2))
+        el = rng.integers(0, 1 << 50, (replicas, 8))
+        tree_pn, tree_el = self._run_converge(mesh, replicas, pn, el, True)
+        flat_pn, flat_el = self._run_converge(mesh, replicas, pn, el, False)
+        want_pn = pn.max(axis=0)
+        want_el = el.max(axis=0)
+        for r in range(replicas):
+            # Every replica holds the identical, exact global join —
+            # tree and flat bit-for-bit.
+            assert np.array_equal(np.asarray(tree_pn)[r], want_pn)
+            assert np.array_equal(np.asarray(tree_el)[r], want_el)
+            assert np.array_equal(np.asarray(flat_pn)[r], want_pn)
+            assert np.array_equal(np.asarray(flat_el)[r], want_el)
+
+    def test_non_power_of_two_falls_back_flat(self):
+        """A ragged replica fan-in (3×2 mesh over 6 devices) routes
+        through the flat all_gather fallback and still joins exactly."""
+        rng = np.random.default_rng(99)
+        mesh = topo.make_mesh(replicas=3, devices=jax.devices()[:6])
+        pn = rng.integers(0, 1 << 50, (3, 4, 2, 2))
+        el = rng.integers(0, 1 << 50, (3, 4))
+        got_pn, got_el = self._run_converge(mesh, 3, pn, el, True)
+        for r in range(3):
+            assert np.array_equal(np.asarray(got_pn)[r], pn.max(axis=0))
+            assert np.array_equal(np.asarray(got_el)[r], el.max(axis=0))
+
+    def test_packed_step_matches_unpacked(self):
+        """build_cluster_step_packed (the StagingPool transfer shape) is
+        bit-exact against the unpacked step on identically routed work."""
+        rng = random.Random(5)
+        mesh = topo.make_mesh(replicas=2)
+        plan = topo.plan_for(mesh, CFG)
+        takes, deltas = random_ops(rng, n_takes=8, n_deltas=24, now=NANO)
+        k = 16
+        take_mat, merge_mat, placed = topo.route_packed(
+            plan, takes, deltas, k, k
+        )
+        req, mb = topo.route_requests(plan, takes, deltas, k, k)
+
+        s1 = topo.init_sharded_state(CFG, mesh)
+        step = topo.build_cluster_step(mesh, 0)
+        s1, res1 = step(s1, mb, req)
+
+        s2 = topo.init_sharded_state(CFG, mesh)
+        packed = topo.build_cluster_step_packed(mesh, 0)
+        s2, out = packed(
+            s2,
+            jax.device_put(take_mat, topo.batch_sharding(mesh)),
+            jax.device_put(merge_mat, topo.batch_sharding(mesh)),
+        )
+        assert (np.asarray(s1.pn) == np.asarray(s2.pn)).all()
+        assert (np.asarray(s1.elapsed) == np.asarray(s2.elapsed)).all()
+        out = np.asarray(out)
+        assert np.array_equal(out[0], np.asarray(res1.have_nt))
+        assert np.array_equal(out[1], np.asarray(res1.admitted))
+        # placed indexes the packed result exactly like the routed one.
+        for (blk, slot), t in zip(placed, takes):
+            assert out[0][blk * k + slot] == int(
+                np.asarray(res1.have_nt)[blk * k + slot]
+            )
+
+
 class TestMeshEquivalence:
-    @pytest.mark.parametrize("replicas", [1, 2, 4])
+    @pytest.mark.parametrize("replicas", [1, 2, 4, 8])
     def test_cluster_step_matches_single_device(self, replicas):
         rng = random.Random(11 + replicas)
         mesh = topo.make_mesh(replicas=replicas)
