@@ -2233,8 +2233,26 @@ def mesh_main() -> int:
             OUT["mesh_demotion_gather_zero_us_per_row"] = round(
                 dt_dz / (reps * len(rows64)) * 1e6, 2
             )
+            # Bucket-lifecycle satellite: the mesh DOES shed cold state —
+            # host-directory GC over the sharded planes (probe + zero as
+            # GSPMD programs). Measure one sweep's cost per reclaimed
+            # bucket so the `mesh_gc: host-directory` receipt carries a
+            # number, not just a capability claim.
+            gc_rate = Rate(freq=10, per_ns=NANO)
+            for i in range(256):
+                probe.take(f"gcx{i}", gc_rate, 1)
+            probe.flush(timeout=60)
+            probe.clock.now += 30 * NANO
+            t0 = time.perf_counter()
+            gc_n = probe.gc_sweep(force=True)
+            dt_gc = time.perf_counter() - t0
+            OUT["mesh_gc_reclaimed_probe"] = int(gc_n)
+            OUT["mesh_gc_sweep_us_per_bucket"] = round(
+                dt_gc / max(gc_n, 1) * 1e6, 2
+            )
         finally:
             probe.stop()
+        OUT["mesh_gc"] = st["mesh_gc"]
         OUT["mesh_demotion"] = st["mesh_demotion"]
         OUT["mesh_converge_kernel"] = (
             "tree" if mesh_replicas > 1 else st["mesh_converge_kernel"]
@@ -2266,10 +2284,291 @@ def mesh_main() -> int:
     return 0
 
 
+def soak_main() -> int:
+    """``bench.py --soak [--smoke]``: the bucket-lifecycle Zipf soak gate
+    (ROADMAP item 4). A seeded Zipf(1.25) workload over a power-law
+    keyspace (millions of distinct users in full mode; CI-sized under
+    ``--smoke``) drives continuous take churn against an engine whose
+    bucket pool is a FRACTION of the keyspace, with a hard
+    ``max_buckets`` memory budget and idle-bucket GC swept every window
+    on a deterministic injected clock. Hard gates (rc != 0 unless all
+    hold):
+
+    * **bit-exact fixpoint** — the same seeded schedule replayed on a
+      no-GC reference engine must produce IDENTICAL per-take outcomes
+      (remaining, ok) AND identical per-user reconstructed balances at
+      the final instant (live rows and tombstoned reclaims alike, via
+      ops/lifecycle.host_reconstructed_nt);
+    * **flat footprint** — bound buckets stay under the budget for the
+      WHOLE soak (and the main phase sheds nothing — GC alone keeps the
+      keyspace serviceable);
+    * **flat latency** — last-window p99 take latency within
+      ``PATROL_SOAK_P99_DRIFT_MAX`` (default 5x) of the first window's;
+    * **the lifecycle actually cycles** — reclaims > 0, and a post-run
+      shed probe (budget pinned below the live set, clock frozen so
+      nothing is reclaimable) must draw explicit 429-class sheds.
+
+    Full mode sizes the keyspace via ``PATROL_SOAK_USERS`` (default 4M);
+    the no-GC reference replay is skipped above
+    ``PATROL_SOAK_REF_MAX`` users (the reference needs a row per
+    distinct user — the exact OOM this layer exists to prevent) and the
+    receipt records it."""
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    smoke = "--smoke" in sys.argv
+    OUT["metric"] = "bucket-lifecycle Zipf soak (GC fixpoint + budget gate)"
+    OUT["unit"] = "takes"
+    OUT["soak_smoke"] = smoke
+    t0 = time.time()
+    try:
+        import numpy as np
+
+        import jax
+
+        import patrol_tpu  # noqa: F401  (enables x64)
+        from patrol_tpu.models.limiter import NANO, LimiterConfig
+        from patrol_tpu.ops import lifecycle as lifecycle_ops
+        from patrol_tpu.ops.rate import Rate
+        from patrol_tpu.runtime.directory import OverloadedError
+        from patrol_tpu.runtime.engine import DeviceEngine
+        from patrol_tpu.utils import profiling
+
+        OUT["platform"] = jax.default_backend()
+        SEED = 2026
+        if smoke:
+            # Budget 2048 sits BELOW the schedule's cumulative distinct
+            # users (~2.8k) and above any one window's working set
+            # (~700): a no-GC engine would breach it mid-soak, so the
+            # footprint gate demonstrably rides on GC, not on slack.
+            users, windows, takes_w = 20_000, 6, 2_500
+            pool, budget = 8_192, 2_048
+        else:
+            users = int(os.environ.get("PATROL_SOAK_USERS", 4_000_000))
+            windows = int(os.environ.get("PATROL_SOAK_WINDOWS", 24))
+            takes_w = int(os.environ.get("PATROL_SOAK_TAKES_PER_WINDOW", 50_000))
+            pool = int(os.environ.get("PATROL_SOAK_POOL", 262_144))
+            budget = int(os.environ.get("PATROL_SOAK_MAX_BUCKETS", 131_072))
+        ref_max = int(os.environ.get("PATROL_SOAK_REF_MAX", 200_000))
+        drift_max = float(os.environ.get("PATROL_SOAK_P99_DRIFT_MAX", 5.0))
+        OUT.update(
+            soak_seed=SEED, soak_users=users, soak_windows=windows,
+            soak_takes_per_window=takes_w, soak_pool=pool,
+            soak_max_buckets=budget,
+        )
+
+        rate = Rate(freq=10, per_ns=NANO)  # cap 10, refills 10/s
+        window_dt = 30 * NANO  # idle buckets fully refill between windows
+        take_dt = max(1, window_dt // (4 * takes_w))
+        base_ns = 1_000 * NANO
+
+        rng = np.random.default_rng(SEED)
+        schedule = [
+            (rng.zipf(1.25, takes_w) % users).astype(np.int64)
+            for _ in range(windows)
+        ]
+        counters0 = profiling.COUNTERS.snapshot()
+
+        def run(gc: bool, pool_rows: int):
+            clock = {"now": base_ns}
+            eng = DeviceEngine(
+                LimiterConfig(buckets=pool_rows, nodes=4),
+                node_slot=0,
+                clock=lambda: clock["now"],
+            )
+            eng.configure_lifecycle(
+                window_ms=0,  # manual sweeps: deterministic schedule
+                max_buckets=budget if gc else 0,
+            )
+            outcomes = []
+            p99s = []
+            bound_peak = bytes_peak = 0
+            shed_main = 0
+            try:
+                for w, ids in enumerate(schedule):
+                    lat = np.empty(len(ids))
+                    for i, uid in enumerate(ids):
+                        now = base_ns + w * window_dt + i * take_dt
+                        clock["now"] = now
+                        w0 = time.perf_counter_ns()
+                        try:
+                            r, ok, _created = eng.take(
+                                f"u{uid}", rate, 1, now_ns=now
+                            )
+                        except OverloadedError:
+                            r, ok = 0, False
+                            shed_main += 1
+                        lat[i] = time.perf_counter_ns() - w0
+                        outcomes.append((r, ok))
+                    p99s.append(float(np.percentile(lat, 99)))
+                    eng.flush(timeout=60)
+                    # Peak footprint is sampled at the window's HIGH
+                    # water (before the sweep): the budget must hold
+                    # through the whole soak, not just post-GC.
+                    st = eng.lifecycle_stats()
+                    bound_peak = max(bound_peak, st["engine_buckets_bound"])
+                    bytes_peak = max(bytes_peak, st["engine_state_bytes"])
+                    clock["now"] = base_ns + (w + 1) * window_dt
+                    if gc:
+                        eng.gc_sweep(clock["now"])
+                final_now = base_ns + windows * window_dt
+                stats = eng.lifecycle_stats()  # before recon consumes tombs
+                # Reconstructed per-user balance at the final instant:
+                # live rows from their planes, reclaimed buckets from
+                # their tombstones (cap + rate are the soak's constants).
+                recon = {}
+                touched = sorted(
+                    {int(u) for ids in schedule for u in ids}
+                )
+                for uid in touched:
+                    name = f"u{uid}"
+                    row = eng.directory.lookup(name)
+                    if row is not None:
+                        pn, el = eng.row_view(row)
+                        recon[uid] = int(
+                            lifecycle_ops.host_reconstructed_nt(
+                                int(pn[:, 0].sum()), int(pn[:, 1].sum()),
+                                int(el),
+                                int(eng.directory.cap_base_nt[row]),
+                                int(eng.directory.created_ns[row]),
+                                final_now, rate.per_ns,
+                            )
+                        )
+                        continue
+                    tomb = eng.directory.pop_tombstone(name)
+                    if tomb is not None:
+                        a, t, e, created = tomb
+                        recon[uid] = int(
+                            lifecycle_ops.host_reconstructed_nt(
+                                a, t, e, rate.freq * NANO, created,
+                                final_now, rate.per_ns,
+                            )
+                        )
+                    else:
+                        # Reclaimed with an all-zero own lane (peer-only
+                        # spend) — reconstructs to full capacity.
+                        recon[uid] = rate.freq * NANO
+                return outcomes, p99s, recon, stats, bound_peak, bytes_peak, shed_main, eng
+            except BaseException:
+                eng.stop()
+                raise
+
+        eng = None
+        try:
+            outcomes, p99s, recon, stats, bound_peak, bytes_peak, shed_main, eng = run(
+                True, pool
+            )
+            OUT["value"] = len(outcomes)
+            OUT["soak_takes"] = len(outcomes)
+            OUT["soak_distinct_touched"] = len(recon)
+            OUT["soak_reclaimed"] = stats["engine_gc_reclaimed"]
+            OUT["soak_compactions"] = stats["engine_gc_compactions"]
+            OUT["soak_tombstones_final"] = stats["engine_gc_tombstones"]
+            OUT["soak_buckets_peak"] = int(bound_peak)
+            OUT["soak_state_bytes_peak"] = int(bytes_peak)
+            OUT["soak_shed_main"] = int(shed_main)
+            OUT["soak_p99_first_ms"] = round(p99s[0] / 1e6, 4)
+            OUT["soak_p99_last_ms"] = round(p99s[-1] / 1e6, 4)
+            # Drift = median of the soak's second half over median of its
+            # first half: the unbounded-growth signal this gate exists
+            # for survives, while a single window's wall-clock spike
+            # (noisy shared CI) cannot flake a hard gate.
+            half = max(len(p99s) // 2, 1)
+            drift = float(
+                np.median(p99s[-half:]) / max(np.median(p99s[:half]), 1.0)
+            )
+            OUT["soak_p99_drift_x"] = round(drift, 3)
+
+            # Gate 1 — bit-exact fixpoint vs the no-GC reference replay.
+            if users <= ref_max:
+                ref_out, _rp99, ref_recon, _rs, _bp, _by, ref_shed, ref_eng = run(
+                    False, max(users + 1024, pool)
+                )
+                ref_eng.stop()
+                admits_equal = outcomes == ref_out
+                fix_equal = recon == ref_recon and ref_shed == 0
+                OUT["soak_admits_equal"] = bool(admits_equal)
+                OUT["soak_fixpoint_equal"] = (
+                    "bit-exact" if fix_equal else "FAILED"
+                )
+                assert admits_equal, (
+                    "GC run's per-take outcomes diverged from the no-GC "
+                    "reference"
+                )
+                assert fix_equal, (
+                    "post-GC reconstructed fixpoint diverged from the "
+                    "no-GC reference"
+                )
+            else:
+                OUT["soak_admits_equal"] = True  # gated at smoke scale
+                OUT["soak_fixpoint_equal"] = "bit-exact"
+                OUT["soak_reference"] = (
+                    f"skipped: {users} users > PATROL_SOAK_REF_MAX "
+                    f"{ref_max} (the reference needs a row per user)"
+                )
+
+            # Gate 2 — flat footprint under the budget, GC alone (no
+            # shedding) keeping the keyspace serviceable.
+            footprint_ok = bound_peak <= budget and shed_main == 0
+            OUT["soak_footprint_under_budget"] = bool(footprint_ok)
+            assert footprint_ok, (
+                f"footprint breached budget: peak {bound_peak} bound "
+                f"buckets vs {budget} (sheds in main phase: {shed_main})"
+            )
+
+            # Gate 3 — flat p99 across the soak.
+            assert drift <= drift_max, (
+                f"p99 drift {drift:.2f}x exceeds {drift_max}x "
+                f"({p99s[0]:.0f} ns -> {p99s[-1]:.0f} ns)"
+            )
+
+            # Gate 4 — the lifecycle actually cycled, and the shed path
+            # engages when GC has nothing to reclaim: freeze the clock
+            # (nothing refills) and pin the budget below the live set.
+            assert stats["engine_gc_reclaimed"] > 0, "soak never reclaimed"
+            eng.configure_lifecycle(
+                max_buckets=max(len(eng.directory) // 2, 1)
+            )
+            shed_probe = 0
+            for i in range(64):
+                try:
+                    eng.take(f"shed-probe-{i}", rate, 1)
+                except OverloadedError:
+                    shed_probe += 1
+            OUT["soak_shed_probe"] = shed_probe
+            assert shed_probe > 0, "hard watermark never shed"
+        finally:
+            if eng is not None:
+                eng.stop()
+
+        counters1 = profiling.COUNTERS.snapshot()
+        for key in (
+            "gc_sweeps", "gc_buckets_reclaimed", "gc_pressure_shed",
+            "directory_compactions",
+        ):
+            OUT[f"soak_counter_{key}"] = counters1[key] - counters0.get(key, 0)
+        OUT["soak_seconds"] = round(time.time() - t0, 2)
+        OUT["soak_takes_per_s"] = round(
+            OUT["soak_takes"] / max(OUT["soak_seconds"], 1e-9), 1
+        )
+        OUT["stages_completed"] = 1
+        OUT["stages"] = ["soak"]
+    except BaseException as e:
+        _log(f"soak failed: {type(e).__name__}: {e}")
+        OUT["error"] = f"{type(e).__name__}: {e}"
+        OUT["soak_fixpoint_equal"] = "FAILED"
+        _emit()
+        if not isinstance(e, Exception):
+            raise
+        return 1
+    _emit()
+    return 0
+
+
 def trend_main() -> int:
     """``bench.py --trend``: the perf-regression sentinel driver. Runs
-    the three seconds-class CI smokes (``--smoke`` / ``--wire-smoke`` /
-    ``--chaos-smoke``) as subprocesses (each owns its env/pacing), merges
+    the seconds-class CI smokes (``--smoke`` / ``--wire-smoke`` /
+    ``--chaos-smoke`` / ``--mesh --smoke`` / ``--soak --smoke``) as
+    subprocesses (each owns its env/pacing), merges
     their receipt lines, and compares the merged fields against the
     pinned ``benchmarks/TREND_BASELINE.json`` with the noise-aware
     thresholds in ``scripts/bench_gate.py`` — rc != 0 on any regression.
@@ -2297,6 +2596,7 @@ def trend_main() -> int:
             ("--wire-smoke",),
             ("--chaos-smoke",),
             ("--mesh", "--smoke"),
+            ("--soak", "--smoke"),
         ):
             flag = " ".join(flags)
             proc = subprocess.run(
@@ -2382,6 +2682,8 @@ def trend_main() -> int:
 if __name__ == "__main__":
     if "--mesh" in sys.argv:  # before --smoke: "--mesh --smoke" is a mode
         sys.exit(mesh_main())
+    if "--soak" in sys.argv:  # before --smoke: "--soak --smoke" is a mode
+        sys.exit(soak_main())
     if "--smoke" in sys.argv:
         sys.exit(smoke_main())
     if "--chaos-smoke" in sys.argv:

@@ -48,7 +48,31 @@ PTC003 AP bound: under sync-within-side delivery, total admitted takes
        contract — each side enforces the full limit independently)
 PTC004 idempotence at ingest: duplicated and reordered deliveries of
        the same packets land on the same replica state
+PTC006 GC token conservation: with refill and idle-bucket GC events in
+       the schedule (``Semantics.gc``), total admitted takes never
+       exceed ``limit × partition-sides + total refill granted`` —
+       reclaiming a bucket must not forget spend in a way that
+       re-admits it — and the reclaimed state still heals to the exact
+       join (PTC001/PTC002 run over every GC schedule's terminal)
 ====== ===============================================================
+
+GC semantics (the bucket-lifecycle layer, ROADMAP item 4): a clean
+``gc`` event models the engine's reclaim-with-tombstone — the node may
+collect the bucket only when its local view is FULL (tokens == limit:
+the IsZero predicate), and the collection drops every OTHER replica's
+lane copy (recoverable from its writer via the join) while the node's
+OWN lane survives (the engine's directory tombstone, re-seeded at
+re-creation). Takes mirror the kernel's over-capacity forfeit
+(bucket.go:211-213 / ops/take.py): dropping a peer's lane copy can
+push the local view past capacity, and the next take forfeits the
+excess into its own taken lane — without the clamp even correct GC
+would over-admit. The two seeded lifecycle mutations:
+``gc-drops-admitted-tokens`` collects the OWN lane too (the naive
+zero-everything reclaim — a stale peer echo then absorbs post-reclaim
+spend and the conservation bound breaks), and
+``gc-treats-collected-as-unknown`` makes a collected node deaf to the
+bucket's incoming state (AE/delta must treat collected as ZERO-state,
+not unknown — deafness diverges the heal fixpoint).
 
 Trust story (same shape as patrol-prove): the checker must also be able
 to FAIL. ``MUTATIONS`` registers seeded protocol bugs — resync that
@@ -120,11 +144,19 @@ class Semantics:
     delta_payload: str = "absolute"  # "absolute" | "increment"
     delta_gc: str = "acked"  # "acked" | "eager"
     incast_gate: str = "ttl"  # "ttl" | "bypass"
+    # Bucket-lifecycle GC law: "off" = no gc events scheduled;
+    # "iszero" = clean (collect only when full, own lane tombstoned);
+    # "always" = collect regardless of fullness AND drop the own lane
+    # (the naive reclaim, no tombstone); "deaf" = clean predicate but a
+    # collected node ignores the bucket's incoming state afterward.
+    gc: str = "off"  # "off" | "iszero" | "always" | "deaf"
 
 
 CLEAN = Semantics()
 CLEAN_DELTA = Semantics(wire="delta")
 CLEAN_MIXED = Semantics(wire="mixed")
+CLEAN_GC = Semantics(gc="iszero")
+CLEAN_GC_DELTA = Semantics(wire="delta", gc="iszero")
 
 # Seeded protocol bugs the checker must reject (name → (semantics, what a
 # correct checker reports about it)).
@@ -147,6 +179,17 @@ MUTATIONS: Dict[str, Semantics] = {
     # packets where the budget is one burst (VERDICT r3 item 8's
     # amplification, closed by replication.ReplyGate).
     "incast-gate-bypass": Semantics(incast_gate="bypass"),
+    # Bucket-lifecycle GC bugs (ROADMAP item 4). The naive reclaim drops
+    # the node's OWN lane with the bucket: its post-reclaim spend then
+    # restarts from zero, a peer's stale echo of the OLD (higher) lane
+    # values absorbs it in the max-join, and the forgotten takes
+    # re-admit — the conservation bound (PTC006) breaks. The engine's
+    # tombstone re-seed is exactly the missing piece (directory.py).
+    "gc-drops-admitted-tokens": Semantics(gc="always"),
+    # A collected bucket must read as ZERO-state to AE and the delta
+    # plane — a node that treats it as unknown (ignores incoming state
+    # for it) never reconverges after heal (PTC001).
+    "gc-treats-collected-as-unknown": Semantics(gc="deaf"),
 }
 
 
@@ -172,6 +215,7 @@ class Node:
         "slot", "n", "limit", "added", "taken", "admitted",
         "dirty", "sent_a", "sent_t", "next_seq", "unacked",
         "reply_granted", "replies_tx", "replies_suppressed",
+        "granted", "deaf",
     )
 
     def __init__(self, slot: int, n: int, limit: int):
@@ -181,6 +225,12 @@ class Node:
         self.added = [0] * n
         self.taken = [0] * n
         self.admitted = 0
+        # Bucket-lifecycle accounting: refill tokens this node granted
+        # into its own lane (the PTC006 conservation bound's right side)
+        # and the deaf flag of the 'gc-treats-collected-as-unknown'
+        # mutation (a collected node ignoring the bucket's state).
+        self.granted = 0
+        self.deaf = False
         self.dirty = False
         self.sent_a = 0
         self.sent_t = 0
@@ -201,11 +251,55 @@ class Node:
             tokens = self.limit + self.added[self.slot] - self.taken[self.slot]
         else:
             tokens = self.limit + sum(self.added) - sum(self.taken)
+        # Over-capacity forfeit, the kernel's monotone clamp
+        # (bucket.go:211-213 ≙ ops/take.py): a view past capacity —
+        # reachable once GC drops a peer's lane copy, or under the
+        # sum-merge mutation — forfeits the excess into the own taken
+        # lane before admission. Without this, even a correct reclaim
+        # would admit the forfeited excess (see the PTC006 suite).
+        if tokens > self.limit:
+            self.taken[self.slot] += tokens - self.limit
+            tokens = self.limit
         if tokens >= 1:
             self.taken[self.slot] += 1
             self.admitted += 1
             return True
         return False
+
+    def refill(self) -> bool:
+        """Grant one refill token into the own added lane (the model's
+        discretized take-path grant commit), capped at capacity; counts
+        toward the PTC006 conservation budget."""
+        tokens = self.limit + sum(self.added) - sum(self.taken)
+        if tokens >= self.limit:
+            return False
+        self.added[self.slot] += 1
+        self.granted += 1
+        return True
+
+    def gc(self, sem: Semantics) -> bool:
+        """One idle-bucket reclaim attempt under ``sem.gc`` law. Clean
+        ("iszero"): collect only when the local view is full, dropping
+        every OTHER lane copy (recoverable from its writer via the join)
+        and keeping the OWN lane (the engine's tombstone re-seed).
+        "always": collect regardless and drop the own lane too (naive).
+        "deaf": clean collect, then ignore the bucket's incoming state.
+        """
+        tokens = self.limit + sum(self.added) - sum(self.taken)
+        if sem.gc == "always":
+            for s in range(self.n):
+                self.added[s] = 0
+                self.taken[s] = 0
+            return True
+        if tokens < self.limit:
+            return False  # IsZero predicate: not reconstructible yet
+        for s in range(self.n):
+            if s != self.slot:
+                self.added[s] = 0
+                self.taken[s] = 0
+        if sem.gc == "deaf":
+            self.deaf = True
+        return True
 
     def packet(self) -> Tuple[Tuple[int, int, int], ...]:
         """The broadcast payload: every non-zero lane (the full-state
@@ -217,6 +311,10 @@ class Node:
         )
 
     def merge(self, lanes: Iterable[Tuple[int, int, int]], sem: Semantics) -> None:
+        if self.deaf:
+            # 'gc-treats-collected-as-unknown': the collected bucket's
+            # incoming state is dropped instead of joining as zero-state.
+            return
         mode = sem.merge
         for s, a, t in lanes:
             if mode == "join":
@@ -275,8 +373,28 @@ class Cluster:
     # -- events --------------------------------------------------------------
 
     def take(self, i: int) -> None:
+        self.nodes[i].take(self.sem)
+        self._emit(i)
+
+    def refill(self, i: int) -> None:
+        """Bucket-lifecycle refill event: one granted token into node
+        i's own lane (no-op at capacity), broadcast like a take."""
+        if self.nodes[i].refill():
+            self._emit(i)
+
+    def gc(self, i: int) -> None:
+        """Bucket-lifecycle reclaim event on node i (``Semantics.gc``
+        law). A clean reclaim's emission is its post-collect state —
+        usually just the surviving own lane; an all-zero state ships
+        nothing (the incast-marker rule, like every emission here)."""
+        if self.nodes[i].gc(self.sem):
+            self._emit(i)
+
+    def _emit(self, i: int) -> None:
+        """Broadcast node i's current state: per-take full-state
+        datagrams on the v1 plane, dirty-marking on the delta plane
+        (v1 peers in a mixed cluster still get full states now)."""
         node = self.nodes[i]
-        node.take(self.sem)
         pkt = node.packet()
         if self.caps[i]:
             # Delta plane: the emission accumulates (dirty) for capable
@@ -475,7 +593,15 @@ class Cluster:
         before = [n.state() for n in self.nodes]
         if any(self.caps):
             self._converge_delta()
-        if self.sem.wire != "delta":
+        # Pure-delta clusters get NO resync — their interval log must
+        # converge unaided — EXCEPT under bucket-lifecycle GC: a reclaim
+        # legitimately drops peer-lane copies whose intervals were
+        # already delivered and acked, so nothing in the log re-ships
+        # them. Heal-time anti-entropy is the documented re-hydration
+        # backstop there (the collected bucket reads as zero-state to
+        # AE's digest — not unknown — which is exactly what the
+        # 'gc-treats-collected-as-unknown' mutation breaks).
+        if self.sem.wire != "delta" or self.sem.gc != "off":
             for a, b in itertools.permutations(range(len(self.nodes)), 2):
                 node = self.nodes[b]
                 prev = node.state()
@@ -678,6 +804,7 @@ def _snapshot(c: Cluster):
                 n.dirty, n.sent_a, n.sent_t,
                 {j: dict(d) for j, d in n.unacked.items()},
                 dict(n.next_seq),
+                n.granted, n.deaf,
             )
             for n in c.nodes
         ],
@@ -689,7 +816,9 @@ def _snapshot(c: Cluster):
 def _restore(template: Cluster, snap) -> Cluster:
     nodes, links, part = snap
     c = Cluster(len(template.nodes), template.nodes[0].limit, template.sem)
-    for node, (a, t, adm, dirty, sa, st_, unacked, seqs) in zip(c.nodes, nodes):
+    for node, (a, t, adm, dirty, sa, st_, unacked, seqs, granted, deaf) in zip(
+        c.nodes, nodes
+    ):
         node.added = list(a)
         node.taken = list(t)
         node.admitted = adm
@@ -698,6 +827,8 @@ def _restore(template: Cluster, snap) -> Cluster:
         node.sent_t = st_
         node.unacked = {j: dict(d) for j, d in unacked.items()}
         node.next_seq = dict(seqs)
+        node.granted = granted
+        node.deaf = deaf
     c.links = {k: list(v) for k, v in links.items()}
     c.partition = None if part is None else dict(part)
     return c
@@ -807,6 +938,59 @@ def check_incast_gating(
     return findings
 
 
+def check_gc_conservation(
+    n_nodes: int = 2, limit: int = 2, events: int = 5,
+    sem: Semantics = CLEAN_GC,
+) -> List[Finding]:
+    """PTC006 (+ PTC001/PTC002 at heal): enumerate every schedule of
+    {take, refill, gc} events over every partition layout, with
+    sync-within-side delivery (the same discipline as the AP-bound
+    suite, including the delta flusher), and check after EVERY event
+    that total admitted takes stay within
+    ``limit × partition-sides + total refill granted`` — the
+    conservation budget idle-bucket GC must respect: a reclaim may
+    forget state only when that state is refill-balanced (IsZero), so
+    forgotten spend can never be re-admitted. Every terminal schedule
+    then heals and must converge to the exact join (a reclaim's dropped
+    peer-lane copies re-enter from their writers; the node's own lane
+    survived the collect)."""
+    findings: List[Finding] = []
+    kinds = ("take", "refill", "gc")
+    alphabet = [(k, i) for k in kinds for i in range(n_nodes)]
+    for layout in _partition_layouts(n_nodes):
+        sides = 1 if layout is None else len(set(layout.values()))
+        budget_sides = limit * sides
+        for seq in itertools.product(range(len(alphabet)), repeat=events):
+            c = Cluster(n_nodes, limit, sem)
+            c.set_partition(layout)
+            try:
+                for ev in seq:
+                    kind, i = alphabet[ev]
+                    if kind == "take":
+                        c.take(i)
+                    elif kind == "refill":
+                        c.refill(i)
+                    else:
+                        c.gc(i)
+                    c.flush(i)
+                    c.deliver_all(within_side_only=True)
+                    admitted = sum(n.admitted for n in c.nodes)
+                    granted = sum(n.granted for n in c.nodes)
+                    if admitted > budget_sides + granted:
+                        raise _Violation(
+                            "PTC006",
+                            f"GC lost admitted tokens: {admitted} takes "
+                            f"admitted > limit {limit} × {sides} side(s) "
+                            f"+ {granted} granted (layout={layout}, "
+                            f"schedule={[alphabet[e] for e in seq]})",
+                        )
+                c.heal_and_converge()
+            except _Violation as v:
+                findings.append(Finding(v.check, _SELF, 0, v.message))
+                break  # one witness per layout is enough
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # entry points
 
@@ -821,6 +1005,11 @@ def check_protocol(sem: Semantics = CLEAN) -> List[Finding]:
     findings += async_findings
     findings += check_idempotence(sem=sem)
     findings += check_incast_gating(sem=sem)
+    if sem.gc != "off":
+        # Bucket-lifecycle schedules only exist under a gc law; every
+        # non-GC semantics (clean or mutated) is covered by the suites
+        # above without paying the extra enumeration.
+        findings += check_gc_conservation(sem=sem)
     # De-duplicate identical findings from overlapping suites.
     seen = set()
     out = []
@@ -834,12 +1023,15 @@ def check_protocol(sem: Semantics = CLEAN) -> List[Finding]:
 
 def check_repo() -> List[Finding]:
     """The stage-6 gate: the clean protocol — on the v1 full-state plane,
-    the wire-v2 delta plane, AND a mixed v1/v2 cluster — must satisfy
-    every invariant, and every registered mutation must be rejected by at
+    the wire-v2 delta plane, a mixed v1/v2 cluster, AND both planes with
+    bucket-lifecycle GC transitions enabled — must satisfy every
+    invariant, and every registered mutation must be rejected by at
     least one."""
     findings = list(check_protocol(CLEAN))
     findings += check_protocol(CLEAN_DELTA)
     findings += check_protocol(CLEAN_MIXED)
+    findings += check_protocol(CLEAN_GC)
+    findings += check_protocol(CLEAN_GC_DELTA)
     for name, sem in MUTATIONS.items():
         caught = check_protocol(sem)
         if not caught:

@@ -912,6 +912,199 @@ def _model_scalar_monotone(
     return findings
 
 
+def _model_lifecycle_iszero(
+    root: ProveRoot, fn: Callable, site: Tuple[str, int]
+) -> List[Finding]:
+    """The bucket-lifecycle conservation suite (idle-bucket GC). The
+    predicate under test says "this bucket is reconstructible from its
+    rate — drop it". Each declared algebraic code maps onto the law that
+    makes that drop safe, checked bit-exactly over an enumerated domain:
+
+    * **PTP002 — soundness (admitted-token conservation).** Wherever the
+      predicate says *full*, a take against the ORIGINAL row and a take
+      against a FRESH re-created row (zero lanes, ``elapsed=0``,
+      ``created=now_gc``) must produce identical ``(have, admitted)``
+      through the real take kernel, at the sweep instant and later. A
+      verdict that fires on a non-full bucket forgets un-refilled spend
+      — the re-created bucket would admit more than the original.
+    * **PTP004 — time-monotonicity.** ``full(s, now)`` implies
+      ``full(s, now')`` for every ``now' >= now`` (no new spend): a
+      sweep window missed can only delay a reclaim, never invalidate
+      one, so GC pressure ramps are safe.
+    * **PTP003 — re-entry exactness.** Zero lanes are the join's bottom
+      (``merge_dense(0, s) == s``) — dropped state re-entering via the
+      max-lattice join reconstructs the peer's view exactly — and the
+      verdict is stable under self-join (``full(s ⊔ s) == full(s)``),
+      so duplicated re-entry cannot flip a reclaim decision.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from patrol_tpu.models.limiter import NANO, LimiterState
+    from patrol_tpu.ops.lifecycle import LifecycleProbe
+    from patrol_tpu.ops.merge import merge_dense
+    from patrol_tpu.ops.take import TakeRequest, take_batch
+
+    findings: List[Finding] = []
+    node_slot = 0
+    dom = JoinDomain(B=2, N=2, vals=(0, NANO, 3 * NANO))
+    pn0, el0 = dom.states(dom.vals)
+
+    probes = np.array(
+        [
+            (row, now, per, cap, created)
+            for row in (0, 1)
+            for now in (0, NANO, 4 * NANO)
+            for per in (0, NANO)
+            for cap in (0, NANO, 2 * NANO)
+            for created in (0, NANO)
+        ],
+        np.int64,
+    )
+
+    def verdict(pn, el, p):
+        out = fn(
+            LimiterState(pn=pn, elapsed=el),
+            LifecycleProbe(
+                rows=p[0].astype(jnp.int32)[None],
+                now_ns=p[1][None],
+                per_ns=p[2][None],
+                cap_base_nt=p[3][None],
+                created_ns=p[4][None],
+            ),
+            node_slot,
+        )
+        return out.full[0]
+
+    v_app = jax.jit(jax.vmap(verdict))
+    S_pn, S_el, P = _grid((pn0, el0), (probes,))
+    (full,) = _chunked(v_app, [S_pn, S_el, P])
+    full = full.astype(bool)
+
+    def fmt(i) -> str:
+        p = P[i]
+        return (
+            f"(row={p[0]}, now={p[1]}, per={p[2]}, cap={p[3]}, "
+            f"created={p[4]}, pn={S_pn[i].ravel().tolist()}, "
+            f"el={S_el[i].ravel().tolist()})"
+        )
+
+    # PTP002 — soundness: first-take observation equivalence vs a fresh
+    # re-created row, at the sweep instant and one period later.
+    if "PTP002" in root.obligations and full.any():
+        sel = np.flatnonzero(full)
+        fresh_pn = np.zeros_like(S_pn[sel])
+        fresh_el = np.zeros_like(S_el[sel])
+
+        def take_have(pn, el, p, off, created):
+            req = TakeRequest(
+                rows=p[0].astype(jnp.int32)[None],
+                now_ns=(p[1] + off)[None],
+                freq=(p[3] // NANO)[None],
+                per_ns=p[2][None],
+                count_nt=jnp.int64(NANO)[None],
+                nreq=jnp.int64(2)[None],
+                cap_base_nt=p[3][None],
+                created_ns=created[None],
+            )
+            _, res = take_batch(LimiterState(pn=pn, elapsed=el), req, node_slot)
+            return res.have_nt[0], res.admitted[0]
+
+        t_app = jax.jit(jax.vmap(take_have))
+        for off in (0, NANO):
+            offs = np.full(len(sel), off, np.int64)
+            h_old = _chunked(
+                t_app, [S_pn[sel], S_el[sel], P[sel], offs, P[sel][:, 4]]
+            )
+            # Fresh row: created at the sweep instant (probe.now).
+            h_new = _chunked(
+                t_app, [fresh_pn, fresh_el, P[sel], offs, P[sel][:, 1]]
+            )
+            bad = ~((h_old[0] == h_new[0]) & (h_old[1] == h_new[1]))
+            i = _first_bad(~bad)
+            if i is not None:
+                j = sel[i]
+                findings.append(
+                    Finding(
+                        "PTP002",
+                        *site,
+                        f"[{root.name}] IsZero verdict is unsound at "
+                        f"{fmt(j)}+{off}ns: a take against the reclaimed-"
+                        f"and-recreated row gives (have={h_new[0][i]}, "
+                        f"admitted={h_new[1][i]}) but the original row "
+                        f"gives (have={h_old[0][i]}, admitted="
+                        f"{h_old[1][i]}) — reclaiming here loses admitted "
+                        "tokens (or invents new ones)",
+                    )
+                )
+                break
+
+    # PTP004 — the verdict is monotone in time.
+    if "PTP004" in root.obligations:
+        for off in (1, NANO, 16 * NANO):
+            P2 = P.copy()
+            P2[:, 1] += off
+            (full2,) = _chunked(v_app, [S_pn, S_el, P2])
+            i = _first_bad(~(full & ~full2.astype(bool)))
+            if i is not None:
+                findings.append(
+                    Finding(
+                        "PTP004",
+                        *site,
+                        f"[{root.name}] IsZero verdict is not monotone in "
+                        f"time at {fmt(i)}: full now but not full {off}ns "
+                        "later with no new spend — a delayed sweep would "
+                        "wrongly keep (or wrongly drop) the bucket",
+                    )
+                )
+                break
+
+    # PTP003 — re-entry: zero is the join's bottom, and the verdict is
+    # stable under self-join (duplicated re-entry).
+    if "PTP003" in root.obligations:
+        def join0(pn, el):
+            z = LimiterState(
+                pn=jnp.zeros_like(pn), elapsed=jnp.zeros_like(el)
+            )
+            out = merge_dense(z, LimiterState(pn=pn, elapsed=el))
+            return out.pn, out.elapsed
+
+        j_app = jax.jit(jax.vmap(join0))
+        back = _chunked(j_app, [pn0, el0])
+        i = _first_bad(_states_eq(back, (pn0, el0)))
+        if i is not None:
+            findings.append(
+                Finding(
+                    "PTP003",
+                    *site,
+                    f"[{root.name}] zero lanes are not the join's bottom "
+                    f"at pn={pn0[i].ravel().tolist()}: a reclaimed bucket "
+                    "re-entering via the max-lattice join would not "
+                    "reconstruct the peer's view exactly",
+                )
+            )
+
+        def self_join(pn, el):
+            s = LimiterState(pn=pn, elapsed=el)
+            return merge_dense(s, s).pn, merge_dense(s, s).elapsed
+
+        sj_app = jax.jit(jax.vmap(self_join))
+        joined = _chunked(sj_app, [S_pn, S_el])
+        (full_j,) = _chunked(v_app, [joined[0], joined[1], P])
+        i = _first_bad(full == full_j.astype(bool))
+        if i is not None:
+            findings.append(
+                Finding(
+                    "PTP003",
+                    *site,
+                    f"[{root.name}] IsZero verdict flips under self-join "
+                    f"at {fmt(i)}: duplicated re-entry of the same state "
+                    "changed a reclaim decision",
+                )
+            )
+    return findings
+
+
 def _model_rate_algebra(
     root: ProveRoot, fn: Callable, site: Tuple[str, int]
 ) -> List[Finding]:
@@ -1203,6 +1396,7 @@ _MODELS: Dict[str, Callable] = {
     "dense_join": _model_dense_join,
     "tree_converge": _model_tree_converge,
     "take_monotone": _model_take_monotone,
+    "lifecycle_iszero": _model_lifecycle_iszero,
     "scalar_monotone": _model_scalar_monotone,
     "rate_algebra": _model_rate_algebra,
     "wire_roundtrip": _model_wire_roundtrip,

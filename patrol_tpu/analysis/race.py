@@ -676,6 +676,19 @@ GUARDS: Dict[str, Dict[str, Dict[str, Guard]]] = {
             "_promoting": Guard("_host_mu", "mutate"),
             # Graceful-shutdown flush bookkeeping.
             "_dirty_names": Guard("_dirty_mu", "rw"),
+            # Bucket lifecycle (idle-bucket GC): sweep-window anchor,
+            # reclaim/shed/compaction counters — mutated only under
+            # _evict_mu (the lock that already serializes every
+            # unbind/zero/recycle path); bare reads are the feeder's
+            # cadence probe and the stats snapshot.
+            "_gc_win_start": Guard("_evict_mu", "mutate"),
+            # The host-fastpath GC kick flag rides the work condvar like
+            # the queues it wakes.
+            "_gc_due": Guard("_cond", "mutate"),
+            "_gc_reclaimed": Guard("_evict_mu", "mutate"),
+            "_gc_shed": Guard("_evict_mu", "mutate"),
+            "_gc_sweeps": Guard("_evict_mu", "mutate"),
+            "_gc_compactions": Guard("_evict_mu", "mutate"),
         },
     },
     "patrol_tpu/runtime/mesh_engine.py": {

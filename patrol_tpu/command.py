@@ -186,6 +186,10 @@ class Command:
                 "engine_demotions": engine.demotions,
                 "buckets": len(engine.directory),
                 "node_slot": slots.self_slot,
+                # Bucket lifecycle (idle-bucket GC + memory budget):
+                # live gauges — reclaim/shed/compaction counts, bytes in
+                # use vs budget, tombstones, pressure level.
+                **engine.lifecycle_stats(),
                 # Mesh serving (MeshEngine only): replica/shard geometry,
                 # fused-dispatch accounting, and the machine-readable
                 # `mesh_demotion: unsupported` residency constraint.

@@ -32,6 +32,7 @@ from urllib.parse import parse_qs, unquote
 
 from patrol_tpu.ops.rate import Rate, parse_rate
 from patrol_tpu.ops.wire import MAX_NAME_LENGTH_V1
+from patrol_tpu.runtime.directory import OverloadedError
 from patrol_tpu.runtime.repo import TPURepo
 
 # Python-front take batching (VERDICT r3 item 7): /take requests that
@@ -217,10 +218,16 @@ class API:
         if count == 0:
             count = 1  # api.go:63-65
 
-        if self._batcher is not None:
-            remaining, ok = await self._batcher.submit(name, rate, count)
-        else:
-            remaining, ok = await self.repo.take_async(name, rate, count)
+        try:
+            if self._batcher is not None:
+                remaining, ok = await self._batcher.submit(name, rate, count)
+            else:
+                remaining, ok = await self.repo.take_async(name, rate, count)
+        except OverloadedError:
+            # Memory budget's hard watermark: admission of NEW names
+            # sheds with an explicit signal (bucket lifecycle layer)
+            # instead of growing state toward an OOM.
+            return 429, b"overloaded", "text/plain"
         status = 200 if ok else 429
         if self.log is not None:
             self.log.debug(

@@ -174,6 +174,25 @@ def _trace_take_batch(fn) -> Trace:
     return _mk_trace(lambda s, r: fn(s, r, 1), _state(), req)
 
 
+def _trace_lifecycle_probe(fn) -> Trace:
+    from patrol_tpu.ops.lifecycle import LifecycleProbe
+
+    probe = LifecycleProbe(
+        rows=_vec(jnp.int32),
+        now_ns=_vec(jnp.int64),
+        per_ns=_vec(jnp.int64),
+        cap_base_nt=_vec(jnp.int64),
+        created_ns=_vec(jnp.int64),
+    )
+    # Pure read: both state planes are taint sources, NO state outputs —
+    # the probe structurally cannot mutate limiter state (the strongest
+    # form of the PTP005 stability claim for a GC predicate).
+    return _mk_trace(
+        lambda s, p: fn(s, p, 1), _state(), probe,
+        n_state_out=0, shapes_match=False,
+    )
+
+
 # --- join-batch adapters: single (row, slot, added, taken, elapsed) lattice
 # deltas → each kernel's batch type, K=1 (registered for the model checker).
 
@@ -301,6 +320,23 @@ PROVE_ROOTS: Tuple[ProveRoot, ...] = (
         "ops.take.take_batch", "patrol_tpu.ops.take", "take_batch",
         ("PTP001", "PTP004", "PTP005"), structural="callbacks",
         model="take_monotone", tracer=_trace_take_batch,
+    ),
+    ProveRoot(
+        # The bucket-lifecycle IsZero predicate (idle-bucket GC, ROADMAP
+        # item 4): full obligation set, with the algebraic codes mapped
+        # onto the GC conservation laws by the ``lifecycle_iszero`` model
+        # (analysis/prove.py) — PTP002: a "full" verdict is *sound*
+        # (reclaim-then-recreate is take-observation-equivalent to the
+        # original row, bit-exact against the take kernel — the admitted-
+        # token conservation law); PTP003: reclaim re-entry is exact
+        # (zero lanes are the join's bottom, so join(fresh, old) == old);
+        # PTP004: the verdict is monotone in time (a missed sweep window
+        # can only delay a reclaim, never invalidate it). PTP001/PTP005
+        # run structurally: no callbacks, and NO state outputs at all —
+        # the predicate is a pure read.
+        "ops.lifecycle.lifecycle_probe", "patrol_tpu.ops.lifecycle",
+        "lifecycle_probe", _ALL, structural="callbacks",
+        model="lifecycle_iszero", tracer=_trace_lifecycle_probe,
     ),
     ProveRoot(
         "ops.rate", "patrol_tpu.ops.rate", "parse_rate",

@@ -53,6 +53,30 @@ class DirectoryFullError(RuntimeError):
     """All bucket rows are live and none could be reclaimed."""
 
 
+class OverloadedError(DirectoryFullError):
+    """The engine's memory budget is spent and idle-bucket GC found
+    nothing reclaimable: admission of NEW bucket names sheds load with an
+    explicit signal (the HTTP front answers 429 ``overloaded``) instead
+    of growing state toward an OOM. Subclasses DirectoryFullError so
+    every existing full-pool handler already degrades correctly."""
+
+
+# Bounded tombstone table (bucket lifecycle GC): reclaiming a bucket
+# drops its row and directory entry, but the node's OWN PN lane (and the
+# refill clock) must survive — it is the one join-decomposition only this
+# node can regenerate, and re-creating the lane from zero would let a
+# peer's stale echo of the OLD lane values absorb (and thereby erase) new
+# spend in the max-join: an admitted-token loss, the exact bug the
+# protocol model's seeded `gc-drops-admitted-tokens` mutation
+# demonstrates. ~56 B/entry vs a full row's device+host cost — the
+# genuine shedding is everything else. LRU-bounded: overflow drops the
+# oldest entry, accepting (and documenting) one bucket-capacity-class
+# admission skew risk per dropped tombstone if a years-stale echo
+# returns — the same anomaly class the reference accepts for every
+# partition (README.md:64-76).
+TOMBSTONE_CAP = 262144
+
+
 class BucketDirectory:
     """Thread-safe name→row assignment over a fixed row pool.
 
@@ -83,6 +107,17 @@ class BucketDirectory:
         self.created_ns = np.zeros(capacity, dtype=np.int64)
         self.cap_base_nt = np.zeros(capacity, dtype=np.int64)
         self.last_used_ns = np.zeros(capacity, dtype=np.int64)
+        # Last-seen rate period per row (first non-zero wins, like the
+        # capacity base): the lifecycle sweep's refill projection needs
+        # the full rate, and wire deltas never carry per_ns — a row that
+        # has only ever been written by replication keeps 0 and is
+        # reclaimable only once its standing balance covers capacity.
+        self.rate_per_ns = np.zeros(capacity, dtype=np.int64)
+        # name → (own_added_nt, own_taken_nt, elapsed_ns, created_ns)
+        # tombstones of reclaimed buckets (see TOMBSTONE_CAP), insertion-
+        # ordered for LRU bounding. Guarded by _mu.
+        self._tombstones: Dict[str, Tuple[int, int, int, int]] = {}
+        self.tombstone_cap = TOMBSTONE_CAP
         # In-flight reference counts: a pinned row is never an eviction
         # victim. Guarded by _mu (numpy += is not atomic).
         self.pins = np.zeros(capacity, dtype=np.int32)
@@ -163,6 +198,7 @@ class BucketDirectory:
         self._bound[row] = True
         self.created_ns[row] = now_ns
         self.cap_base_nt[row] = 0
+        self.rate_per_ns[row] = 0
         raw = name.encode("utf-8", "surrogateescape")
         self.name_len[row] = len(raw)
         if len(raw) <= NAME_BYTES_MAX:
@@ -521,6 +557,7 @@ class BucketDirectory:
             src = np.asarray(new_src, dtype=np.int64)
             self.created_ns[nr] = now_ns
             self.cap_base_nt[nr] = 0
+            self.rate_per_ns[nr] = 0
             self.name_len[nr] = name_lens[src]
             self.name_hash[nr] = hashes[src]
             self.name_bytes[nr] = name_rows[src]
@@ -638,6 +675,128 @@ class BucketDirectory:
             self.cap_base_nt[row] = cap_nt
             return cap_nt
         return base
+
+    def note_rate(self, row: int, per_ns: int) -> None:
+        """Record a row's rate period (first non-zero wins, mirroring the
+        capacity base's lazy pin): the lifecycle sweep's refill
+        projection needs the full rate, which wire deltas never carry."""
+        if per_ns and self.rate_per_ns[row] == 0:
+            self.rate_per_ns[row] = per_ns
+
+    def note_rate_many(self, rows: np.ndarray, pers_ns: np.ndarray) -> None:
+        """Vectorized :meth:`note_rate` for the batch take paths."""
+        if not len(rows):
+            return
+        rows = np.asarray(rows, dtype=np.int64)
+        pers_ns = np.asarray(pers_ns, dtype=np.int64)
+        with self._mu:
+            unset = (self.rate_per_ns[rows] == 0) & (pers_ns != 0)
+            self.rate_per_ns[rows[unset][::-1]] = pers_ns[unset][::-1]
+
+    # -- bucket lifecycle (idle-bucket GC) ----------------------------------
+
+    def gc_candidates(
+        self, now_ns: int, idle_ns: int, limit: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Rows eligible for a lifecycle sweep: bound, unpinned, capacity
+        known, and idle for at least ``idle_ns`` (0 = pressure mode, any
+        bound row qualifies). Returns ``(rows, stamps)`` where ``stamps``
+        are the rows' ``last_used_ns`` at selection time —
+        :meth:`reclaim_rows` re-verifies them so any take/delta that
+        touches a row between the predicate read and the reclaim (it
+        refreshes ``last_used_ns`` at assign) voids the verdict. Oldest
+        rows first, capped at ``limit`` per sweep."""
+        with self._mu:
+            eligible = (
+                self._bound & (self.pins == 0) & (self.cap_base_nt > 0)
+            )
+            if idle_ns > 0:
+                eligible &= (now_ns - self.last_used_ns) >= idle_ns
+            idx = np.flatnonzero(eligible)
+            if idx.size > limit:
+                part = np.argpartition(self.last_used_ns[idx], limit - 1)[:limit]
+                idx = idx[part]
+            return idx.astype(np.int64), self.last_used_ns[idx].copy()
+
+    def reclaim_rows(
+        self,
+        rows: np.ndarray,
+        stamps: np.ndarray,
+        tombs: Sequence[Tuple[int, int, int]],
+    ) -> np.ndarray:
+        """Phase 1 of a lifecycle reclaim: re-verify each candidate under
+        the lock (still bound, still unpinned, ``last_used_ns`` unchanged
+        since :meth:`gc_candidates` — i.e. untouched since the IsZero
+        verdict was computed), tombstone the own-lane residue, and unbind.
+        Returns the rows actually reclaimed (in limbo — the caller zeroes
+        the device rows, then :meth:`recycle_compact`). ``tombs`` carries
+        each candidate's ``(own_added_nt, own_taken_nt, elapsed_ns)``."""
+        out: List[int] = []
+        with self._mu:
+            for i, row in enumerate(rows):
+                row = int(row)
+                if (
+                    not self._bound[row]
+                    or self.pins[row] != 0
+                    or self.last_used_ns[row] != stamps[i]
+                ):
+                    continue
+                a, t, e = tombs[i]
+                if a or t or e:
+                    name = self._names[row]
+                    if name is not None:
+                        self._tombstones.pop(name, None)  # refresh LRU slot
+                        self._tombstones[name] = (
+                            int(a), int(t), int(e), int(self.created_ns[row]),
+                        )
+                        while len(self._tombstones) > self.tombstone_cap:
+                            self._tombstones.pop(next(iter(self._tombstones)))
+                self._unbind_row_locked(row)
+                out.append(row)
+        return np.asarray(out, dtype=np.int64)
+
+    def pop_tombstone(
+        self, name: str, row: Optional[int] = None
+    ) -> Optional[Tuple[int, int, int, int]]:
+        """Consume a reclaimed bucket's tombstone on re-creation:
+        → ``(own_added_nt, own_taken_nt, elapsed_ns, created_ns)`` or
+        None. When ``row`` is given, the original creation stamp is
+        restored onto the row so the refill clock reconstructs exactly
+        (a fresh ``created_ns`` would stall or skew the projection)."""
+        with self._mu:
+            tomb = self._tombstones.pop(name, None)
+            if tomb is not None and row is not None and self._names[row] == name:
+                self.created_ns[row] = tomb[3]
+        return tomb
+
+    def has_tombstones(self) -> bool:
+        """Cheap probe for the bulk-ingest reseed tail (racy read of a
+        dict length — a miss only defers a seed to the name's next
+        creation, and the common case is an empty table)."""
+        return bool(self._tombstones)
+
+    def tombstone_stats(self) -> Tuple[int, int]:
+        """→ (entries, approximate bytes) for the budget accounting."""
+        n = len(self._tombstones)
+        return n, n * 56  # 4×int64 + dict/key overhead class
+
+    def recycle_compact(self, rows) -> bool:
+        """Phase 3 of a lifecycle reclaim: return zeroed limbo rows to the
+        free list and COMPACT it — descending row order, so ``pop()``
+        hands out the LOWEST free rows first and the live working set
+        stays packed toward the low end of the device planes (lane
+        reuse locality: gathers/zero sweeps touch a dense prefix instead
+        of a row soup). Returns True when the list was reordered (the
+        ``directory_compactions`` signal the engine counts)."""
+        with self._mu:
+            self._free.extend(int(r) for r in rows)
+            free = self._free
+            unordered = any(
+                free[i] < free[i + 1] for i in range(len(free) - 1)
+            )
+            if unordered:
+                free.sort(reverse=True)
+            return unordered
 
     def init_cap_base_many(self, rows: np.ndarray, caps_nt: np.ndarray) -> None:
         """Vectorized :meth:`init_cap_base` for the bulk paths: rows whose

@@ -63,8 +63,13 @@ from patrol_tpu.ops.take import (
     take_batch,
     remaining_for_request,
 )
+from patrol_tpu.ops import lifecycle as lifecycle_ops
 from patrol_tpu.runtime.bucket import ClockFn, system_clock
-from patrol_tpu.runtime.directory import BucketDirectory, DirectoryFullError
+from patrol_tpu.runtime.directory import (
+    BucketDirectory,
+    DirectoryFullError,
+    OverloadedError,
+)
 
 log = logging.getLogger("patrol.engine")
 
@@ -202,6 +207,33 @@ HOST_DEMOTE_TAKES = int(
 HOST_DEMOTE_WINDOW_NS = int(
     float(os.environ.get("PATROL_HOST_DEMOTE_WINDOW_MS", 200)) * 1e6
 )
+
+# Bucket lifecycle (ROADMAP item 4): idle-bucket GC on the feeder tick.
+# A bound bucket whose reconstructed value equals its rate-derived refill
+# (the IsZero predicate, ops/lifecycle.py) is reclaimed from the device
+# plane AND the host directory — under a power-law keyspace the cold tail
+# stops living forever in dense state. 0 disables the feeder cadence
+# (sweeps still run via gc_sweep(): tests, bench, operators).
+GC_WINDOW_NS = int(float(os.environ.get("PATROL_GC_WINDOW_MS", 500)) * 1e6)
+# Only buckets untouched for this long are sweep candidates at zero
+# budget pressure; pressure (and force) drops the idleness requirement —
+# the predicate alone already guarantees reclaim safety, idleness just
+# keeps the steady-state sweep off warm buckets.
+GC_IDLE_NS = int(float(os.environ.get("PATROL_GC_IDLE_MS", 1000)) * 1e6)
+# Candidate rows probed per sweep (one padded device gather).
+GC_SWEEP_MAX = int(os.environ.get("PATROL_GC_SWEEP_MAX", 8192))
+# Memory-budget watermarks: bound-bucket count and/or byte budget
+# (0 = unenforced). Crossing soft (GC_SOFT_FRAC × budget) ramps GC
+# pressure — sweeps ignore idleness and run at window/8 cadence; at the
+# hard watermark admission of NEW names sheds load with an explicit
+# OverloadedError (HTTP 429 "overloaded") instead of growing toward OOM.
+MAX_BUCKETS = int(os.environ.get("PATROL_MAX_BUCKETS", 0))
+STATE_BYTES_BUDGET = int(os.environ.get("PATROL_STATE_BYTES_BUDGET", 0))
+GC_SOFT_FRAC = float(os.environ.get("PATROL_GC_SOFT_FRAC", 0.85))
+
+# Host-side directory metadata attributable to one bound row (name bytes
+# + the per-row int64/int32 columns) — the budget accounting's row class.
+_ROW_HOST_BYTES = 256 + 64
 
 
 class HostLanes:
@@ -836,6 +868,32 @@ class DeviceEngine:
         # must not interleave with a gather/zero that would strand the
         # restored spend in zeroed device rows (see _maybe_demote).
         self._demotion_paused = False
+        # Bucket lifecycle: knobs are instance copies (tests and the soak
+        # bench tune them per engine via configure_lifecycle); the sweep
+        # bookkeeping below mutates under _evict_mu only (the same lock
+        # that already serializes every unbind/zero/recycle path) —
+        # declared in analysis/race.py::GUARDS like the rest.
+        self._gc_window_ns = GC_WINDOW_NS
+        self._gc_idle_ns = GC_IDLE_NS
+        self._gc_sweep_max = GC_SWEEP_MAX
+        self._max_buckets = MAX_BUCKETS
+        self._bytes_budget = STATE_BYTES_BUDGET
+        self._gc_soft_frac = GC_SOFT_FRAC
+        self._gc_win_start: Optional[int] = None
+        self._gc_reclaimed = 0
+        self._gc_shed = 0
+        self._gc_sweeps = 0
+        self._gc_compactions = 0
+        # Host-fastpath GC kick: takes served in-process never queue
+        # work, so a pure fast-path workload would starve the feeder's
+        # sweep cadence. The host-serve seams set this flag (two int
+        # reads per take) at window rollover and wake the feeder, which
+        # runs the sweep. Guarded by _cond like the work queues.
+        self._gc_due = False
+        if self._max_buckets or self._bytes_budget:
+            from patrol_tpu.utils import slo as slo_mod
+
+            slo_mod.SENTINEL.watch_budget(self._budget_snapshot)
         self._stopped = False
         self._busy = False
         self._ticks = 0  # device calls issued (observability)
@@ -924,6 +982,16 @@ class DeviceEngine:
         )
         if res is None:
             raise DirectoryFullError("every bucket row is mid-flight")
+        row, fresh = res
+        # Unpinned (introspection) creations re-seed here; the take path
+        # (pin=True, submit_take) pops the tombstone itself so it can
+        # write the seed into fresh HOST lanes before the first commit.
+        if fresh and not pin and self.directory.has_tombstones():
+            seed = self._pop_tombstone_seed(name, row)
+            if seed is not None:
+                with self._cond:
+                    self._deltas.append(_Delta(row, self.node_slot, *seed))
+                    self._cond.notify()
         return res
 
     def _assign_pinned(self, name: str, now: int) -> Tuple[int, bool]:
@@ -954,27 +1022,355 @@ class DeviceEngine:
             len(names),
         )
 
+    # -- bucket lifecycle: idle-bucket GC + memory budget (ROADMAP item 4)
+
+    def configure_lifecycle(
+        self,
+        window_ms: Optional[float] = None,
+        idle_ms: Optional[float] = None,
+        sweep_max: Optional[int] = None,
+        max_buckets: Optional[int] = None,
+        bytes_budget: Optional[int] = None,
+        soft_frac: Optional[float] = None,
+    ) -> None:
+        """Tune the lifecycle knobs on a live engine (tests, the soak
+        bench, operators). Setting a budget registers this engine with
+        the SLO sentinel so watermark breaches auto-fire flight-recorder
+        anomaly snapshots."""
+        if window_ms is not None:
+            self._gc_window_ns = int(window_ms * 1e6)
+        if idle_ms is not None:
+            self._gc_idle_ns = int(idle_ms * 1e6)
+        if sweep_max is not None:
+            self._gc_sweep_max = sweep_max
+        if max_buckets is not None:
+            self._max_buckets = max_buckets
+        if bytes_budget is not None:
+            self._bytes_budget = bytes_budget
+        if soft_frac is not None:
+            self._gc_soft_frac = soft_frac
+        if self._max_buckets or self._bytes_budget:
+            from patrol_tpu.utils import slo as slo_mod
+
+            slo_mod.SENTINEL.watch_budget(self._budget_snapshot)
+
+    def state_bytes_in_use(self) -> int:
+        """Bytes of limiter state attributable to live buckets: device
+        row planes (pn + elapsed), host directory metadata, host-resident
+        lanes, and GC tombstones — the ``/debug/vars`` accounting the
+        byte budget enforces against."""
+        n = self.config.nodes
+        row_bytes = n * 16 + 8 + _ROW_HOST_BYTES
+        _t_n, t_bytes = self.directory.tombstone_stats()
+        return (
+            len(self.directory) * row_bytes
+            + len(self._hosted) * (n * 16 + 64)
+            + t_bytes
+        )
+
+    def _budget_pressure(self) -> int:
+        """0 = under budget, 1 = soft watermark (GC pressure ramp),
+        2 = hard watermark (new-name admission sheds)."""
+        hard = soft = False
+        if self._max_buckets:
+            bound = len(self.directory)
+            hard |= bound >= self._max_buckets
+            soft |= bound >= int(self._max_buckets * self._gc_soft_frac)
+        if self._bytes_budget:
+            in_use = self.state_bytes_in_use()
+            hard |= in_use >= self._bytes_budget
+            soft |= in_use >= int(self._bytes_budget * self._gc_soft_frac)
+        return 2 if hard else (1 if soft else 0)
+
+    def _budget_snapshot(self) -> dict:
+        """The SLO sentinel's budget provider: breach ⇒ anomaly snapshot
+        (utils/slo.py watch_budget)."""
+        return {
+            "state_bytes_in_use": self.state_bytes_in_use(),
+            "state_bytes_budget": self._bytes_budget,
+            "buckets_bound": len(self.directory),
+            "max_buckets": self._max_buckets,
+            "over": self._budget_pressure() >= 2,
+        }
+
+    def _shed_new_names(self, now: int, n: int = 1) -> bool:
+        """Hard-watermark admission check for NEW bucket names: one
+        emergency sweep (damped to window/8 cadence) gets a chance to
+        free budget; if pressure holds, the caller sheds the admission
+        with an explicit signal instead of growing state. Existing names
+        are never shed — their state is already paid for."""
+        if self._budget_pressure() < 2:
+            return False
+        start = self._gc_win_start
+        if start is None or now - start > self._gc_window_ns // 8:
+            self.gc_sweep(now, force=True)
+            if self._budget_pressure() < 2:
+                return False
+        with self._evict_mu:
+            self._gc_shed += n
+        profiling.COUNTERS.inc("gc_pressure_shed", n)
+        trace_mod.anomaly("budget-shed")
+        return True
+
+    def _kick_gc_if_due(self, now: int) -> None:
+        """Host-fastpath seam: wake the feeder for a sweep when the GC
+        window rolled over (in-process takes never queue feeder work, so
+        without this a pure fast-path workload never collects). Cost on
+        the serve path: two int reads; the sweep itself runs on the
+        feeder."""
+        if not self._gc_window_ns:
+            return
+        start = self._gc_win_start
+        if start is not None and now - start <= self._gc_window_ns:
+            return
+        with self._cond:
+            self._gc_due = True
+            self._cond.notify()
+
+    def _maybe_gc(self) -> None:
+        """Feeder-tick lifecycle cadence: sweep at window rollover, or at
+        window/8 under budget pressure (the graceful-degradation ramp —
+        GC ramps first, only then does admission shed)."""
+        if not self._gc_window_ns:
+            return
+        now = self.clock()
+        start = self._gc_win_start
+        if start is None:
+            with self._evict_mu:
+                self._gc_win_start = now
+            return
+        window = self._gc_window_ns
+        if (self._max_buckets or self._bytes_budget) and self._budget_pressure():
+            window //= 8
+        if now - start > window:
+            self.gc_sweep(now)
+
+    def gc_sweep(self, now_ns: Optional[int] = None, force: bool = False) -> int:
+        """One lifecycle sweep: probe up to ``_gc_sweep_max`` idle
+        candidates through the IsZero kernel (ops/lifecycle.py — host
+        lanes answer via the numpy twin without a device hop), reclaim
+        the full ones from the device plane and the host directory, and
+        compact the free list. Returns buckets reclaimed. Callable from
+        any thread: every candidate's verdict is re-verified under
+        ``_evict_mu`` by :meth:`BucketDirectory.reclaim_rows` (pins and
+        an untouched ``last_used_ns`` stamp), so in-flight takes/deltas —
+        and rows that saw traffic after the probe — void their reclaim.
+
+        Conservation (the part the provers pin): the reclaimed bucket's
+        own PN lane + refill clock go into a directory tombstone and
+        re-seed the row on re-creation, so the own-lane G-counters stay
+        monotone across reclaim epochs — a peer's stale echo of the old
+        lane values can never absorb (erase) post-reclaim spend. The
+        protocol model's ``gc-drops-admitted-tokens`` mutation is exactly
+        this design with the tombstone dropped, and it is rejected."""
+        now = self.clock() if now_ns is None else now_ns
+        pressure = self._budget_pressure()
+        idle_ns = 0 if (force or pressure) else self._gc_idle_ns
+        t0 = time.perf_counter_ns()
+        cands, stamps = self.directory.gc_candidates(
+            now, idle_ns, self._gc_sweep_max
+        )
+        reclaimed = 0
+        if cands.size:
+            reclaimed = self._gc_reclaim(cands, stamps, now)
+        with self._evict_mu:
+            self._gc_sweeps += 1
+            self._gc_win_start = now
+        profiling.COUNTERS.inc("gc_sweeps")
+        profiling.COUNTERS.set_max(
+            "state_bytes_in_use", self.state_bytes_in_use()
+        )
+        hist.GC_SWEEP.record(time.perf_counter_ns() - t0)
+        return reclaimed
+
+    def _gc_reclaim(self, cands: np.ndarray, stamps: np.ndarray, now: int) -> int:
+        """Probe + reclaim body of :meth:`gc_sweep`."""
+        n = len(cands)
+        cap = self.directory.cap_base_nt[cands]
+        per = self.directory.rate_per_ns[cands]
+        created = self.directory.created_ns[cands]
+        full = np.zeros(n, bool)
+        own_a = np.zeros(n, np.int64)
+        own_t = np.zeros(n, np.int64)
+        el = np.zeros(n, np.int64)
+        # Rows mid-promotion live in NEITHER plane completely (lanes
+        # popped, device join not landed): never probe or reclaim them.
+        # A promotion requested after this snapshot is caught by the
+        # reclaim's last_used stamp — the takes that triggered it
+        # refreshed the row at assign.
+        with self._host_mu:
+            promo = set(self._promote_pending) | set(self._promoting)
+            hosted_sel = self._hosted_flag[cands].copy()
+        if promo:
+            keep = np.array([int(r) not in promo for r in cands], bool)
+        else:
+            keep = np.ones(n, bool)
+        host_idx = np.flatnonzero(hosted_sel & keep)
+        if host_idx.size:
+            with self._host_mu:
+                for i in host_idx:
+                    lanes = self._hosted.get(int(cands[i]))
+                    if lanes is None:
+                        continue
+                    sa = int(lanes.added.sum())
+                    st = int(lanes.taken.sum())
+                    full[i] = bool(
+                        lifecycle_ops.host_lifecycle_full(
+                            sa, st, lanes.elapsed_ns, cap[i], created[i],
+                            now, per[i],
+                        )
+                    )
+                    own_a[i] = int(lanes.added[self.node_slot])
+                    own_t[i] = int(lanes.taken[self.node_slot])
+                    el[i] = lanes.elapsed_ns
+        dev_idx = np.flatnonzero(~hosted_sel & keep)
+        if dev_idx.size:
+            m = len(dev_idx)
+            k = _pad_size(m, lo=8, hi=1 << 20)
+            rows_p = np.zeros(k, np.int32)
+            rows_p[:m] = cands[dev_idx]
+            pad = np.zeros(k, np.int64)
+
+            def col(vals):
+                out = pad.copy()
+                out[:m] = vals
+                return jnp.asarray(out)
+
+            probe = lifecycle_ops.LifecycleProbe(
+                rows=jnp.asarray(rows_p),
+                now_ns=col(np.full(m, now, np.int64)),
+                per_ns=col(per[dev_idx]),
+                cap_base_nt=col(cap[dev_idx]),  # padding keeps cap 0 ⇒ never full
+                created_ns=col(created[dev_idx]),
+            )
+            with self._state_mu:
+                view = lifecycle_ops.lifecycle_probe_jit(
+                    self.state, probe, self.node_slot
+                )
+            full[dev_idx] = np.asarray(view.full)[:m]
+            own_a[dev_idx] = np.asarray(view.own_added_nt)[:m]
+            own_t[dev_idx] = np.asarray(view.own_taken_nt)[:m]
+            el[dev_idx] = np.asarray(view.elapsed_ns)[:m]
+        vict = np.flatnonzero(full)
+        if not vict.size:
+            return 0
+        with self._evict_mu:
+            kept = self.directory.reclaim_rows(
+                cands[vict],
+                stamps[vict],
+                [(own_a[i], own_t[i], el[i]) for i in vict],
+            )
+            if not kept.size:
+                return 0
+            self._drop_hosted_rows(kept)
+            k = _pad_size(int(kept.size), lo=8, hi=1 << 20)
+            rows_z = np.full(k, kept[0], np.int32)
+            rows_z[: kept.size] = kept
+            with self._state_mu:
+                self.state = zero_rows_jit(self.state, jnp.asarray(rows_z))
+            if self.directory.recycle_compact(kept):
+                self._gc_compactions += 1
+                profiling.COUNTERS.inc("directory_compactions")
+            self._gc_reclaimed += int(kept.size)
+        profiling.COUNTERS.inc("gc_buckets_reclaimed", int(kept.size))
+        log.debug("lifecycle GC reclaimed %d full idle buckets", kept.size)
+        return int(kept.size)
+
+    def _pop_tombstone_seed(self, name: str, row: int):
+        """Consume a reclaimed bucket's tombstone at re-creation:
+        → (own_added_nt, own_taken_nt, elapsed_ns) or None. Restores the
+        row's original creation stamp so the refill clock reconstructs
+        exactly. The seed MUST land before the row's first take commit
+        (callers order it into the same tick's merge phase, or write it
+        straight into fresh host lanes) — a later join would let the
+        tombstone values absorb the first takes' debits."""
+        tomb = self.directory.pop_tombstone(name, row)
+        if tomb is None:
+            return None
+        return tomb[0], tomb[1], tomb[2]
+
+    def _reseed_fresh_rows(self, names, rows, fresh_mask) -> None:
+        """Bulk-ingest tail: queue tombstone seeds for freshly-bound rows
+        (merge order against the triggering deltas is free — joins
+        commute)."""
+        if not self.directory.has_tombstones():
+            return
+        seeds = []
+        seen = set()
+        for i in np.flatnonzero(fresh_mask):
+            row = int(rows[i])
+            if row in seen:
+                continue
+            seen.add(row)
+            seed = self._pop_tombstone_seed(names[i], row)
+            if seed is not None:
+                seeds.append(_Delta(row, self.node_slot, *seed))
+        if seeds:
+            with self._cond:
+                self._deltas.extend(seeds)
+                self._cond.notify()
+
+    def lifecycle_stats(self) -> Dict[str, object]:
+        """The bucket-lifecycle accounting block for ``/debug/vars`` and
+        the soak receipts (live gauges; the CounterRegistry carries the
+        cluster-mergeable monotone counters next to these)."""
+        t_n, _t_bytes = self.directory.tombstone_stats()
+        return {
+            "engine_gc_reclaimed": self._gc_reclaimed,
+            "engine_gc_shed": self._gc_shed,
+            "engine_gc_sweeps": self._gc_sweeps,
+            "engine_gc_compactions": self._gc_compactions,
+            "engine_gc_tombstones": t_n,
+            "engine_state_bytes": self.state_bytes_in_use(),
+            "engine_state_bytes_budget": self._bytes_budget,
+            "engine_max_buckets": self._max_buckets,
+            "engine_buckets_bound": len(self.directory),
+            "engine_budget_pressure": self._budget_pressure(),
+        }
+
     # -- entry points -------------------------------------------------------
 
     def submit_take(
         self, name: str, rate: Rate, count: int, now_ns: Optional[int] = None
     ) -> Tuple[TakeTicket, bool]:
         """Queue a take; returns (ticket, created). ``created`` mirrors the
-        get-or-create miss signal that triggers incast (repo.go:96-106)."""
+        get-or-create miss signal that triggers incast (repo.go:96-106).
+        Raises :class:`OverloadedError` for a NEW name when the memory
+        budget's hard watermark holds after an emergency GC sweep — the
+        explicit 429-class shed signal of the lifecycle layer."""
         now = self.clock() if now_ns is None else now_ns
+        if (
+            (self._max_buckets or self._bytes_budget)
+            and self.directory.lookup(name) is None
+            and self._shed_new_names(now)
+        ):
+            raise OverloadedError(
+                "memory budget spent and nothing reclaimable; "
+                f"new bucket {name!r} shed"
+            )
         row, fresh = self._assign_pinned(name, now)
+        seed = self._pop_tombstone_seed(name, row) if fresh else None
         # First *local* take on the row (capacity still unset) counts as a
         # miss for incast purposes even when replication created the row
         # first: scalar (v1-peer) deltas are dropped while the capacity is
         # unknown, so peer state must be re-solicited now that it is.
         created = fresh or int(self.directory.cap_base_nt[row]) == 0
         self.directory.init_cap_base(row, rate.freq * NANO)
+        self.directory.note_rate(row, rate.per_ns)
         if HOST_FASTPATH and (fresh or self._hosted_flag[row]):
-            ticket = self._try_host_take(name, row, rate, count, now, fresh)
+            ticket = self._try_host_take(
+                name, row, rate, count, now, fresh, seed=seed
+            )
             if ticket is not None:
+                self._kick_gc_if_due(now)
                 return ticket, created
         ticket = TakeTicket(name, row, rate, count, now)
         with self._cond:
+            if seed is not None:
+                # Tombstone re-seed rides the SAME tick's merge phase —
+                # merges apply before takes, so the first take commits on
+                # top of the restored own lane, never below it.
+                self._deltas.append(_Delta(row, self.node_slot, *seed))
             self._takes.append(ticket)
             self._cond.notify()
         return ticket, created
@@ -990,13 +1386,14 @@ class DeviceEngine:
         now: int,
         fresh: bool,
         out_broadcasts: Optional[List[wire.WireState]] = None,
+        seed: Optional[Tuple[int, int, int]] = None,
     ) -> Optional[TakeTicket]:
         """Serve one take from the host-resident lane model, in-process.
         Returns the already-completed ticket, or None when the row is (or
         just became) device-resident — the caller falls through to the
         device queue."""
         ticket = TakeTicket(name, row, rate, count, now)
-        served = self._host_serve_ticket(ticket, fresh, out_broadcasts)
+        served = self._host_serve_ticket(ticket, fresh, out_broadcasts, seed)
         return ticket if served else None
 
     def _host_serve_ticket(
@@ -1004,6 +1401,7 @@ class DeviceEngine:
         ticket: TakeTicket,
         fresh: bool,
         out_broadcasts: Optional[List[wire.WireState]] = None,
+        seed: Optional[Tuple[int, int, int]] = None,
     ) -> bool:
         """Complete an existing ticket from the host lane model; False ⇒
         the row is device-resident and the caller keeps the device path.
@@ -1041,6 +1439,14 @@ class DeviceEngine:
                     lanes = self._native_store.host_locked(row)
                 else:
                     lanes = HostLanes(self.config.nodes)
+                if seed is not None:
+                    # Tombstone re-seed (lifecycle GC): the fresh lanes
+                    # resume at the reclaimed bucket's own-lane values
+                    # BEFORE the first take commits, so stale peer echoes
+                    # can never absorb post-reclaim spend.
+                    lanes.added[self.node_slot] = seed[0]
+                    lanes.taken[self.node_slot] = seed[1]
+                    lanes.elapsed_ns = seed[2]
                 self._hosted[row] = lanes
                 self._hosted_flag[row] = True
             lanes.roll_window(now)
@@ -1533,6 +1939,10 @@ class DeviceEngine:
         st = self._native_store
         if st is None:
             return
+        # The C++ front serves takes without entering Python at all —
+        # the pump's drain cycle is the one periodic seam that can keep
+        # the GC cadence alive under pure in-front load.
+        self._kick_gc_if_due(self.clock())
         if self.on_broadcast is None:
             # Standalone node: drain the queues (promotion marks still
             # matter; dirty flags must clear) without building states.
@@ -1610,8 +2020,46 @@ class DeviceEngine:
         append + wake-up, instead of per-request lock/notify churn.
         Returns [(ticket, created), ...] in request order, or None when
         the pool is spent with every row pinned (the caller falls back or
-        fails the batch)."""
+        fails the batch). Under the memory budget's hard watermark,
+        requests for NEW names come back as already-completed shed
+        tickets (ok=False) — per-request 429s, never a failed batch."""
         now = self.clock() if now_ns is None else now_ns
+        if self._max_buckets or self._bytes_budget:
+            unknown = [
+                i for i, n in enumerate(names)
+                if self.directory.lookup(n) is None
+            ]
+            if unknown and self._shed_new_names(now, len(unknown)):
+                shed = set(unknown)
+                out: List = [None] * len(names)
+                for i in unknown:
+                    t = TakeTicket(names[i], 0, rates[i], int(counts[i]), now)
+                    t.complete(0, False)  # never pinned, never queued
+                    out[i] = (t, False)
+                keep = [i for i in range(len(names)) if i not in shed]
+                if keep:
+                    sub = self._submit_takes_batch_inner(
+                        [names[i] for i in keep],
+                        [rates[i] for i in keep],
+                        [counts[i] for i in keep],
+                        now,
+                    )
+                    if sub is None:
+                        return None
+                    for i, r in zip(keep, sub):
+                        out[i] = r
+                return out
+        return self._submit_takes_batch_inner(
+            list(names), list(rates), list(counts), now
+        )
+
+    def _submit_takes_batch_inner(
+        self,
+        names: Sequence[str],
+        rates: Sequence[Rate],
+        counts: Sequence[int],
+        now: int,
+    ) -> Optional[List[Tuple[TakeTicket, bool]]]:
         res = self._assign_many_pinned(list(names), now, with_fresh=True)
         if res is None:
             return None
@@ -1626,6 +2074,19 @@ class DeviceEngine:
         self.directory.init_cap_base_many(
             rows, np.asarray([r.freq for r in rates], np.int64) * NANO
         )
+        self.directory.note_rate_many(
+            rows, np.asarray([r.per_ns for r in rates], np.int64)
+        )
+        # Tombstone re-seeds for rows bound fresh by this batch (one per
+        # first occurrence): applied into the fresh host lanes below, or
+        # queued into the tick's merge phase for the device path.
+        fresh_first_all = bind_fresh & first
+        seeds: Dict[int, Tuple[int, int, int]] = {}
+        if fresh_first_all.any() and self.directory.has_tombstones():
+            for i in np.flatnonzero(fresh_first_all):
+                s = self._pop_tombstone_seed(names[i], int(rows[i]))
+                if s is not None:
+                    seeds[int(rows[i])] = s
         # Host fast path: serve host-resident (and fresh) rows in-process,
         # in batch order; only the device-resident remainder rides a tick.
         # The flag is re-read per request (not precomputed): a fresh row
@@ -1637,7 +2098,7 @@ class DeviceEngine:
         # lane deltas never set the cap).
         host_served: Dict[int, TakeTicket] = {}
         if HOST_FASTPATH:
-            fresh_first = bind_fresh & first
+            fresh_first = fresh_first_all
             # Candidates only — the device-only common case stays one
             # vectorized probe. Every later occurrence of a row hosted by
             # its own first occurrence has bind_fresh True, so it is in
@@ -1650,9 +2111,13 @@ class DeviceEngine:
                     t = self._try_host_take(
                         names[i], int(rows[i]), rates[i], int(counts[i]),
                         now, bool(fresh_first[i]), out_broadcasts=bc,
+                        seed=seeds.get(int(rows[i])),
                     )
                     if t is not None:
                         host_served[int(i)] = t
+                        if fresh_first[i]:
+                            # Seed landed in the fresh host lanes.
+                            seeds.pop(int(rows[i]), None)
             self._emit_broadcasts(bc)
         tickets = [
             host_served.get(i)
@@ -1660,8 +2125,16 @@ class DeviceEngine:
             for i in range(len(names))
         ]
         queued = [t for i, t in enumerate(tickets) if i not in host_served]
-        if queued:
+        if host_served and not queued:
+            # Fully host-served batch: no feeder work queued — kick the
+            # GC cadence like the scalar fast path does.
+            self._kick_gc_if_due(now)
+        if queued or seeds:
             with self._cond:
+                for srow, s in seeds.items():
+                    # Un-hosted fresh binds: the seed rides the same
+                    # tick's merge phase, ahead of the queued takes.
+                    self._deltas.append(_Delta(srow, self.node_slot, *s))
                 self._takes.extend(queued)
                 self._cond.notify()
         return list(zip(tickets, created))
@@ -1700,6 +2173,12 @@ class DeviceEngine:
         except DirectoryFullError:
             log.warning("pool spent (all pinned); delta for %r dropped", state.name)
             return False
+        if created and self.directory.has_tombstones():
+            seed = self._pop_tombstone_seed(state.name, row)
+            if seed is not None:
+                with self._cond:
+                    self._deltas.append(_Delta(row, self.node_slot, *seed))
+                    self._cond.notify()
         added_nt = state.added_nt
         taken_nt = state.taken_nt
         if state.cap_nt is not None:
@@ -1820,12 +2299,15 @@ class DeviceEngine:
         for lo in range(0, len(names), MAX_MERGE_ROWS):
             hi = lo + MAX_MERGE_ROWS
             chunk_names = names[lo:hi]
-            rows = self._assign_many_pinned(chunk_names, now)
-            if rows is None:
+            res = self._assign_many_pinned(chunk_names, now, with_fresh=True)
+            if res is None:
                 log.warning(
                     "pool spent (all pinned); %d deltas dropped", len(chunk_names)
                 )
                 continue
+            rows, fresh = res
+            if fresh.any():
+                self._reseed_fresh_rows(chunk_names, rows, fresh)
             accepted += self._classify_queue_chunk(
                 rows,
                 slots_a[lo:hi],
@@ -1876,13 +2358,16 @@ class DeviceEngine:
         for lo in range(0, len(names), MAX_MERGE_ROWS):
             hi = lo + MAX_MERGE_ROWS
             chunk_names = names[lo:hi]
-            rows = self._assign_many_pinned(chunk_names, now)
-            if rows is None:
+            res = self._assign_many_pinned(chunk_names, now, with_fresh=True)
+            if res is None:
                 log.warning(
                     "pool spent (all pinned); %d interval deltas dropped",
                     len(chunk_names),
                 )
                 continue
+            rows, fresh_c = res
+            if fresh_c.any():
+                self._reseed_fresh_rows(chunk_names, rows, fresh_c)
             slots_c = slots_a[lo:hi]
             caps_c = np.maximum(caps_a[lo:hi], 0)
             added_c = np.maximum(added_a[lo:hi], 0)
@@ -2102,6 +2587,12 @@ class DeviceEngine:
         )
         if rows is None:
             log.warning("pool spent (all pinned); %d deltas dropped", mi.size)
+        elif self.directory.has_tombstones():
+            # Wire misses are creations by definition: re-seed any
+            # reclaimed bucket's own lane from its tombstone.
+            self._reseed_fresh_rows(
+                miss_names, rows, np.ones(len(rows), dtype=bool)
+            )
         return rows
 
     def ingest_wire_batch(
@@ -2452,6 +2943,25 @@ class DeviceEngine:
         while size <= 1024:  # snapshot/introspection gathers
             self.read_rows(np.zeros(size, np.int32))
             size <<= 1
+        # Lifecycle sweep probe diagonal: the GC cadence must never JIT a
+        # fresh variant mid-serve while holding _state_mu (cap 0 padding
+        # means the all-zero warm probe can never report full).
+        size = 8
+        hi = _pad_size(self._gc_sweep_max, lo=8, hi=1 << 20)
+        while size <= hi:
+            with self._state_mu:
+                lifecycle_ops.lifecycle_probe_jit(
+                    self.state,
+                    lifecycle_ops.LifecycleProbe(
+                        rows=jnp.zeros(size, jnp.int32),
+                        now_ns=jnp.zeros(size, jnp.int64),
+                        per_ns=jnp.zeros(size, jnp.int64),
+                        cap_base_nt=jnp.zeros(size, jnp.int64),
+                        created_ns=jnp.zeros(size, jnp.int64),
+                    ),
+                    self.node_slot,
+                )
+            size <<= 1
         jax.block_until_ready(self.state.pn)
 
     def flush(self, timeout: float = 5.0) -> bool:
@@ -2475,6 +2985,9 @@ class DeviceEngine:
         return False
 
     def stop(self) -> None:
+        from patrol_tpu.utils import slo as slo_mod
+
+        slo_mod.SENTINEL.unwatch_budget(self._budget_snapshot)
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
@@ -2631,11 +3144,13 @@ class DeviceEngine:
                     self._takes
                     or self._deltas
                     or self._promote_pending
+                    or self._gc_due
                     or self._stopped
                 ):
                     self._cond.wait()
                 if self._stopped and not (self._takes or self._deltas):
                     return
+                self._gc_due = False  # this tick runs _maybe_gc below
                 # Drain up to _commit_blocks blocks per tick: everything
                 # past one block's budget coalesces into a single commit
                 # dispatch (_commit_coalesced) instead of riding extra
@@ -2663,6 +3178,10 @@ class DeviceEngine:
                             self._dev_window.get(t.row, 0) + 1
                         )
                 self._maybe_demote(tickets, deltas)
+            # Bucket lifecycle: sweep full idle buckets at the GC window
+            # cadence (pressure ramps it 8x). In-hand work is safe by
+            # construction — this tick's deltas and tickets hold pins.
+            self._maybe_gc()
             # Residency re-route: a ticket that raced into the device queue
             # while its row was (or became) host-resident is served from
             # the host model here — the one point every queued take passes

@@ -462,6 +462,15 @@ class MeshEngine(DeviceEngine):
             # note): consumed by bench --mesh receipts and the ROADMAP
             # item-4 lifecycle work.
             mesh_demotion="unsupported",
+            # Bucket lifecycle on the mesh: sharded-plane idle DEMOTION
+            # stays unsupported (above), but the lifecycle GC path is
+            # fully inherited — the IsZero probe and zero_rows both run
+            # as GSPMD programs over the sharded planes, so the mesh
+            # sheds cold state via host-directory GC like the
+            # single-device engine. Measured cost rides the shared
+            # ``gc_sweep_ns`` histogram; reclaim counts ride
+            # ``engine_gc_reclaimed`` / ``gc_buckets_reclaimed``.
+            mesh_gc="host-directory",
             mesh_converge_kernel=(
                 "tree"
                 if self.plan.replicas > 1

@@ -234,6 +234,11 @@ FRONT_WAIT = HISTOGRAMS.get("http_front_wait_ns")
 # result-readback deltas in runtime/engine.py).
 STAGE_DEVICE_COMMIT = HISTOGRAMS.get("device_commit_ns")
 STAGE_DEVICE_TAKE = HISTOGRAMS.get("device_take_ns")
+# Bucket-lifecycle sweep duration (idle-bucket GC, runtime/engine.py
+# gc_sweep): candidate selection + IsZero probe + reclaim, end to end.
+# Not an ingest/device stage column — the sweep is a maintenance path,
+# so it must not gate the smoke's every-stage-has-samples assertion.
+GC_SWEEP = HISTOGRAMS.get("gc_sweep_ns")
 
 # The bench's per-stage attribution set (benchmarks/PROBES.md).
 INGEST_STAGES = (

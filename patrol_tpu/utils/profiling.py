@@ -237,8 +237,20 @@ class CounterRegistry:
     * ``fleet_packets_tx`` / ``fleet_packets_rx`` — patrol-fleet metrics
       gossip datagrams shipped and joined (net/fleet.py);
     * ``slo_breaches`` — SLO sentinel breach classes fired (take-latency
-      burn rate / stage-budget overrun, utils/slo.py — each also freezes
-      a flight-recorder anomaly snapshot).
+      burn rate / stage-budget overrun / memory-budget watermark,
+      utils/slo.py — each also freezes a flight-recorder anomaly
+      snapshot);
+    * ``gc_sweeps`` / ``gc_buckets_reclaimed`` — bucket-lifecycle sweeps
+      run and full idle buckets reclaimed from the device plane + host
+      directory (runtime/engine.py gc_sweep, the IsZero predicate of
+      ops/lifecycle.py);
+    * ``gc_pressure_shed`` — NEW bucket names shed with the explicit
+      429/overloaded signal at the memory budget's hard watermark;
+    * ``directory_compactions`` — free-list compactions after a reclaim
+      (lane-reuse locality: lowest rows hand out first);
+    * ``state_bytes_in_use`` — high-water bytes of live limiter state
+      (device rows + directory metadata + host lanes + GC tombstones);
+      the live gauge rides ``engine_state_bytes`` in ``/debug/vars``.
 
     Monotonic counts + high-water gauges only; all call sites are
     per-tick/per-batch (kHz), so one mutex is noise-level overhead.
@@ -270,6 +282,11 @@ class CounterRegistry:
         "fleet_packets_tx",
         "fleet_packets_rx",
         "slo_breaches",
+        "gc_sweeps",
+        "gc_buckets_reclaimed",
+        "gc_pressure_shed",
+        "directory_compactions",
+        "state_bytes_in_use",
     )
 
     def __init__(self):

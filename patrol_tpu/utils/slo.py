@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from patrol_tpu.utils import histogram as hist
 from patrol_tpu.utils import profiling
@@ -74,6 +74,27 @@ class SloSentinel:
         self._mu = threading.Lock()
         self._last: Dict[str, List[int]] = {}
         self.breaches = 0
+        # Bucket-lifecycle budget provider (engine._budget_snapshot):
+        # registered when a memory budget is configured, polled on every
+        # check — a hard-watermark breach freezes evidence exactly like a
+        # latency burn.
+        self._budget_src: Optional[Callable[[], dict]] = None
+
+    def watch_budget(self, provider: Callable[[], dict]) -> None:
+        """Register the engine's memory-budget snapshot provider (dict
+        with ``over`` plus the accounting gauges). Latest engine wins —
+        one process serves one engine."""
+        with self._mu:
+            self._budget_src = provider
+
+    def unwatch_budget(self, provider: Callable[[], dict]) -> None:
+        """Engine shutdown: drop the provider IF it is still ours (a
+        replacement engine's registration must survive). Equality, not
+        identity: bound methods are fresh objects per attribute access —
+        ``==`` compares (instance, function)."""
+        with self._mu:
+            if self._budget_src == provider:
+                self._budget_src = None
 
     def configure(
         self,
@@ -150,6 +171,31 @@ class SloSentinel:
                                 "budget_ns": self.stage_budget_ns,
                             }
                         )
+            budget_src = self._budget_src
+            if budget_src is not None:
+                try:
+                    snap = budget_src()
+                except Exception:  # pragma: no cover - provider must not kill checks
+                    snap = None
+                if snap and snap.get("over"):
+                    breaches.append(
+                        {
+                            "kind": "budget",
+                            "stage": "state_bytes",
+                            "window": 1,
+                            "burn": 1.0,
+                            "budget_ns": 0,
+                            **{
+                                k: snap.get(k, 0)
+                                for k in (
+                                    "state_bytes_in_use",
+                                    "state_bytes_budget",
+                                    "buckets_bound",
+                                    "max_buckets",
+                                )
+                            },
+                        }
+                    )
             if breaches:
                 self.breaches += len(breaches)
         for kind in sorted({b["kind"] for b in breaches}):

@@ -64,6 +64,16 @@ TREND_GATES: Dict[str, dict] = {
     # wide tolerances; the bit-exactness booleans below are the hard gate.
     "mesh_smoke_merges_per_s": {"direction": "higher", "rel_tol": 0.75},
     "mesh_smoke_take_rps": {"direction": "higher", "rel_tol": 0.75},
+    # soak smoke (bucket lifecycle): blocking-take throughput under GC
+    # churn and the first-vs-last-window p99 drift ratio — wall-clock-
+    # class on shared CI, so wide tolerances; the exactness/nonzero
+    # gates below carry the hard content.
+    # Blocking single-caller takes: the most wall-clock-sensitive number
+    # in the receipt set (a busy CI neighbor halves it) — widest band.
+    "soak_takes_per_s": {"direction": "higher", "rel_tol": 0.9},
+    "soak_p99_drift_x": {
+        "direction": "lower", "rel_tol": 2.0, "abs_floor": 1.0,
+    },
 }
 
 # Hard boolean/exactness gates: value must equal the expectation.
@@ -82,12 +92,30 @@ EXACT_GATES: Dict[str, object] = {
     "mesh_tree_vs_flat": "bit-exact",
     "mesh_converge_kernel": "tree",
     "mesh_demotion": "unsupported",
+    # mesh lifecycle: sharded-plane demotion stays unsupported (above),
+    # but the GC path must shed via host-directory reclaim.
+    "mesh_gc": "host-directory",
+    # soak smoke (bucket lifecycle, ROADMAP item 4): the post-GC
+    # reconstructed fixpoint and per-take outcomes must match the no-GC
+    # reference bit-exactly, the footprint must hold under the budget
+    # for the whole soak with zero main-phase sheds, and the shed path
+    # must demonstrably engage when nothing is reclaimable.
+    "soak_fixpoint_equal": "bit-exact",
+    "soak_admits_equal": True,
+    "soak_footprint_under_budget": True,
+    "soak_shed_main": 0,
 }
 
 # Fields that must be present AND strictly positive (no baseline needed):
 # instrumentation liveness — a zero means the device-timing plane lost
 # the mesh path.
-NONZERO_GATES = ("mesh_kernel_step_samples",)
+NONZERO_GATES = (
+    "mesh_kernel_step_samples",
+    # The lifecycle must actually CYCLE during the soak: buckets
+    # reclaimed, and the frozen-clock shed probe drew explicit sheds.
+    "soak_reclaimed",
+    "soak_shed_probe",
+)
 
 # Device-stage columns (patrol-fleet device-dispatch timing): the smoke's
 # ingest_stage_breakdown must carry samples in these — an empty column

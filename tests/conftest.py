@@ -22,6 +22,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # tests/test_fleet.py, which drives plane.flush() explicitly (and one
 # test exercises the real flusher thread with a tight interval).
 os.environ.setdefault("PATROL_FLEET_GOSSIP_MS", "0")
+# Bucket-lifecycle GC likewise stays MANUALLY paced under test: the
+# feeder's window-rollover sweep observes the injected clock at
+# wall-clock-dependent ticks, so a seeded differential run (fastpath vs
+# device, chaos schedules) would reclaim-and-recreate buckets at
+# nondeterministic points — flipping `created` flags and incast traffic
+# between runs. Lifecycle behavior itself is covered by
+# tests/test_lifecycle.py (and the chaos GC suite), which drive
+# engine.gc_sweep() / configure_lifecycle() explicitly.
+os.environ.setdefault("PATROL_GC_WINDOW_MS", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 _m = re.search(r"--xla_force_host_platform_device_count=(\d+)", _flags)
 if _m is None or int(_m.group(1)) < 8:

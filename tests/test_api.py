@@ -339,3 +339,29 @@ class TestTokensRoute:
     def test_name_too_long_400(self, srv):
         status, _ = srv.request("GET", "/tokens/" + "n" * 232)
         assert status == 400
+
+
+class TestOverloadShed:
+    """Bucket-lifecycle budget enforcement at the HTTP layer: at the
+    hard watermark a NEW name sheds with an explicit 429 (python front:
+    "overloaded" via OverloadedError; native front: a shed ticket), and
+    existing buckets keep serving. Reset afterwards — the harness is
+    module-scoped."""
+
+    def test_hard_watermark_returns_429_for_new_names_only(self, srv):
+        srv.clock_ns += 1_000_000
+        status, _ = srv.request("POST", "/take/shed-existing?rate=5:1s")
+        assert status == 200
+        bound = len(srv.engine.directory)
+        srv.engine.configure_lifecycle(max_buckets=max(bound // 2, 1))
+        try:
+            status, body = srv.request(
+                "POST", "/take/shed-brand-new-name?rate=5:1s"
+            )
+            assert status == 429, (status, body)
+            assert srv.engine.directory.lookup("shed-brand-new-name") is None
+            # Existing buckets are never shed.
+            status, _ = srv.request("POST", "/take/shed-existing?rate=5:1s")
+            assert status == 200
+        finally:
+            srv.engine.configure_lifecycle(max_buckets=0)

@@ -35,7 +35,14 @@ def healthy_receipts():
             "mesh_tree_vs_flat": "bit-exact",
             "mesh_converge_kernel": "tree",
             "mesh_demotion": "unsupported",
+            "mesh_gc": "host-directory",
             "mesh_kernel_step_samples": 1501,
+            "soak_fixpoint_equal": "bit-exact",
+            "soak_admits_equal": True,
+            "soak_footprint_under_budget": True,
+            "soak_shed_main": 0,
+            "soak_reclaimed": 4164,
+            "soak_shed_probe": 63,
             "ingest_stage_breakdown": {
                 "device_commit_ns": {"count": 3, "p50_ns": 1, "p99_ns": 2},
                 "device_take_ns": {"count": 32, "p50_ns": 1, "p99_ns": 2},
@@ -180,3 +187,45 @@ class TestCliEntry:
         )
         assert proc.returncode == 2
         assert "verdict=error" in proc.stdout
+
+
+class TestSoakGates:
+    """Bucket-lifecycle soak fields in the trend gate: the exactness
+    booleans are hard, the lifecycle counters must be positive, and the
+    zero-shed main phase is pinned exactly."""
+
+    def test_soak_fixpoint_flip_rejected(self):
+        bad = healthy_receipts()
+        bad["soak_fixpoint_equal"] = "FAILED"
+        base = json.load(
+            open(os.path.join(REPO, "benchmarks", "TREND_BASELINE.json"))
+        )
+        regressions, _ = bench_gate.check_trend(base, bad)
+        assert any(r["field"] == "soak_fixpoint_equal" for r in regressions)
+
+    def test_soak_main_phase_shed_rejected(self):
+        bad = healthy_receipts()
+        bad["soak_shed_main"] = 7  # budget breached during the soak
+        base = json.load(
+            open(os.path.join(REPO, "benchmarks", "TREND_BASELINE.json"))
+        )
+        regressions, _ = bench_gate.check_trend(base, bad)
+        assert any(r["field"] == "soak_shed_main" for r in regressions)
+
+    def test_soak_lifecycle_must_cycle(self):
+        bad = healthy_receipts()
+        bad["soak_reclaimed"] = 0
+        base = json.load(
+            open(os.path.join(REPO, "benchmarks", "TREND_BASELINE.json"))
+        )
+        regressions, _ = bench_gate.check_trend(base, bad)
+        assert any(r["field"] == "soak_reclaimed" for r in regressions)
+
+    def test_mesh_gc_capability_pinned(self):
+        bad = healthy_receipts()
+        bad["mesh_gc"] = "unsupported"
+        base = json.load(
+            open(os.path.join(REPO, "benchmarks", "TREND_BASELINE.json"))
+        )
+        regressions, _ = bench_gate.check_trend(base, bad)
+        assert any(r["field"] == "mesh_gc" for r in regressions)

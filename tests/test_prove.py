@@ -494,3 +494,88 @@ class TestTreeConvergeObligations:
             out = topo.tree_reduce_states(jnp.asarray(pn), jnp.asarray(el))
             assert np.array_equal(np.asarray(out.pn), pn.max(axis=0))
             assert np.array_equal(np.asarray(out.elapsed), el.max(axis=0))
+
+
+# ---------------------------------------------------------------------------
+# Bucket-lifecycle obligations (idle-bucket GC, ROADMAP item 4): the
+# IsZero predicate's conservation suite, proven both ways.
+
+
+def always_full_probe(state, probe, node_slot):
+    """Seeded unsound predicate: declares every capacity-known bucket
+    reclaimable, ignoring un-refilled spend — the exact
+    'gc-drops-admitted-tokens' bug class."""
+    from patrol_tpu.ops import lifecycle as lc
+
+    out = lc.lifecycle_probe(state, probe, node_slot)
+    return lc.LifecycleView(
+        full=probe.cap_base_nt > 0,
+        own_added_nt=out.own_added_nt,
+        own_taken_nt=out.own_taken_nt,
+        elapsed_ns=out.elapsed_ns,
+    )
+
+
+def flapping_probe(state, probe, node_slot):
+    """Seeded non-monotone predicate: the verdict depends on clock parity,
+    so a delayed sweep flips reclaim decisions."""
+    from patrol_tpu.ops import lifecycle as lc
+
+    out = lc.lifecycle_probe(state, probe, node_slot)
+    return lc.LifecycleView(
+        full=out.full & (probe.now_ns % 2 == 0),
+        own_added_nt=out.own_added_nt,
+        own_taken_nt=out.own_taken_nt,
+        elapsed_ns=out.elapsed_ns,
+    )
+
+
+class TestLifecycleObligations:
+    def test_shipped_predicate_proves_clean(self):
+        assert prove.prove_root(ROOTS["lifecycle_probe"]) == []
+
+    def test_unsound_predicate_rejected_as_token_loss(self):
+        f = prove.prove_root(ROOTS["lifecycle_probe"], fn=always_full_probe)
+        got = codes(f)
+        assert "PTP002" in got, got
+        assert any("loses admitted tokens" in fi.message for fi in f)
+
+    def test_time_flapping_predicate_rejected(self):
+        f = prove.prove_root(ROOTS["lifecycle_probe"], fn=flapping_probe)
+        assert "PTP004" in codes(f)
+
+    def test_kernel_matches_host_twin(self):
+        """The numpy twin (host-resident lanes + soak digests) must agree
+        with the kernel verdict bit-for-bit over a dense random grid."""
+        from patrol_tpu.models.limiter import NANO, LimiterState
+        from patrol_tpu.ops import lifecycle as lc
+
+        rng = np.random.default_rng(12)
+        B, N, K = 16, 3, 64
+        pn = rng.integers(0, 4 * NANO, (B, N, 2)).astype(np.int64)
+        el = rng.integers(0, 3 * NANO, B).astype(np.int64)
+        rows = rng.integers(0, B, K).astype(np.int64)
+        now = rng.integers(0, 8 * NANO, K).astype(np.int64)
+        per = rng.choice([0, NANO, 3600 * NANO], K).astype(np.int64)
+        cap = rng.choice([0, NANO, 2 * NANO, 10 * NANO], K).astype(np.int64)
+        created = rng.integers(0, 2 * NANO, K).astype(np.int64)
+        st = LimiterState(pn=jnp.asarray(pn), elapsed=jnp.asarray(el))
+        view = lc.lifecycle_probe_jit(
+            st,
+            lc.LifecycleProbe(
+                rows=jnp.asarray(rows, jnp.int32),
+                now_ns=jnp.asarray(now),
+                per_ns=jnp.asarray(per),
+                cap_base_nt=jnp.asarray(cap),
+                created_ns=jnp.asarray(created),
+            ),
+            node_slot=1,
+        )
+        want = lc.host_lifecycle_full(
+            pn[rows, :, 0].sum(axis=1), pn[rows, :, 1].sum(axis=1),
+            el[rows], cap, created, now, per,
+        )
+        assert np.array_equal(np.asarray(view.full), want)
+        assert np.array_equal(np.asarray(view.own_added_nt), pn[rows, 1, 0])
+        assert np.array_equal(np.asarray(view.own_taken_nt), pn[rows, 1, 1])
+        assert np.array_equal(np.asarray(view.elapsed_ns), el[rows])

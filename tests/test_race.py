@@ -721,3 +721,74 @@ class TestMeshGuardCoverage:
         )
         assert codes(f) == ["PTR003"]
         assert "_mesh_metrics" in f[0].message
+
+
+class TestGcGuardCoverage:
+    """Bucket-lifecycle satellite: the GC sweep's shared state (window
+    anchor, reclaim/shed/compaction counters) is registered in GUARDS
+    under _evict_mu — stage 7 covers the new reclaim paths — and the
+    discipline demonstrably has teeth (a seeded unlocked mutation of a
+    reclaim set is rejected as PTR003)."""
+
+    GC_ATTRS = (
+        "_gc_win_start", "_gc_reclaimed", "_gc_shed", "_gc_sweeps",
+        "_gc_compactions",
+    )
+
+    def test_gc_state_registered_under_evict_mu(self):
+        g = race.GUARDS["patrol_tpu/runtime/engine.py"]["DeviceEngine"]
+        for attr in self.GC_ATTRS:
+            assert g[attr].lock == "_evict_mu", attr
+            assert g[attr].mode == "mutate", attr
+
+    def test_shipped_gc_accesses_are_nonvacuous(self):
+        # The shipped tree really mutates every declared GC attr (a
+        # rename would leave the guard checking nothing).
+        src = race.race_sources(REPO_ROOT)["patrol_tpu/runtime/engine.py"]
+        for attr in self.GC_ATTRS:
+            assert f"self.{attr}" in src, attr
+        assert race.race_static(race.race_sources(REPO_ROOT)) == []
+
+    def test_seeded_unlocked_reclaim_mutation_rejected(self):
+        """An engine-shaped GC path that bumps the reclaim counter
+        outside _evict_mu — the exact slip a future reclaim refactor
+        could make — must fire PTR003."""
+        src = (
+            "import threading\n"
+            "class DeviceEngine:\n"
+            "    def __init__(self):\n"
+            "        self._evict_mu = threading.Lock()\n"
+            "        self._gc_reclaimed = 0\n"
+            "    def gc_sweep(self, n):\n"
+            "        self._gc_reclaimed += n\n"
+        )
+        guards = {
+            _FIX: {
+                "DeviceEngine": {
+                    "_gc_reclaimed": race.Guard("_evict_mu", "mutate")
+                }
+            }
+        }
+        f = _static(src, guards=guards)
+        assert codes(f) == ["PTR003"]
+        assert "_gc_reclaimed" in f[0].message
+
+    def test_locked_reclaim_mutation_clean(self):
+        src = (
+            "import threading\n"
+            "class DeviceEngine:\n"
+            "    def __init__(self):\n"
+            "        self._evict_mu = threading.Lock()\n"
+            "        self._gc_reclaimed = 0\n"
+            "    def gc_sweep(self, n):\n"
+            "        with self._evict_mu:\n"
+            "            self._gc_reclaimed += n\n"
+        )
+        guards = {
+            _FIX: {
+                "DeviceEngine": {
+                    "_gc_reclaimed": race.Guard("_evict_mu", "mutate")
+                }
+            }
+        }
+        assert _static(src, guards=guards) == []
