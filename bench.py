@@ -1460,6 +1460,212 @@ def smoke_main() -> int:
     return 0
 
 
+def _chaos_audit_leg(on_loop) -> None:
+    """The ``--audit`` leg of ``bench.py --chaos-smoke``: a seeded,
+    deterministic 2-node measurement of the patrol-audit plane
+    (net/audit.py). Script: establish delta capability on a warm bucket;
+    PARTITION (drop everything) and let BOTH sides admit a full capacity
+    each — the paper's AP tradeoff made real; sample the lag gauges
+    mid-partition (unacked delta intervals aging); close the admitted
+    window in lockstep; heal connectivity but pin repair OFF (anti-entropy
+    neutered, delta retransmit deferred) so the read-only divergence
+    meter demonstrably reads >0 on a divergent-but-connected cluster;
+    then re-enable repair, converge, and assert the gauge reads ZERO at
+    the fixpoint while the evaluated window reports the measured
+    overshoot factor in (1, sides]. Asserts (rc != 0 via chaos_main's
+    handler): lag samples > 0, divergence checks > 0, divergence seen
+    > 0 mid-divergence and == 0 at fixpoint, overshoot ∈ (1, sides],
+    windows evaluated on both nodes. Emits the ``audit_*`` receipt
+    fields bench_gate/TREND_BASELINE pin."""
+    import socket as sk
+
+    from patrol_tpu.models.limiter import NANO, LimiterConfig
+    from patrol_tpu.net.replication import Replicator, SlotTable
+    from patrol_tpu.ops.rate import Rate
+    from patrol_tpu.runtime.engine import DeviceEngine
+    from patrol_tpu.runtime.repo import TPURepo
+    from patrol_tpu.utils import profiling
+
+    def free_port():
+        s = sk.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    ports = [free_port(), free_port()]
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    frozen = lambda: NANO  # noqa: E731 — zero refill ⇒ exact overshoot factor
+    lag0 = profiling.COUNTERS.get("audit_lag_samples")
+    checks0 = profiling.COUNTERS.get("audit_divergence_checks")
+    nodes = []
+    try:
+        for i in range(2):
+            slots = SlotTable(addrs[i], addrs, max_slots=4)
+            rep = on_loop(Replicator.create(addrs[i], addrs, slots, wire_mode="delta"))
+            rep.health.configure(
+                probe_interval_s=0.15, alive_ttl_s=0.4, backoff_cap_s=0.4
+            )
+            # Determinism: packed delta intervals never auto-retransmit
+            # (the divergent phase must stay divergent until AE is
+            # re-armed), and the admitted window closes manually.
+            rep.delta.retransmit_ticks = 1 << 30
+            eng = DeviceEngine(
+                LimiterConfig(buckets=64, nodes=4),
+                node_slot=slots.self_slot,
+                clock=frozen,
+            )
+            eng.audit_ledger.window_ns = 0  # lockstep epoch windows
+            repo = TPURepo(eng, send_incast=rep.send_incast_request)
+            rep.repo = repo
+            eng.on_broadcast = rep.broadcast_states
+            nodes.append((rep, eng, repo))
+
+        rate = Rate(freq=10, per_ns=3600 * NANO)
+        # Phase 0: delta capability handshake on a throwaway bucket.
+        nodes[0][2].take("warm", rate, 1)
+        for _ in range(60):
+            for rep, _, _ in nodes:
+                rep.delta.flush()
+            if all(rep.delta.capable_peers() for rep, _, _ in nodes):
+                break
+            time.sleep(0.05)
+        assert all(
+            rep.delta.capable_peers() for rep, _, _ in nodes
+        ), "delta capability handshake did not complete"
+
+        # Phase 1: 2-side partition; both sides admit a FULL capacity.
+        for rep, _, _ in nodes:
+            rep.drop_addr = lambda a: True
+        time.sleep(0.5)  # alive TTL lapses ⇒ PeerHealth sides estimate = 2
+        for _, _, repo in nodes:
+            for _i in range(10):
+                _, ok = repo.take("audit", rate, 1)
+                assert ok, "partitioned side must admit up to capacity"
+            _, ok = repo.take("audit", rate, 1)
+            assert not ok, "capacity must bound each side"
+        for rep, _, _ in nodes:
+            rep.delta.flush()  # pack (dropped) intervals: the lag source
+        time.sleep(0.05)
+        for rep, _, _ in nodes:
+            rep.audit.flush()  # partition tick: sides + lag samples
+        lag_ms = max(
+            rep.audit.stats()["audit_peer_lag_ms"] for rep, _, _ in nodes
+        )
+        OUT["audit_peer_lag_ms"] = lag_ms
+        OUT["audit_peer_lag_samples"] = (
+            profiling.COUNTERS.get("audit_lag_samples") - lag0
+        )
+        for _, eng, _ in nodes:
+            eng.audit_ledger.roll(eng.clock(), force=True)
+
+        # Phase 2: heal connectivity, repair pinned OFF — the divergence
+        # meter must read the divergent-but-connected cluster.
+        for rep, _, _ in nodes:
+            rep.antientropy.max_buckets = 0  # digest jobs send nothing
+            rep.drop_addr = None
+        divergent_seen = 0
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            for rep, _, _ in nodes:
+                rep.audit.flush()
+            time.sleep(0.15)
+            divergent_seen = max(
+                rep.audit.stats()["audit_divergent_buckets"]
+                for rep, _, _ in nodes
+            )
+            if divergent_seen:
+                break
+        OUT["audit_divergent_buckets_divergent_phase"] = divergent_seen
+        assert divergent_seen > 0, (
+            "divergence meter read 0 on a divergent cluster"
+        )
+
+        # Phase 3: re-arm repair, converge, audit the fixpoint.
+        for rep, _, _ in nodes:
+            rep.antientropy.max_buckets = 2048
+            for peer in rep.peers:
+                rep.antientropy.trigger(peer, force=True)
+        deadline = time.time() + 20
+        views = []
+        while time.time() < deadline:
+            views = []
+            for _, eng, _ in nodes:
+                eng.flush()
+                row = eng.directory.lookup("audit")
+                if row is None:
+                    views.append(None)
+                    continue
+                pn, el = eng.row_view(row)
+                views.append(
+                    (int(pn[:, 0].sum()), int(pn[:, 1].sum()), int(el))
+                )
+            # Sum equality alone is a weak proxy (each side's own
+            # 10-token lane sums identically); the converged fixpoint
+            # carries BOTH lanes — taken Σ = 20 tokens.
+            if (
+                None not in views
+                and len(set(views)) == 1
+                and views[0][1] == 20 * NANO
+            ):
+                break
+            time.sleep(0.1)
+        assert (
+            views
+            and None not in views
+            and len(set(views)) == 1
+            and views[0][1] == 20 * NANO
+        ), f"audit leg did not converge: {views}"
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            for rep, _, _ in nodes:
+                rep.audit.flush()
+            time.sleep(0.15)
+            stats = [rep.audit.stats() for rep, _, _ in nodes]
+            if all(
+                s["audit_divergent_buckets"] == 0
+                and s["audit_windows_evaluated"] > 0
+                for s in stats
+            ):
+                break
+        s0 = nodes[0][0].audit.stats()
+        for key in (
+            "audit_divergent_buckets",
+            "audit_divergence_age_ms",
+            "audit_overshoot_factor",
+            "audit_overshoot_window",
+            "audit_sides_estimate",
+            "audit_windows_evaluated",
+            "audit_staleness_ns",
+        ):
+            OUT[key] = s0[key]
+        OUT["audit_divergence_checks"] = (
+            profiling.COUNTERS.get("audit_divergence_checks") - checks0
+        )
+        # The acceptance gates (rc != 0 through chaos_main's handler).
+        assert OUT["audit_peer_lag_samples"] > 0, "lag gauges unpopulated"
+        assert OUT["audit_divergence_checks"] > 0, "no divergence compares ran"
+        for s in (s0, nodes[1][0].audit.stats()):
+            assert s["audit_divergent_buckets"] == 0, (
+                f"divergence nonzero at fixpoint: {s}"
+            )
+            assert s["audit_windows_evaluated"] > 0, "no window evaluated"
+            sides = s["audit_sides_estimate"]
+            factor = s["audit_overshoot_factor"]
+            assert 1.0 < factor <= sides, (
+                f"measured overshoot {factor} outside (1, {sides}]"
+            )
+    finally:
+        for rep, eng, _ in nodes:
+            on_loop_close = rep.close
+            try:
+                rep.loop.call_soon_threadsafe(on_loop_close)
+            except Exception:
+                pass
+            eng.stop()
+        time.sleep(0.2)
+
+
 def chaos_main() -> int:
     """``bench.py --chaos-smoke``: a seconds-class, CPU-safe, SEEDED chaos
     gate for the replication resilience layer. Wires a real 2-node
@@ -1595,12 +1801,19 @@ def chaos_main() -> int:
                 loop.call_soon_threadsafe(rep.close)
                 eng.stop()
             time.sleep(0.2)  # let the cancelled health tasks unwind
+
+        # patrol-audit leg (``--audit`` names it explicitly; it always
+        # runs — the consistency plane must gate every chaos smoke).
+        OUT["audit_leg"] = True
+        try:
+            _chaos_audit_leg(on_loop)
+        finally:
             loop.call_soon_threadsafe(loop.stop)
             thread.join(timeout=5)
 
         OUT["chaos_smoke_seconds"] = round(time.time() - t0, 2)
-        OUT["stages_completed"] = 1
-        OUT["stages"] = ["chaos-smoke"]
+        OUT["stages_completed"] = 2
+        OUT["stages"] = ["chaos-smoke", "audit"]
     except BaseException as e:
         _log(f"chaos smoke failed: {type(e).__name__}: {e}")
         OUT["error"] = f"{type(e).__name__}: {e}"
@@ -2680,6 +2893,12 @@ def trend_main() -> int:
 
 
 if __name__ == "__main__":
+    # patrol-audit stays MANUALLY paced across every bench leg (the
+    # fleet-gossip precedent): a background audit flusher would inject
+    # control datagrams into the seeded packet accounting of the wire
+    # and chaos smokes. The --chaos-smoke --audit leg drives
+    # plane.flush() explicitly.
+    os.environ.setdefault("PATROL_AUDIT_MS", "0")
     if "--mesh" in sys.argv:  # before --smoke: "--mesh --smoke" is a mode
         sys.exit(mesh_main())
     if "--soak" in sys.argv:  # before --smoke: "--soak --smoke" is a mode

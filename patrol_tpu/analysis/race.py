@@ -639,6 +639,7 @@ RACE_FILES: Tuple[str, ...] = (
     "patrol_tpu/net/native_replication.py",
     "patrol_tpu/net/delta.py",
     "patrol_tpu/net/antientropy.py",
+    "patrol_tpu/net/audit.py",
 )
 
 # Additional files scanned for the lock graph (native-mutex call sites
@@ -690,6 +691,16 @@ GUARDS: Dict[str, Dict[str, Dict[str, Guard]]] = {
             "_gc_sweeps": Guard("_evict_mu", "mutate"),
             "_gc_compactions": Guard("_evict_mu", "mutate"),
         },
+        # patrol-audit admitted-token window ledger: every field mutates
+        # under its own leaf lock (taken strictly after any engine lock
+        # released — note() runs on serve/completion threads, roll() on
+        # the audit plane's flusher).
+        "AuditLedger": {
+            "_cur": Guard("_mu", "rw"),
+            "_closed": Guard("_mu", "rw"),
+            "_window": Guard("_mu", "rw"),
+            "_start_ns": Guard("_mu", "rw"),
+        },
     },
     "patrol_tpu/runtime/mesh_engine.py": {
         "MeshEngine": {
@@ -729,6 +740,21 @@ GUARDS: Dict[str, Dict[str, Dict[str, Guard]]] = {
             "_stopped": Guard("_mu", "mutate"),
         },
     },
+    # patrol-audit plane: the window store + divergence gauges mutate on
+    # the flusher, rx, and compare-worker threads — all under the plane's
+    # one leaf lock (never held across a send or an engine snapshot).
+    "patrol_tpu/net/audit.py": {
+        "AuditPlane": {
+            "_win": Guard("_mu", "rw"),
+            "_tick": Guard("_mu", "rw"),
+            "_local_window": Guard("_mu", "rw"),
+            "_divergent": Guard("_mu", "rw"),
+            "_divergence_since": Guard("_mu", "rw"),
+            "_jobs": Guard("_mu", "rw"),
+            "_worker": Guard("_mu", "mutate"),
+            "_stopped": Guard("_mu", "mutate"),
+        },
+    },
 }
 
 # Methods that run with a lock already held by contract (the documented
@@ -738,6 +764,14 @@ HOLDERS: Dict[str, Dict[str, Tuple[str, ...]]] = {
     "patrol_tpu/runtime/engine.py": {
         # "Caller holds ``_host_mu``." (engine.py:_promote_locked)
         "DeviceEngine._promote_locked": ("_host_mu",),
+        # AuditLedger's *_locked helpers run under its leaf lock.
+        "AuditLedger._close_locked": ("_mu",),
+        "AuditLedger._clock_window": ("_mu",),
+    },
+    "patrol_tpu/net/audit.py": {
+        "AuditPlane._join_window_locked": ("_mu",),
+        "AuditPlane._absorb_ledger_locked": ("_mu",),
+        "AuditPlane._evaluate_locked": ("_mu",),
     },
     "patrol_tpu/net/delta.py": {
         "DeltaPlane._flush_peer_locked": ("_mu",),
@@ -752,6 +786,7 @@ HOLDERS: Dict[str, Dict[str, Tuple[str, ...]]] = {
 # the condvar == holding the underlying lock (threading.Condition(lock)).
 LOCK_ALIASES: Dict[str, Dict[str, Dict[str, str]]] = {
     "patrol_tpu/net/antientropy.py": {"AntiEntropy": {"_cond": "_mu"}},
+    "patrol_tpu/net/audit.py": {"AuditPlane": {"_cond": "_mu"}},
 }
 
 # The engine's cross-cutting locks keep their bare names in the lock

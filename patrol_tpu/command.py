@@ -208,6 +208,8 @@ class Command:
         # /cluster/* (patrol-fleet): served from the replicator's gossip
         # store — any node answers for the fleet.
         api.fleet = getattr(replicator, "fleet", None)
+        # /debug/audit (patrol-audit): the consistency plane's gauges.
+        api.audit = getattr(replicator, "audit", None)
         host, _, port = self.api_addr.rpartition(":")
         native_front = None
         server = None

@@ -142,6 +142,9 @@ class API:
         # patrol-fleet: the replicator's metrics-gossip plane (set by the
         # supervisor); None ⇒ /cluster/* answers 503 (no fleet view).
         self.fleet = None
+        # patrol-audit: the replicator's consistency plane (set by the
+        # supervisor); None ⇒ /debug/audit answers 503.
+        self.audit = None
         self.started_at = time.time()  # patrol-lint: clock-seam (uptime)
         self._batcher = (
             _TakeBatcher(repo)
@@ -267,6 +270,19 @@ class API:
             ).encode()
             ctype = "text/plain; version=0.0.4" if path == "/metrics" else "application/json"
             return 200, body, ctype
+        if path == "/debug/audit":
+            # patrol-audit: the consistency plane's gauges plus the last
+            # evaluated window's per-bucket overshoot detail.
+            if self.audit is None:
+                return 503, b"no audit plane\n", "text/plain"
+            body = json.dumps(
+                {
+                    **self.audit.stats(),
+                    "last_evaluation": self.audit.last_evaluation(),
+                },
+                indent=2,
+            ).encode()
+            return 200, body, "application/json"
         if path == "/debug/pprof/" or path == "/debug/pprof":
             index = (
                 "patrol_tpu debug index\n\n"
@@ -280,6 +296,7 @@ class API:
                 "/debug/trace/ring               flight-recorder rings, Chrome-trace JSON (&snapshot=N for anomaly snapshots)\n"
                 "/debug/trace/spans              cross-node take spans JSON (&trace_id=N to filter)\n"
                 "/debug/vars                     engine stats JSON (incl. histogram summaries)\n"
+                "/debug/audit                    patrol-audit consistency gauges + last overshoot evaluation JSON\n"
                 "/metrics                        prometheus text exposition (gauges + latency histograms)\n"
                 "/cluster/metrics                fleet-merged exposition, node-labeled lanes (patrol-fleet gossip)\n"
                 "/cluster/vars                   fleet-merged summaries JSON (patrol-fleet gossip)\n"

@@ -92,18 +92,25 @@ class _PeerDelta:
 
     __slots__ = (
         "capable", "max_rx", "next_seq", "unacked", "pending_acks",
-        "last_advert_tick",
+        "last_advert_tick", "last_rx_data_ns",
     )
 
     def __init__(self) -> None:
         self.capable = False
         self.max_rx = MIN_DELTA_MTU
         self.next_seq = 1
-        # seq -> (flush tick at emission, tuple[wire.DeltaEntry])
-        self.unacked: "OrderedDict[int, Tuple[int, tuple]]" = OrderedDict()
+        # seq -> (flush tick at emission, emission perf_counter_ns,
+        #         tuple[wire.DeltaEntry]) — the wall stamp is the
+        # patrol-audit replication-lag source (net/audit.py): the oldest
+        # unacked interval's age IS this peer's outstanding-repair lag.
+        self.unacked: "OrderedDict[int, Tuple[int, int, tuple]]" = OrderedDict()
         # interval seqs received from this peer, to ack back (newest kept)
         self.pending_acks: deque = deque(maxlen=64)
         self.last_advert_tick = -(1 << 30)
+        # perf_counter_ns of the last DATA-bearing delta interval received
+        # from this peer (0 = never) — the audit plane's per-peer
+        # time-since-last-absorb gauge.
+        self.last_rx_data_ns = 0
 
 
 class DeltaPlane:
@@ -357,11 +364,12 @@ class DeltaPlane:
             ae.inflight_buckets(addr) if ae is not None and st.unacked else ()
         )
         retransmitted = 0
+        now_ns = time.perf_counter_ns()
         for seq in [
-            s for s, (t, _) in st.unacked.items()
+            s for s, (t, _, _) in st.unacked.items()
             if tick - t >= self.retransmit_ticks
         ]:
-            _, ents = st.unacked.pop(seq)
+            _, _, ents = st.unacked.pop(seq)
             live = False
             deferred = []
             for e in ents:
@@ -379,7 +387,7 @@ class DeltaPlane:
                 send_map.setdefault(key, e)
                 live = True
             if deferred:
-                st.unacked[st.next_seq] = (tick, tuple(deferred))
+                st.unacked[st.next_seq] = (tick, now_ns, tuple(deferred))
                 st.next_seq += 1
             if live:
                 retransmitted += 1
@@ -404,7 +412,7 @@ class DeltaPlane:
                 break
             acks = acks[wire.DELTA_MAX_ACKS:]
             st.next_seq += 1
-            st.unacked[seq] = (tick, tuple(entries[:n]))
+            st.unacked[seq] = (tick, now_ns, tuple(entries[:n]))
             entries = entries[n:]
             sends.append((data, addr))
             packets += 1
@@ -462,6 +470,8 @@ class DeltaPlane:
                     tr.record(trace_mod.EV_DELTA_ACK, 0, len(pkt.acks))
             if pkt.seq:
                 st.pending_acks.append(pkt.seq)
+            if pkt.entries:
+                st.last_rx_data_ns = time.perf_counter_ns()
             self.rx_packets += 1
             self.rx_deltas += len(pkt.entries)
         # Acking needs a pacing tick even on nodes that ship no deltas.
@@ -492,6 +502,41 @@ class DeltaPlane:
         return True
 
     # -- observability -------------------------------------------------------
+
+    def lag_stats(self, now_ns: Optional[int] = None) -> Dict[Addr, dict]:
+        """Per-peer replication-lag view for patrol-audit (net/audit.py),
+        derived entirely from state the plane already keeps — the interval
+        log and ack bookkeeping carry lag for free (arXiv:1410.2803):
+
+        * ``unacked`` — outstanding interval count (the seq gap between
+          what we shipped and what the peer acknowledged);
+        * ``oldest_unacked_age_ns`` — age of the oldest un-acked interval
+          (0 when fully acked): how long the peer has been behind;
+        * ``last_rx_data_age_ns`` — time since the peer last shipped us a
+          data-bearing interval (None when it never has).
+
+        Covers every peer that has exchanged delta traffic; read-only."""
+        now = time.perf_counter_ns() if now_ns is None else now_ns
+        out: Dict[Addr, dict] = {}
+        with self._mu:
+            for addr, st in self._peers.items():
+                if not st.capable and not st.unacked and not st.last_rx_data_ns:
+                    continue
+                oldest = min(
+                    (t_ns for _, t_ns, _ in st.unacked.values()), default=None
+                )
+                out[addr] = {
+                    "unacked": len(st.unacked),
+                    "oldest_unacked_age_ns": (
+                        max(0, now - oldest) if oldest is not None else 0
+                    ),
+                    "last_rx_data_age_ns": (
+                        max(0, now - st.last_rx_data_ns)
+                        if st.last_rx_data_ns
+                        else None
+                    ),
+                }
+        return out
 
     def stats(self) -> dict:
         with self._mu:

@@ -327,6 +327,20 @@ class FleetPlane:
             self.node_name,
         )
         slo_mod.SENTINEL.check(self.registry)
+        # GC-cadence backstop (ROADMAP 4e): the host-serve seams and the
+        # native pump kick the feeder's lifecycle sweep at window
+        # rollover, but an rx-absorb-only or fully idle node never runs
+        # either seam — this standing timer is the one paced tick such a
+        # node still has, so hang the sweep check off it. Two int reads
+        # when the window hasn't rolled; the sweep itself runs on the
+        # feeder.
+        repo = getattr(self.rep, "repo", None)
+        eng = getattr(repo, "engine", None) if repo is not None else None
+        if eng is not None and hasattr(eng, "_kick_gc_if_due"):
+            try:
+                eng._kick_gc_if_due(eng.clock())
+            except Exception:  # pragma: no cover - gossip must not die
+                pass
         peers = list(getattr(self.rep, "peers", ()))
         if not peers:
             return 0

@@ -115,6 +115,7 @@ class NativeReplicator:
         # vectorized batch path resumes the moment it is detached).
         self.faultnet = None
         from patrol_tpu.net.antientropy import AntiEntropy
+        from patrol_tpu.net.audit import AuditPlane
         from patrol_tpu.net.delta import DeltaPlane
         from patrol_tpu.net.fleet import FleetPlane
 
@@ -130,8 +131,12 @@ class NativeReplicator:
             self.delta.start()
         # patrol-fleet metrics-lattice gossip (net/fleet.py).
         self.fleet = FleetPlane(self, tx_mtu=native.RX_RING_ROW)
+        # patrol-audit consistency plane (net/audit.py): the rx ring rows
+        # bound the frame size exactly like the delta/fleet planes.
+        self.audit = AuditPlane(self, tx_mtu=native.RX_RING_ROW)
         if peers:
             self.fleet.start()
+            self.audit.start()
         self._probe_bytes = wire.encode(
             wire.WireState(name=PROBE_NAME, added=0.0, taken=0.0, elapsed_ns=0)
         )
@@ -311,6 +316,11 @@ class NativeReplicator:
                             self.fleet.on_packet(
                                 bytes(packets[i][: sizes[i]]), addr_i
                             )
+                        elif name == wire.AUDIT_CHANNEL_NAME:
+                            # patrol-audit digests + admitted windows.
+                            self.audit.on_packet(
+                                bytes(packets[i][: sizes[i]]), addr_i
+                            )
                         else:
                             # Probe pings / anti-entropy: never a bucket.
                             self._handle_control(name, addr_i)
@@ -357,6 +367,9 @@ class NativeReplicator:
                 return
             if state.name == wire.METRICS_CHANNEL_NAME:
                 self.fleet.on_packet(data, addr)
+                return
+            if state.name == wire.AUDIT_CHANNEL_NAME:
+                self.audit.on_packet(data, addr)
                 return
             self._handle_control(state.name, addr)
             return
@@ -640,6 +653,8 @@ class NativeReplicator:
             self.delta.close()
         if self.fleet is not None:
             self.fleet.close()
+        if self.audit is not None:
+            self.audit.close()
         if self.antientropy is not None:
             self.antientropy.close()
         self._rx_thread.join(timeout=2)
@@ -662,6 +677,8 @@ class NativeReplicator:
             out.update(self.delta.stats())
         if self.fleet is not None:
             out.update(self.fleet.stats())
+        if self.audit is not None:
+            out.update(self.audit.stats())
         if self.antientropy is not None:
             out.update(self.antientropy.stats())
         if self.faultnet is not None:

@@ -467,6 +467,7 @@ class Replicator(asyncio.DatagramProtocol):
         # received datagram when set. Settable at runtime.
         self.faultnet = None
         from patrol_tpu.net.antientropy import AntiEntropy
+        from patrol_tpu.net.audit import AuditPlane
         from patrol_tpu.net.delta import DeltaPlane
         from patrol_tpu.net.fleet import FleetPlane
 
@@ -480,8 +481,13 @@ class Replicator(asyncio.DatagramProtocol):
         # join-decompositions of the histogram/counter lattices on the
         # control channel. Gossip only runs when there is a fleet.
         self.fleet = FleetPlane(self)
+        # patrol-audit consistency plane (net/audit.py): replication lag,
+        # read-only divergence digests, AP-overshoot auditor. Like the
+        # fleet gossip, the paced tick only runs when there are peers.
+        self.audit = AuditPlane(self)
         if self.peers:
             self.fleet.start()
+            self.audit.start()
         self._health_task: Optional[asyncio.Task] = None
         self._health_tick_s = 0.1
         self._probe_bytes = wire.encode(
@@ -627,6 +633,10 @@ class Replicator(asyncio.DatagramProtocol):
             if state.name == wire.METRICS_CHANNEL_NAME and self.fleet is not None:
                 # patrol-fleet metrics gossip: same envelope trick.
                 self.fleet.on_packet(data, addr)
+                return
+            if state.name == wire.AUDIT_CHANNEL_NAME and self.audit is not None:
+                # patrol-audit digests + admitted-window lanes.
+                self.audit.on_packet(data, addr)
                 return
             self._handle_control(state.name, addr)
             return
@@ -820,6 +830,8 @@ class Replicator(asyncio.DatagramProtocol):
             self.delta.close()
         if self.fleet is not None:
             self.fleet.close()
+        if self.audit is not None:
+            self.audit.close()
         if self.antientropy is not None:
             self.antientropy.close()
         if self.transport is not None:
@@ -841,6 +853,8 @@ class Replicator(asyncio.DatagramProtocol):
             out.update(self.delta.stats())
         if self.fleet is not None:
             out.update(self.fleet.stats())
+        if self.audit is not None:
+            out.update(self.audit.stats())
         if self.antientropy is not None:
             out.update(self.antientropy.stats())
         if self.faultnet is not None:

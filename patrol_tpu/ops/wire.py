@@ -959,3 +959,235 @@ def decode_metrics_packet(data: bytes) -> Optional[MetricsPacket]:
     if off != end:
         return None  # trailing garbage ⇒ reject whole
     return MetricsPacket(sender_slot, tuple(names), tuple(counters), tuple(hists))
+
+
+# ---------------------------------------------------------------------------
+# patrol-audit: consistency-audit datagrams (``\x00pt!adt``).
+#
+# The third observability plane (net/audit.py) measures how consistent the
+# cluster actually IS: read-only divergence digests (no resync — that is
+# anti-entropy's job) and the windowed admitted-token G-counter lanes the
+# AP-overshoot auditor joins cluster-wide. Same envelope invisibility
+# argument as ``dv2``/``mtr``: the first 25+L bytes form a v1 zero-state
+# packet for a reserved name no real bucket can carry, so reference peers
+# read an incast request for an unknown bucket and stay silent, and
+# pre-audit patrol builds dispatch it to the control channel and ignore
+# the unknown name.
+#
+# Payload (after the 32-byte envelope, all big-endian):
+#
+#   u8  version (= 1)
+#   u16 sender_slot
+#   u16 Nd | Nd × (u64 name_hash | u64 state_digest)     divergence digests
+#   u8  Nw | Nw × window:
+#         u64 window_id | u16 sides | u8 closed | u64 duration_ns
+#         u16 Na | Na × (u8 len | name | u16 slot |
+#                        u64 admitted_nt | u64 limit_nt)
+#   u8  checksum (sum of payload bytes mod 256)
+#
+# Every admitted-lane entry is an ABSOLUTE monotone own-lane value for
+# (window, bucket, lane) — its own join-decomposition, so dup/reorder/
+# stale delivery max-join to a no-op, and a window's lanes may split
+# across any number of datagrams (the window header repeats). Validation
+# is all-or-nothing, like the dv2/mtr framings.
+
+AUDIT_CHANNEL_NAME = "\x00pt!adt"
+_AUDIT_NAME_BYTES = AUDIT_CHANNEL_NAME.encode()
+_AUDIT_BASE = FIXED_SIZE + len(_AUDIT_NAME_BYTES)  # payload offset (32)
+AUDIT_VERSION = 1
+_ADT_HEAD = struct.Struct(">BH")  # version | sender_slot
+_ADT_U16 = struct.Struct(">H")
+_ADT_DIGEST = struct.Struct(">QQ")  # name_hash | state_digest
+_ADT_WIN_HEAD = struct.Struct(">QHBQ")  # window_id | sides | closed | dur
+_ADT_LANE_TAIL = struct.Struct(">HQQ")  # slot | admitted_nt | limit_nt
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditLane:
+    """One (bucket, node-lane) of an audit window's admitted-token
+    G-counter: the ABSOLUTE cumulative nanotokens that lane admitted
+    inside the window, plus the sender's view of the window limit."""
+
+    name: str
+    slot: int
+    admitted_nt: int
+    limit_nt: int
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditWindow:
+    window_id: int
+    sides: int  # sender's partition-sides estimate for the window (max-joined)
+    closed: bool  # the sender's ledger has closed this window locally
+    duration_ns: int  # observed window span (refill term of the limit)
+    lanes: Tuple[AuditLane, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditPacket:
+    sender_slot: int
+    digests: Tuple[Tuple[int, int], ...]  # (name_hash, state_digest)
+    windows: Tuple[AuditWindow, ...]
+
+
+def _adt_envelope() -> bytearray:
+    env = bytearray(_AUDIT_BASE)
+    env[24] = len(_AUDIT_NAME_BYTES)
+    env[FIXED_SIZE:] = _AUDIT_NAME_BYTES
+    return env
+
+
+def audit_lane_size(name: str) -> int:
+    return 1 + len(name.encode("utf-8", "surrogateescape")) + _ADT_LANE_TAIL.size
+
+
+def encode_audit_packets(
+    sender_slot: int,
+    digests: Sequence[Tuple[int, int]],
+    windows: Sequence[AuditWindow],
+    max_size: int = DELTA_PACKET_SIZE,
+) -> List[bytes]:
+    """Pack the audit exchange into as many ``\\x00pt!adt`` datagrams as
+    fit under ``max_size``. Digest entries and window lanes both split
+    freely across packets (each is an independent join-decomposition; the
+    window header repeats per packet). A lane whose name cannot fit even
+    an otherwise-empty packet is dropped whole, never truncated."""
+    out: List[bytes] = []
+    head_cost = _AUDIT_BASE + _ADT_HEAD.size + _ADT_U16.size + 1 + 1  # +checksum
+    budget0 = max_size - head_cost
+    if budget0 <= 0:
+        raise ValueError(f"audit packet head exceeds max_size {max_size}")
+    d_todo = list(digests)
+    w_todo: List[Tuple[AuditWindow, List[AuditLane]]] = [
+        (w, list(w.lanes)) for w in windows
+    ]
+    # Header-only windows (no lanes) still ship once: they carry the
+    # sides estimate and the closed flag.
+    while d_todo or w_todo:
+        budget = budget0
+        d_now: List[Tuple[int, int]] = []
+        while d_todo and _ADT_DIGEST.size <= budget and len(d_now) < 0xFFFF:
+            d_now.append(d_todo.pop(0))
+            budget -= _ADT_DIGEST.size
+        w_now: List[Tuple[AuditWindow, List[AuditLane]]] = []
+        while w_todo and len(w_now) < 0xFF:
+            win, rem = w_todo[0]
+            head = _ADT_WIN_HEAD.size + _ADT_U16.size
+            if head > budget:
+                break
+            lanes_fit: List[AuditLane] = []
+            b = budget - head
+            while rem:
+                sz = audit_lane_size(rem[0].name)
+                if sz > budget0 - head:
+                    rem.pop(0)  # undeliverable at this MTU: drop whole
+                    continue
+                if sz > b or len(lanes_fit) >= 0xFFFF:
+                    break
+                lanes_fit.append(rem.pop(0))
+                b -= sz
+            if rem and not lanes_fit:
+                break  # not even one lane fits this packet: next packet
+            w_now.append(
+                (dataclasses.replace(win, lanes=tuple(lanes_fit)), rem)
+            )
+            budget = b
+            if rem:
+                w_todo[0] = (win, rem)
+                break  # packet is full: ship it
+            w_todo.pop(0)
+        if not d_now and not w_now:
+            break  # nothing fit (all undeliverable): stop, never spin
+        body = bytearray(_ADT_HEAD.pack(AUDIT_VERSION, sender_slot & 0xFFFF))
+        body += _ADT_U16.pack(len(d_now))
+        for h, d in d_now:
+            body += _ADT_DIGEST.pack(
+                h & 0xFFFFFFFFFFFFFFFF, d & 0xFFFFFFFFFFFFFFFF
+            )
+        body.append(len(w_now))
+        for win, _rem in w_now:
+            body += _ADT_WIN_HEAD.pack(
+                win.window_id & 0xFFFFFFFFFFFFFFFF,
+                min(max(win.sides, 0), 0xFFFF),
+                1 if win.closed else 0,
+                min(max(win.duration_ns, 0), _INT64_MAX),
+            )
+            body += _ADT_U16.pack(len(win.lanes))
+            for lane in win.lanes:
+                raw = lane.name.encode("utf-8", "surrogateescape")
+                body.append(len(raw))
+                body += raw
+                body += _ADT_LANE_TAIL.pack(
+                    lane.slot & 0xFFFF,
+                    min(max(lane.admitted_nt, 0), _INT64_MAX),
+                    min(max(lane.limit_nt, 0), _INT64_MAX),
+                )
+        body.append(sum(body) & 0xFF)
+        out.append(bytes(_adt_envelope()) + bytes(body))
+    return out
+
+
+def decode_audit_packet(data: bytes) -> Optional[AuditPacket]:
+    """Strict all-or-nothing decode of an audit datagram; ``None`` for
+    anything malformed — a corrupted audit frame must never be partially
+    joined (a torn admitted lane would inflate the measured overshoot)."""
+    end = len(data) - 1
+    if end < _AUDIT_BASE + _ADT_HEAD.size + _ADT_U16.size + 1:
+        return None
+    if (
+        data[:24] != b"\x00" * 24
+        or data[24] != len(_AUDIT_NAME_BYTES)
+        or data[FIXED_SIZE:_AUDIT_BASE] != _AUDIT_NAME_BYTES
+    ):
+        return None
+    if data[end] != sum(data[_AUDIT_BASE:end]) & 0xFF:
+        return None
+    version, sender_slot = _ADT_HEAD.unpack_from(data, _AUDIT_BASE)
+    if version != AUDIT_VERSION:
+        return None
+    off = _AUDIT_BASE + _ADT_HEAD.size
+    try:
+        (nd,) = _ADT_U16.unpack_from(data, off)
+        off += _ADT_U16.size
+        if off + nd * _ADT_DIGEST.size > end:
+            return None
+        digests = tuple(
+            _ADT_DIGEST.unpack_from(data, off + i * _ADT_DIGEST.size)
+            for i in range(nd)
+        )
+        off += nd * _ADT_DIGEST.size
+        nw = data[off]
+        off += 1
+        windows = []
+        for _ in range(nw):
+            if off + _ADT_WIN_HEAD.size + _ADT_U16.size > end:
+                return None
+            wid, sides, closed, dur = _ADT_WIN_HEAD.unpack_from(data, off)
+            off += _ADT_WIN_HEAD.size
+            if closed > 1 or dur > _INT64_MAX:
+                return None
+            (na,) = _ADT_U16.unpack_from(data, off)
+            off += _ADT_U16.size
+            lanes = []
+            for _ in range(na):
+                if off >= end:
+                    return None
+                ln = data[off]
+                off += 1
+                if off + ln + _ADT_LANE_TAIL.size > end:
+                    return None
+                nm = data[off : off + ln].decode("utf-8", "surrogateescape")
+                off += ln
+                slot, adm, lim = _ADT_LANE_TAIL.unpack_from(data, off)
+                off += _ADT_LANE_TAIL.size
+                if adm > _INT64_MAX or lim > _INT64_MAX:
+                    return None
+                lanes.append(AuditLane(nm, slot, adm, lim))
+            windows.append(
+                AuditWindow(wid, sides, bool(closed), dur, tuple(lanes))
+            )
+    except (IndexError, struct.error):
+        return None
+    if off != end:
+        return None  # trailing garbage ⇒ reject whole
+    return AuditPacket(sender_slot, digests, tuple(windows))

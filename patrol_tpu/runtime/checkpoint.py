@@ -49,6 +49,16 @@ def save(directory: str, engine) -> str:
         "rows": rows,
         "created_ns": {str(r): int(d.created_ns[r]) for r in rows.values()},
         "cap_base_nt": {str(r): int(d.cap_base_nt[r]) for r in rows.values()},
+        # GC tombstones (ROADMAP 4c): a reclaimed bucket's own-lane
+        # residue must survive a restart, or the stale-echo window the
+        # tombstone closes re-opens — a peer echoing pre-reclaim lane
+        # values into the restarted node would absorb (erase) the
+        # reclaimed spend. Written as an extra key, so older builds
+        # restoring this checkpoint simply ignore it (format-compatible
+        # both ways).
+        "tombstones": {
+            name: list(tomb) for name, tomb in d.export_tombstones().items()
+        },
     }
 
     # Atomic write: temp files + rename.
@@ -127,6 +137,11 @@ def restore(directory: str, engine) -> int:
                 d._bind_locked(name, row, int(meta["created_ns"][str(row)]))
                 d.cap_base_nt[row] = int(meta["cap_base_nt"][str(row)])
                 d._next_fresh = max(d._next_fresh, row + 1)
+        # Tombstones restore AFTER the binds: restore_tombstones skips
+        # names the checkpoint re-bound (their lanes carry the spend).
+        # Absent on pre-tombstone checkpoints — restoring those keeps the
+        # old (stale-echo-exposed) behavior rather than failing.
+        d.restore_tombstones(meta.get("tombstones", {}))
         return len(meta["rows"])
     finally:
         engine._demotion_paused = False

@@ -113,6 +113,13 @@ class BucketDirectory:
         # has only ever been written by replication keeps 0 and is
         # reclaimable only once its standing balance covers capacity.
         self.rate_per_ns = np.zeros(capacity, dtype=np.int64)
+        # patrol-audit per-bucket staleness stamps: engine-clock ns of the
+        # last REMOTE-lane absorb into the row (any rx ingest path) and of
+        # the last LOCAL state emission for it (broadcast). Best-effort
+        # racy int64 writes, read only by the audit plane's staleness
+        # sampler — a torn stamp skews one sample, never state.
+        self.last_remote_ns = np.zeros(capacity, dtype=np.int64)
+        self.last_emit_ns = np.zeros(capacity, dtype=np.int64)
         # name → (own_added_nt, own_taken_nt, elapsed_ns, created_ns)
         # tombstones of reclaimed buckets (see TOMBSTONE_CAP), insertion-
         # ordered for LRU bounding. Guarded by _mu.
@@ -199,6 +206,8 @@ class BucketDirectory:
         self.created_ns[row] = now_ns
         self.cap_base_nt[row] = 0
         self.rate_per_ns[row] = 0
+        self.last_remote_ns[row] = 0
+        self.last_emit_ns[row] = 0
         raw = name.encode("utf-8", "surrogateescape")
         self.name_len[row] = len(raw)
         if len(raw) <= NAME_BYTES_MAX:
@@ -769,11 +778,59 @@ class BucketDirectory:
                 self.created_ns[row] = tomb[3]
         return tomb
 
+    def staleness_sample(self, limit: int = 64) -> np.ndarray:
+        """patrol-audit per-bucket staleness: for up to ``limit`` bound
+        rows carrying BOTH stamps, how far the last local emission ran
+        ahead of the last remote absorb (``last_emit_ns − last_remote_ns``,
+        clamped ≥ 0) — a bucket we keep broadcasting for without hearing
+        remote state back is one whose cluster view is going stale."""
+        with self._mu:
+            sel = (
+                self._bound
+                & (self.last_emit_ns > 0)
+                & (self.last_remote_ns > 0)
+            )
+            idx = np.flatnonzero(sel)[: max(0, int(limit))]
+            if not idx.size:
+                return np.zeros(0, dtype=np.int64)
+            return np.maximum(
+                self.last_emit_ns[idx] - self.last_remote_ns[idx], 0
+            )
+
     def has_tombstones(self) -> bool:
         """Cheap probe for the bulk-ingest reseed tail (racy read of a
         dict length — a miss only defers a seed to the name's next
         creation, and the common case is an empty table)."""
         return bool(self._tombstones)
+
+    def export_tombstones(self) -> Dict[str, Tuple[int, int, int, int]]:
+        """Snapshot the tombstone table for checkpointing (insertion order
+        preserved — the LRU bound survives a save/restore roundtrip)."""
+        with self._mu:
+            return dict(self._tombstones)
+
+    def restore_tombstones(self, entries) -> int:
+        """Re-install checkpointed tombstones (``name → (own_added_nt,
+        own_taken_nt, elapsed_ns, created_ns)``). Names currently bound
+        are skipped — a live row's lanes already carry its spend; max-join
+        against an existing tombstone keeps the table monotone if both a
+        checkpoint and a post-restore reclaim contributed. Returns entries
+        installed."""
+        n = 0
+        with self._mu:
+            for name, tomb in entries.items():
+                if name in self._rows:
+                    continue
+                a, t, e, c = (int(v) for v in tomb)
+                old = self._tombstones.pop(name, None)
+                if old is not None:
+                    a, t, e = max(a, old[0]), max(t, old[1]), max(e, old[2])
+                    c = min(c, old[3]) if old[3] else c
+                self._tombstones[name] = (a, t, e, c)
+                n += 1
+                while len(self._tombstones) > self.tombstone_cap:
+                    self._tombstones.pop(next(iter(self._tombstones)))
+        return n
 
     def tombstone_stats(self) -> Tuple[int, int]:
         """→ (entries, approximate bytes) for the budget accounting."""

@@ -235,6 +235,123 @@ GC_SOFT_FRAC = float(os.environ.get("PATROL_GC_SOFT_FRAC", 0.85))
 # + the per-row int64/int32 columns) — the budget accounting's row class.
 _ROW_HOST_BYTES = 256 + 64
 
+# patrol-audit (net/audit.py): the admitted-token audit window. Every
+# admitted take books its nanotokens into the engine's AuditLedger under
+# the current window id; the audit plane gossips the closed windows'
+# own-lane G-counters cluster-wide and reports the measured AP-overshoot
+# factor (global admitted vs limit×1) as a live SLI. 0 = manual windows
+# (tests/bench close them explicitly via roll(force=True)).
+AUDIT_WINDOW_NS = int(float(os.environ.get("PATROL_AUDIT_WINDOW_MS", 5000)) * 1e6)
+
+
+class AuditLedger:
+    """Own-lane half of the AP-overshoot auditor: a windowed per-bucket
+    admitted-token G-counter. Each admitted take books its nanotokens
+    under the CURRENT window id; a window's per-bucket totals are monotone
+    within the window, so they gossip as join-decompositions exactly like
+    the metrics lattices (net/fleet.py) — receivers max-join per (window,
+    bucket, lane). Window ids are engine-clock derived (``clock //
+    window_ns``), so clock-synced nodes agree on attribution; with
+    ``window_ns == 0`` windows only close via ``roll(force=True)`` and the
+    id is a lockstep epoch counter (the deterministic test/bench mode).
+
+    Alongside the admitted count the ledger keeps each bucket's limit
+    view: capacity base plus the rate-derived refill over the window's
+    observed span — the ``limit × 1`` denominator of the overshoot
+    factor. Thread-safe; one leaf lock, never held across other locks
+    (declared in analysis/race.py::GUARDS)."""
+
+    def __init__(self, window_ns: int = 0):
+        self._mu = threading.Lock()
+        self.window_ns = window_ns
+        self._window = 0
+        self._start_ns: Optional[int] = None
+        # name -> [admitted_nt, cap_nt(max), per_ns(max)] for the open window.
+        self._cur: Dict[str, list] = {}
+        self._closed: deque = deque(maxlen=4)
+        self.windows_closed = 0
+
+    def _clock_window(self, now: int) -> int:
+        return now // self.window_ns if self.window_ns > 0 else self._window
+
+    def _close_locked(self, now: int, next_window: int) -> None:
+        start = self._start_ns if self._start_ns is not None else now
+        dur = max(0, now - start)
+        if self._cur:
+            lanes = {
+                name: (
+                    v[0],
+                    # limit×1: capacity base + refill over the window span.
+                    v[1] + (v[1] * dur // v[2] if v[2] > 0 else 0),
+                )
+                for name, v in self._cur.items()
+            }
+            self._closed.append((self._window, dur, lanes))
+            self.windows_closed += 1
+        self._cur = {}
+        self._window = next_window
+        self._start_ns = now
+
+    def note(
+        self, name: str, admitted_nt: int, cap_nt: int, per_ns: int, now: int
+    ) -> None:
+        """Book one admitted take into the open window (self-rolling on
+        clock-derived window ids)."""
+        if admitted_nt <= 0:
+            return
+        with self._mu:
+            if self._start_ns is None:
+                self._start_ns = now
+                self._window = self._clock_window(now)
+            elif self.window_ns > 0:
+                w = self._clock_window(now)
+                if w > self._window:
+                    self._close_locked(now, w)
+            ent = self._cur.get(name)
+            if ent is None:
+                self._cur[name] = [admitted_nt, max(cap_nt, 0), max(per_ns, 0)]
+            else:
+                ent[0] += admitted_nt
+                ent[1] = max(ent[1], cap_nt)
+                ent[2] = max(ent[2], per_ns)
+
+    def roll(self, now: int, force: bool = False) -> None:
+        """Close the open window when its span lapsed (or ``force``)."""
+        with self._mu:
+            if self._start_ns is None:
+                self._start_ns = now
+                self._window = self._clock_window(now)
+                return
+            if force:
+                self._close_locked(now, self._window + 1)
+            elif self.window_ns > 0:
+                w = self._clock_window(now)
+                if w > self._window:
+                    self._close_locked(now, w)
+
+    def export(self):
+        """→ (current window id, closed windows) where each closed window
+        is ``(window_id, duration_ns, {name: (admitted_nt, limit_nt)})``
+        and the OPEN window rides along too (monotone — shipping partial
+        progress is join-safe). The open window's limit uses the span so
+        far."""
+        with self._mu:
+            out = list(self._closed)
+            if self._cur and self._start_ns is not None:
+                # The open window's partial view (duration so far unknown
+                # to a frozen clock ⇒ 0 refill, conservative).
+                out.append(
+                    (
+                        self._window,
+                        0,
+                        {
+                            name: (v[0], v[1])
+                            for name, v in self._cur.items()
+                        },
+                    )
+                )
+            return self._window, out
+
 
 class HostLanes:
     """Host-resident PN-lane state for one bucket row: the fast-path twin
@@ -890,6 +1007,12 @@ class DeviceEngine:
         # reads per take) at window rollover and wake the feeder, which
         # runs the sweep. Guarded by _cond like the work queues.
         self._gc_due = False
+        # patrol-audit: the admitted-token window ledger (net/audit.py
+        # reads it on the audit plane's pace). Known attribution gap: the
+        # C++ native-front in-process takes never cross into Python, so
+        # they are invisible to the ledger — audit coverage degrades to
+        # the python-served paths there (documented in README).
+        self._audit = AuditLedger(AUDIT_WINDOW_NS)
         if self._max_buckets or self._bytes_budget:
             from patrol_tpu.utils import slo as slo_mod
 
@@ -1476,6 +1599,13 @@ class DeviceEngine:
             self.directory.unpin_rows([row])
         done_ns = time.perf_counter_ns()
         hist.TAKE_SERVICE.record(done_ns - ticket.t0_ns)
+        if ok:
+            # patrol-audit: book the admitted tokens into the open audit
+            # window (the AP-overshoot auditor's own lane). Leaf lock,
+            # taken strictly after _host_mu released.
+            self._audit.note(
+                ticket.name, ticket.count * NANO, cap, rate.per_ns, now
+            )
         if ticket.trace_id:
             trace_mod.SPANS.add(
                 ticket.trace_id, self.node_slot, "take", ticket.name,
@@ -1511,7 +1641,9 @@ class DeviceEngine:
 
     def _note_dirty(self, broadcasts: List[wire.WireState]) -> None:
         """Remember which buckets this node broadcast state for (bounded,
-        newest kept) — the shutdown-flush working set."""
+        newest kept) — the shutdown-flush working set. Also stamps the
+        patrol-audit per-bucket emission clock (staleness sampler)."""
+        now = self.clock()
         with self._dirty_mu:
             d = self._dirty_names
             for st in broadcasts:
@@ -1519,6 +1651,10 @@ class DeviceEngine:
                 d[st.name] = None
             while len(d) > self._dirty_cap:
                 d.pop(next(iter(d)))
+        for st in broadcasts:
+            row = self.directory.lookup(st.name)
+            if row is not None:
+                self.directory.last_emit_ns[row] = now
 
     def drain_dirty_states(self, limit: int = 1024) -> List[wire.WireState]:
         """Snapshot the most recently broadcast buckets' CURRENT full lane
@@ -2179,6 +2315,8 @@ class DeviceEngine:
                 with self._cond:
                     self._deltas.append(_Delta(row, self.node_slot, *seed))
                     self._cond.notify()
+        # patrol-audit staleness stamp (remote absorb; racy by design).
+        self.directory.last_remote_ns[row] = now
         added_nt = state.added_nt
         taken_nt = state.taken_nt
         if state.cap_nt is not None:
@@ -2366,6 +2504,9 @@ class DeviceEngine:
                 )
                 continue
             rows, fresh_c = res
+            # patrol-audit staleness stamp: these rows just absorbed
+            # remote-lane state (racy int64 write, sampler-only reader).
+            self.directory.last_remote_ns[rows] = now
             if fresh_c.any():
                 self._reseed_fresh_rows(chunk_names, rows, fresh_c)
             slots_c = slots_a[lo:hi]
@@ -2436,6 +2577,8 @@ class DeviceEngine:
         added_c = np.maximum(added_c, 0)
         taken_c = np.maximum(taken_c, 0)
         elapsed_c = np.maximum(elapsed_c, 0)
+        # patrol-audit staleness stamp (remote absorb; racy by design).
+        self.directory.last_remote_ns[rows] = self.clock()
         scalar_c = None
         if caps_c is not None:
             has_cap = caps_c >= 0
@@ -3108,6 +3251,18 @@ class DeviceEngine:
         return self._demotions
 
     @property
+    def audit_ledger(self) -> AuditLedger:
+        """patrol-audit admitted-token window ledger (net/audit.py reads
+        it on the audit plane's pace)."""
+        return self._audit
+
+    def audit_staleness_samples(self, limit: int = 64) -> List[int]:
+        """Per-bucket staleness sample for the audit plane: ns the last
+        local emission ran ahead of the last remote absorb, over up to
+        ``limit`` buckets that have seen both."""
+        return [int(v) for v in self.directory.staleness_sample(limit)]
+
+    @property
     def pending_completions(self) -> int:
         """Dispatched ticks whose results haven't fanned out yet — the
         completion pipeline's depth (backpressure signal)."""
@@ -3348,14 +3503,18 @@ class DeviceEngine:
         broadcasts: List[wire.WireState] = []
         unpin: List[int] = []
         done_ns = time.perf_counter_ns()
+        now_clock = self.clock()
         take_hist = hist.TAKE_SERVICE
         for i, key in enumerate(keys):
             ts = groups[key]
             c_nt = ts[0].count * NANO
+            admitted_nt = 0
             for idx, t in enumerate(ts):
                 remaining, ok = remaining_for_request(
                     int(have[i]), int(admitted[i]), c_nt, idx
                 )
+                if ok:
+                    admitted_nt += c_nt
                 if t.complete(remaining, ok):
                     unpin.append(t.row)
                     take_hist.record(done_ns - t.t0_ns)
@@ -3374,6 +3533,12 @@ class DeviceEngine:
             # still all-zero — a zero state on the wire is the incast
             # *request* marker (repo.go:78-90).
             cap = int(self.directory.cap_base_nt[ts[0].row])
+            if admitted_nt:
+                # patrol-audit: the device path's admitted-token booking
+                # (the host fast path books in _host_serve_ticket).
+                self._audit.note(
+                    ts[0].name, admitted_nt, cap, ts[0].rate.per_ns, now_clock
+                )
             if own_a[i] or own_t[i] or elapsed[i] or cap:
                 broadcasts.append(
                     wire.from_nanotokens(

@@ -239,6 +239,13 @@ STAGE_DEVICE_TAKE = HISTOGRAMS.get("device_take_ns")
 # Not an ingest/device stage column — the sweep is a maintenance path,
 # so it must not gate the smoke's every-stage-has-samples assertion.
 GC_SWEEP = HISTOGRAMS.get("gc_sweep_ns")
+# patrol-audit (net/audit.py): per-peer replication lag (oldest unacked
+# delta interval's age, one sample per delta-exchanging peer per audit
+# tick) and per-bucket staleness (ns the last local emission ran ahead
+# of the last remote absorb). Both are G-Counter lattices like every
+# histogram here, so the fleet gossip merges them cluster-wide for free.
+AUDIT_PEER_LAG = HISTOGRAMS.get("audit_peer_lag_ns")
+AUDIT_STALENESS = HISTOGRAMS.get("audit_bucket_staleness_ns")
 
 # The bench's per-stage attribution set (benchmarks/PROBES.md).
 INGEST_STAGES = (

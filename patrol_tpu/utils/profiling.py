@@ -287,6 +287,18 @@ class CounterRegistry:
         "gc_pressure_shed",
         "directory_compactions",
         "state_bytes_in_use",
+        # patrol-audit (net/audit.py): lag samples recorded, read-only
+        # divergence compares completed, admitted-token windows evaluated,
+        # the high-water measured overshoot (milli-factor, set_max so the
+        # gauge is monotone and fleet-gossip-safe), audit frames shipped /
+        # joined, and SLO overshoot breaches fired.
+        "audit_lag_samples",
+        "audit_divergence_checks",
+        "audit_windows_evaluated",
+        "audit_overshoot_millis",
+        "audit_packets_tx",
+        "audit_packets_rx",
+        "audit_overshoot_breaches",
     )
 
     def __init__(self):

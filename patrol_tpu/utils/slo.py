@@ -12,13 +12,22 @@ buckets) integer work — no sampling, no timers):
   thread's flight-recorder ring (damped to 1/reason/s by the recorder).
 * **stage-budget overrun** — any commit-pipeline or device stage whose
   window p99 exceeds its budget fires ``anomaly("slo.stage_budget")``.
+* **AP-overshoot** (patrol-audit, net/audit.py) — when the measured
+  admitted-token overshoot factor of the last evaluated audit window
+  exceeds ``PATROL_SLO_OVERSHOOT × partition-sides-estimate``, the
+  sentinel fires ``anomaly("slo.overshoot")``: admission multiplied
+  beyond what the observed partition explains is evidence worth
+  freezing. Enabled by setting ``PATROL_SLO_OVERSHOOT`` > 0 (1.0 = the
+  paper's AP bound exactly: overshoot must not exceed the sides
+  estimate).
 
 Budgets default OFF (0 = disabled) so an unconfigured process never
 snapshots itself; set them via environment (``PATROL_SLO_TAKE_P99_NS``,
-``PATROL_SLO_STAGE_P99_NS``) or programmatically (tests, operators).
-The check is driven by the fleet gossip flusher (net/fleet.py) — the
-same paced observability tick that ships the histograms — and by
-``bench.py --trend``.
+``PATROL_SLO_STAGE_P99_NS``, ``PATROL_SLO_OVERSHOOT``) or
+programmatically (tests, operators). The check is driven by the fleet
+gossip flusher (net/fleet.py) — the same paced observability tick that
+ships the histograms — by the audit plane's own tick
+(:meth:`SloSentinel.check_audit`), and by ``bench.py --trend``.
 """
 
 from __future__ import annotations
@@ -34,6 +43,13 @@ from patrol_tpu.utils import profiling
 def _env_int(name: str, default: int) -> int:
     try:
         return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
     except ValueError:
         return default
 
@@ -58,6 +74,7 @@ class SloSentinel:
         stage_budget_ns: Optional[int] = None,
         max_burn: float = 0.10,
         min_samples: int = 16,
+        overshoot_budget: Optional[float] = None,
     ):
         self.take_budget_ns = (
             _env_int("PATROL_SLO_TAKE_P99_NS", 0)
@@ -69,6 +86,11 @@ class SloSentinel:
             if stage_budget_ns is None
             else stage_budget_ns
         )
+        self.overshoot_budget = (
+            _env_float("PATROL_SLO_OVERSHOOT", 0.0)
+            if overshoot_budget is None
+            else overshoot_budget
+        )
         self.max_burn = max_burn
         self.min_samples = min_samples
         self._mu = threading.Lock()
@@ -79,6 +101,12 @@ class SloSentinel:
         # check — a hard-watermark breach freezes evidence exactly like a
         # latency burn.
         self._budget_src: Optional[Callable[[], dict]] = None
+        # patrol-audit overshoot provider (AuditPlane._slo_snapshot):
+        # last evaluated window's measured factor + sides estimate.
+        self._audit_src: Optional[Callable[[], dict]] = None
+        # The last (window, factor) breach fired, so one bad window does
+        # not re-fire on every subsequent check.
+        self._audit_fired: Optional[tuple] = None
 
     def watch_budget(self, provider: Callable[[], dict]) -> None:
         """Register the engine's memory-budget snapshot provider (dict
@@ -96,12 +124,26 @@ class SloSentinel:
             if self._budget_src == provider:
                 self._budget_src = None
 
+    def watch_audit(self, provider: Callable[[], dict]) -> None:
+        """Register the audit plane's overshoot provider (dict with
+        ``overshoot``, ``sides``, ``window``). Latest plane wins."""
+        with self._mu:
+            self._audit_src = provider
+
+    def unwatch_audit(self, provider: Callable[[], dict]) -> None:
+        """Audit plane shutdown: drop the provider IF still ours (same
+        equality contract as :meth:`unwatch_budget`)."""
+        with self._mu:
+            if self._audit_src == provider:
+                self._audit_src = None
+
     def configure(
         self,
         take_budget_ns: Optional[int] = None,
         stage_budget_ns: Optional[int] = None,
         max_burn: Optional[float] = None,
         min_samples: Optional[int] = None,
+        overshoot_budget: Optional[float] = None,
     ) -> None:
         with self._mu:
             if take_budget_ns is not None:
@@ -112,6 +154,8 @@ class SloSentinel:
                 self.max_burn = max_burn
             if min_samples is not None:
                 self.min_samples = min_samples
+            if overshoot_budget is not None:
+                self.overshoot_budget = overshoot_budget
 
     def _window(self, name: str, counts: List[int]) -> List[int]:
         """Per-bucket deltas since the last check (counts are cumulative
@@ -171,6 +215,7 @@ class SloSentinel:
                                 "budget_ns": self.stage_budget_ns,
                             }
                         )
+            breaches.extend(self._audit_breach_locked())
             budget_src = self._budget_src
             if budget_src is not None:
                 try:
@@ -201,6 +246,54 @@ class SloSentinel:
         for kind in sorted({b["kind"] for b in breaches}):
             profiling.COUNTERS.inc("slo_breaches")
             trace_mod.anomaly(f"slo.{kind}")
+        return breaches
+
+    def _audit_breach_locked(self) -> List[dict]:
+        """The AP-overshoot budget (patrol-audit): breach when the last
+        evaluated window's measured factor exceeds ``overshoot_budget ×
+        sides-estimate``. Caller holds ``_mu``. Fires once per (window,
+        factor) — a standing bad window must not re-snapshot every tick."""
+        if self.overshoot_budget <= 0 or self._audit_src is None:
+            return []
+        try:
+            snap = self._audit_src()
+        except Exception:  # pragma: no cover - provider must not kill checks
+            return []
+        factor = float(snap.get("overshoot", 0.0))
+        sides = max(int(snap.get("sides", 1)), 1)
+        window = snap.get("window", -1)
+        bound = self.overshoot_budget * sides
+        key = (window, round(factor, 6))
+        if factor <= bound or window < 0 or self._audit_fired == key:
+            return []
+        self._audit_fired = key
+        profiling.COUNTERS.inc("audit_overshoot_breaches")
+        return [
+            {
+                "kind": "overshoot",
+                "stage": "audit_overshoot_factor",
+                "window": window,
+                "burn": round(factor, 4),
+                "budget_ns": 0,
+                "overshoot": round(factor, 4),
+                "sides": sides,
+                "bound": round(bound, 4),
+            }
+        ]
+
+    def check_audit(self) -> List[dict]:
+        """The audit plane's own tick: evaluate ONLY the overshoot budget
+        (the latency/stage windows stay on the fleet-gossip cadence, so
+        an extra audit tick never shrinks their burn windows)."""
+        from patrol_tpu.utils import trace as trace_mod
+
+        with self._mu:
+            breaches = self._audit_breach_locked()
+            if breaches:
+                self.breaches += len(breaches)
+        for _ in breaches:
+            profiling.COUNTERS.inc("slo_breaches")
+            trace_mod.anomaly("slo.overshoot")
         return breaches
 
 

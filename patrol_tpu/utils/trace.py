@@ -69,6 +69,8 @@ EV_DELTA_PACK = 13  # delta-plane flush: intervals packed (arg = datagrams)
 EV_DELTA_ACK = 14  # delta ack vector sent/processed (arg = acks)
 EV_DELTA_RETRANSMIT = 15  # expired intervals re-shipped (arg = intervals)
 EV_DEVICE_READY = 16  # device dispatch→ready observed (arg = work rows)
+EV_AUDIT_TICK = 17  # patrol-audit flush tick (arg = datagrams shipped)
+EV_AUDIT_COMPARE = 18  # read-only divergence compare (arg = divergent buckets)
 
 EVENT_NAMES = {
     EV_TICK: "engine.tick",
@@ -87,6 +89,8 @@ EVENT_NAMES = {
     EV_DELTA_ACK: "delta.ack",
     EV_DELTA_RETRANSMIT: "delta.retransmit",
     EV_DEVICE_READY: "device.ready",
+    EV_AUDIT_TICK: "audit.tick",
+    EV_AUDIT_COMPARE: "audit.compare",
 }
 
 AE_PHASES = {"trigger": 1, "digest": 2, "fetch": 3}

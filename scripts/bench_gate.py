@@ -74,6 +74,14 @@ TREND_GATES: Dict[str, dict] = {
     "soak_p99_drift_x": {
         "direction": "lower", "rel_tol": 2.0, "abs_floor": 1.0,
     },
+    # patrol-audit: the measured AP-overshoot factor of the chaos smoke's
+    # seeded 2-side partition. Deterministic (frozen clocks, both sides
+    # admit exactly one capacity: 20/10 = 2.0) — a drift means the
+    # auditor's lattice arithmetic changed. The chaos leg separately
+    # hard-asserts factor ∈ (1, sides].
+    "audit_overshoot_factor": {
+        "direction": "lower", "rel_tol": 0.05, "abs_floor": 0.01,
+    },
 }
 
 # Hard boolean/exactness gates: value must equal the expectation.
@@ -104,6 +112,11 @@ EXACT_GATES: Dict[str, object] = {
     "soak_admits_equal": True,
     "soak_footprint_under_budget": True,
     "soak_shed_main": 0,
+    # patrol-audit: the divergence gauge MUST read zero at the chaos
+    # leg's converged fixpoint (the meter's defining property), and the
+    # sides estimate of the seeded 2-side partition is exactly 2.
+    "audit_divergent_buckets": 0,
+    "audit_sides_estimate": 2,
 }
 
 # Fields that must be present AND strictly positive (no baseline needed):
@@ -115,6 +128,13 @@ NONZERO_GATES = (
     # reclaimed, and the frozen-clock shed probe drew explicit sheds.
     "soak_reclaimed",
     "soak_shed_probe",
+    # patrol-audit instrumentation liveness: the lag gauges drew samples,
+    # read-only divergence compares ran, the divergent phase was actually
+    # observed (>0 before repair re-armed), and a window was evaluated.
+    "audit_peer_lag_samples",
+    "audit_divergence_checks",
+    "audit_divergent_buckets_divergent_phase",
+    "audit_windows_evaluated",
 )
 
 # Device-stage columns (patrol-fleet device-dispatch timing): the smoke's

@@ -22,6 +22,15 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # tests/test_fleet.py, which drives plane.flush() explicitly (and one
 # test exercises the real flusher thread with a tight interval).
 os.environ.setdefault("PATROL_FLEET_GOSSIP_MS", "0")
+# patrol-audit stays MANUALLY paced under test for the same reason: a
+# background audit flusher would interleave extra control datagrams into
+# the chaos suite's seeded per-link faultnet streams and un-seed the
+# schedules. Audit behavior is covered by tests/test_audit.py, which
+# drives plane.flush() explicitly. The admitted-token window likewise
+# closes manually (roll(force=True)) so frozen-clock differentials stay
+# deterministic.
+os.environ.setdefault("PATROL_AUDIT_MS", "0")
+os.environ.setdefault("PATROL_AUDIT_WINDOW_MS", "0")
 # Bucket-lifecycle GC likewise stays MANUALLY paced under test: the
 # feeder's window-rollover sweep observes the injected clock at
 # wall-clock-dependent ticks, so a seeded differential run (fastpath vs

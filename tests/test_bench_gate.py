@@ -43,6 +43,13 @@ def healthy_receipts():
             "soak_shed_main": 0,
             "soak_reclaimed": 4164,
             "soak_shed_probe": 63,
+            "audit_divergent_buckets": 0,
+            "audit_sides_estimate": 2,
+            "audit_overshoot_factor": 2.0,
+            "audit_peer_lag_samples": 2,
+            "audit_divergence_checks": 8,
+            "audit_divergent_buckets_divergent_phase": 1,
+            "audit_windows_evaluated": 1,
             "ingest_stage_breakdown": {
                 "device_commit_ns": {"count": 3, "p50_ns": 1, "p99_ns": 2},
                 "device_take_ns": {"count": 32, "p50_ns": 1, "p99_ns": 2},
