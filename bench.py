@@ -1373,6 +1373,159 @@ def smoke_main() -> int:
         OUT["ingest_commit_smoke_deltas"] = int(n + n2)
         _snap_commit_counters(OUT, counters0)
 
+        # -- device-resident raw-ingest gate (r15) ------------------------
+        # A seeded dv2 datagram corpus (valid interleaved with hostile:
+        # truncations, single-byte flips, trailing garbage) replayed as
+        # RAW BYTE PLANES through engine.ingest_raw_planes (ops/ingest.py
+        # decode_fold_raw: framing walk + verdicts + fold, one dispatch),
+        # hard-gated BIT-EXACT against the python decode +
+        # ingest_interval path; plus the CPU-measured raw ingest rate —
+        # the number the r05 375k deltas/s end-to-end wall is judged by.
+        from patrol_tpu.ops import ingest as ingest_ops
+        from patrol_tpu.ops import wire as wire_raw
+
+        ROWB = wire_raw.DELTA_PACKET_SIZE
+
+        def _raw_pkt(seed: int, hostile: int) -> bytes:
+            r = np.random.default_rng(seed)
+            ents = [
+                wire_raw.DeltaEntry(
+                    f"rw{int(r.integers(0, 2000))}", int(r.integers(0, nodes)),
+                    int(r.integers(0, 1 << 50)), int(r.integers(0, 1 << 50)),
+                    int(r.integers(0, 1 << 50)), int(r.integers(0, 1 << 50)),
+                )
+                for _ in range(180)
+            ]
+            b, _k = wire_raw.encode_delta_packet(1, seed + 1, (), ents, ROWB)
+            b = bytearray(b)
+            if hostile == 1:
+                b[int(r.integers(0, len(b)))] ^= 0x41
+            elif hostile == 2:
+                b = b[: int(r.integers(1, len(b)))]
+            elif hostile == 3:
+                b += b"??"
+            return bytes(b)
+
+        corpus = [_raw_pkt(i, (0, 0, 1, 2, 3)[i % 5]) for i in range(60)]
+        planes = np.full((len(corpus), ROWB), 0xAB, np.uint8)  # stale tails
+        lengths = np.zeros(len(corpus), np.int32)
+        for i, b in enumerate(corpus):
+            planes[i, : min(len(b), ROWB)] = np.frombuffer(
+                b[:ROWB], np.uint8
+            )
+            lengths[i] = min(len(b), ROWB)
+        raw_names = {
+            e.name
+            for b in corpus
+            if (pk := wire_raw.decode_delta_packet(b)) is not None
+            for e in pk.entries
+        }
+        e_raw = DeviceEngine(cfg, node_slot=0)
+        e_ref = DeviceEngine(cfg, node_slot=0)
+        try:
+            n_raw = e_raw.ingest_raw_planes(planes, lengths)
+            assert e_raw.flush(timeout=60), "raw engine flush timed out"
+            for b in corpus:
+                pk = wire_raw.decode_delta_packet(b)
+                if pk is None or not pk.entries:
+                    continue
+                ents = [e for e in pk.entries if e.slot < nodes]
+                e_ref.ingest_interval(
+                    [e.name for e in ents], [e.slot for e in ents],
+                    [e.cap_nt for e in ents], [e.added_nt for e in ents],
+                    [e.taken_nt for e in ents], [e.elapsed_ns for e in ents],
+                )
+            assert e_ref.flush(timeout=60), "ref engine flush timed out"
+            rows_raw = [e_raw.directory.lookup(nm) for nm in sorted(raw_names)]
+            rows_ref = [e_ref.directory.lookup(nm) for nm in sorted(raw_names)]
+            assert all(r is not None for r in rows_raw + rows_ref), (
+                "raw/host directory population diverged"
+            )
+            pn_a, el_a = e_raw.read_rows(rows_raw)
+            pn_b, el_b = e_ref.read_rows(rows_ref)
+            assert np.array_equal(pn_a, pn_b) and np.array_equal(el_a, el_b), (
+                "raw-plane device decode+fold diverged from the host "
+                "decode path"
+            )
+            OUT["ingest_raw_vs_host_fixpoint"] = "bit-exact"
+            OUT["ingest_raw_smoke_deltas"] = int(n_raw)
+            # Timed leg: a FLOOD-shaped all-valid batch (a recvmmsg sweep
+            # under load fills ~256-row planes of ~180-entry intervals),
+            # repeated — the join is idempotent, so re-ingesting measures
+            # the identical work. This is the number the r05 375k
+            # deltas/s end-to-end wall is judged by.
+            flood = [_raw_pkt(10_000 + i, 0) for i in range(240)]
+            fl_planes = np.zeros((len(flood), ROWB), np.uint8)
+            fl_lengths = np.zeros(len(flood), np.int32)
+            for i, b in enumerate(flood):
+                fl_planes[i, : len(b)] = np.frombuffer(b, np.uint8)
+                fl_lengths[i] = len(b)
+            e_raw.ingest_raw_planes(fl_planes, fl_lengths)  # warm shapes
+            assert e_raw.flush(timeout=60)
+            t_r0 = time.time()
+            reps_raw = 0
+            while time.time() - t_r0 < 2.0 and reps_raw < 40:
+                e_raw.ingest_raw_planes(fl_planes, fl_lengths)
+                reps_raw += 1
+            assert e_raw.flush(timeout=60)
+            dt_raw = time.time() - t_r0
+            rate = reps_raw * len(flood) * 180 / dt_raw
+            OUT["ingest_raw_decode_per_s"] = int(rate)
+            # Same-box reference: the SAME flood through the python
+            # decode + ingest_interval path (the pre-r15 rx pipeline).
+            # Absolute rates are container-class-bound — the BENCH_r05
+            # 375k/s end-to-end figure came from a different machine —
+            # so the honest improvement claim is the same-box ratio,
+            # hard-gated ≥ 2x (the r15 acceptance bar).
+            decoded_flood = [wire_raw.decode_delta_packet(b) for b in flood]
+            t_p0 = time.time()
+            reps_py = 0
+            while time.time() - t_p0 < 2.0 and reps_py < 6:
+                for pk in decoded_flood:
+                    ents = [e for e in pk.entries if e.slot < nodes]
+                    e_ref.ingest_interval(
+                        [e.name for e in ents], [e.slot for e in ents],
+                        [e.cap_nt for e in ents], [e.added_nt for e in ents],
+                        [e.taken_nt for e in ents],
+                        [e.elapsed_ns for e in ents],
+                    )
+                reps_py += 1
+            assert e_ref.flush(timeout=60)
+            dt_py = time.time() - t_p0
+            # NOTE: the python leg is flattered here — its per-datagram
+            # wire.decode_delta_packet cost is NOT in the timed window
+            # (pre-decoded above), while the raw leg carries its whole
+            # bytes→state path. The gated ratio is therefore a floor.
+            rate_py = reps_py * len(flood) * 180 / dt_py
+            OUT["ingest_raw_python_path_per_s"] = int(rate_py)
+            speedup = rate / max(rate_py, 1.0)
+            OUT["ingest_raw_vs_python_speedup_x"] = round(speedup, 2)
+            OUT["ingest_raw_speedup_vs_r05"] = round(rate / 375_000.0, 2)
+            OUT["ingest_raw_basis"] = (
+                f"cpu-measured, {os.cpu_count()}-core container; r05 375k/s "
+                "was a different container class — the same-box ratio is "
+                "the gated claim"
+            )
+            assert speedup >= 2.0, (
+                f"raw ingest speedup {speedup:.2f}x < 2x vs the python "
+                "decode path on this box"
+            )
+        finally:
+            e_raw.stop()
+            e_ref.stop()
+        snap = profiling.COUNTERS.snapshot()
+        OUT["ingest_raw_device_dispatches"] = int(
+            snap.get("ingest_raw_device_dispatches", 0)
+            - counters0.get("ingest_raw_device_dispatches", 0)
+        )
+        OUT["ingest_raw_bytes_on_device"] = int(
+            snap.get("ingest_raw_bytes_on_device", 0)
+            - counters0.get("ingest_raw_bytes_on_device", 0)
+        )
+        assert OUT["ingest_raw_device_dispatches"] > 0, (
+            "raw ingest never dispatched"
+        )
+
         # -- patrol-scope gates -------------------------------------------
         # (1) rx-decode stage samples: drive real wire packets through the
         # instrumented replication rx path (no sockets — Replicator._ingest
@@ -2015,7 +2168,18 @@ def wire_main() -> int:
         # The explicit opt-out leg runs through the "full" ALIAS so the
         # regression covers both the classic plane and the alias plumbing.
         full = run_mode("full")
+        raw0 = profiling.COUNTERS.get("ingest_raw_device_dispatches")
         delta = run_mode("delta")
+        # Device-resident ingest (r15): the delta leg's rx path must have
+        # shipped its intervals as raw byte planes (one decode+fold
+        # dispatch per datagram batch) — a zero here means the raw seam
+        # silently fell back to the per-datagram python decode.
+        OUT["wire_raw_device_dispatches"] = (
+            profiling.COUNTERS.get("ingest_raw_device_dispatches") - raw0
+        )
+        assert OUT["wire_raw_device_dispatches"] > 0, (
+            "delta-mode rx never took the raw-plane device path"
+        )
 
         st = delta["stats0"]
         data_pkts = st["wire_delta_packets_tx"]

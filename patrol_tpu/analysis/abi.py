@@ -72,6 +72,7 @@ from __future__ import annotations
 
 import ctypes
 import dataclasses
+import errno
 import itertools
 import math
 import os
@@ -1390,6 +1391,157 @@ def check_hls_interleavings(ob: AbiObligation, lib) -> List[Finding]:
 
 
 # ===========================================================================
+# PTA004 — rx-ring lease/commit vs the pump (device-resident ingest).
+#
+# The zero-copy rx ring's ownership protocol spans two threads: the rx
+# loop LEASES a plane before recvmmsg fills it, hands the shipped plane
+# to the engine, and the completion pipeline COMMITS it back once the
+# H2D transfer is ready. This explorer enumerates EVERY interleaving of
+# a bounded rx script (leases, one past capacity — the -EAGAIN edge)
+# against a completer script (commits, in hand-off FIFO order, only
+# schedulable while the queue is non-empty), running each schedule
+# against a fresh native ring AND a step-for-step Python model of the
+# lowest-free-first lease policy. Divergence (wrong plane index, a lease
+# succeeding on an in-flight plane, stats drift) and ownership-protocol
+# violations (double commit, stray-index commit must refuse -EINVAL)
+# are PTA004 findings.
+
+
+class _RingModel:
+    """Python twin of PtRxRing: lowest-free-first lease, commit frees."""
+
+    def __init__(self, n_planes: int):
+        self.free = list(range(n_planes))
+        self.leased: set = set()
+        self.used: set = set()
+        self.leases = 0
+        self.commits = 0
+        self.reuse = 0
+        self.exhausted = 0
+
+    def lease(self) -> int:
+        for i in sorted(self.free):
+            self.free.remove(i)
+            self.leased.add(i)
+            self.leases += 1
+            if i in self.used:
+                self.reuse += 1
+            self.used.add(i)
+            return i
+        self.exhausted += 1
+        return -errno.EAGAIN
+
+    def commit(self, i: int) -> int:
+        if i not in self.leased:
+            return -errno.EINVAL
+        self.leased.discard(i)
+        self.free.append(i)
+        self.commits += 1
+        return 0
+
+    def stats(self):
+        return (self.leases, self.commits, self.reuse, self.exhausted)
+
+
+def _ring_schedules(n_leases: int, n_commits: int):
+    """All interleavings of ``n_leases`` rx ops vs ``n_commits`` pump
+    commits, a commit only schedulable while the hand-off queue holds a
+    successfully leased plane (the blocking rule — exactly how the real
+    completer parks until the feeder hands it work)."""
+    out: List[Tuple[str, ...]] = []
+
+    def rec(lx, cx, queue, prefix):
+        if lx == n_leases and cx == n_commits:
+            out.append(tuple(prefix))
+            return
+        if lx < n_leases:
+            prefix.append("lease")
+            rec(lx + 1, cx, queue + 1, prefix)  # queue grows iff success;
+            prefix.pop()  # the runner tracks real success — this bound
+            # only prunes schedules that could never run.
+        if cx < n_commits and queue > 0:
+            prefix.append("commit")
+            rec(lx, cx + 1, queue - 1, prefix)
+            prefix.pop()
+
+    rec(0, 0, 0, [])
+    return out
+
+
+def check_rxring_interleavings(ob: AbiObligation, lib=None) -> List[Finding]:
+    lib = lib if lib is not None else _load_lib()
+    site = _cpp_site("pt_rx_ring_lease")
+    findings: List[Finding] = []
+    n_planes, n_leases, n_commits = 2, 3, 2
+
+    def run_schedule(schedule) -> Optional[str]:
+        h = lib.pt_rx_ring_create(n_planes, 4, 256)
+        if h < 0:
+            return f"pt_rx_ring_create failed ({h})"
+        try:
+            model = _RingModel(n_planes)
+            queue: List[int] = []
+            for step, op in enumerate(schedule):
+                if op == "lease":
+                    got = lib.pt_rx_ring_lease(h)
+                    want = model.lease()
+                    if got != want:
+                        return f"step {step}: lease → {got}, model {want}"
+                    if got >= 0:
+                        queue.append(got)
+                else:
+                    if not queue:
+                        continue  # pruned interleaving became empty: skip
+                    plane = queue.pop(0)
+                    got = lib.pt_rx_ring_commit(h, plane)
+                    want = model.commit(plane)
+                    if got != want:
+                        return (
+                            f"step {step}: commit({plane}) → {got}, "
+                            f"model {want}"
+                        )
+            # Ownership refusals: a double commit and a stray index must
+            # both refuse -EINVAL (the use-after-recycle guard).
+            if queue:
+                plane = queue.pop(0)
+                if lib.pt_rx_ring_commit(h, plane) != model.commit(plane):
+                    return "drain commit diverged"
+                if lib.pt_rx_ring_commit(h, plane) != -errno.EINVAL:
+                    return f"double commit of plane {plane} not refused"
+            if lib.pt_rx_ring_commit(h, n_planes + 3) != -errno.EINVAL:
+                return "stray-index commit not refused"
+            out = np.zeros(4, np.uint64)
+            if lib.pt_rx_ring_stats(h, out) != 0:
+                return "pt_rx_ring_stats failed"
+            got_stats = tuple(int(v) for v in out)
+            # The refused commits above must not count.
+            want_stats = model.stats()
+            if got_stats != want_stats:
+                return f"stats {got_stats} != model {want_stats}"
+            # Drain the rest so destroy frees immediately (leak check).
+            for plane in queue:
+                lib.pt_rx_ring_commit(h, plane)
+            return None
+        finally:
+            lib.pt_rx_ring_destroy(h)
+
+    seen: Set[str] = set()
+    for schedule in _ring_schedules(n_leases, n_commits):
+        err = run_schedule(schedule)
+        if err is not None:
+            msg = (
+                f"[rxring lease/commit vs pump] schedule "
+                f"[{' '.join(schedule)}] diverges from the model: {err}"
+            )
+            if msg not in seen:
+                seen.add(msg)
+                findings.append(Finding("PTA004", *site, msg))
+            if len(seen) >= 3:
+                break
+    return findings
+
+
+# ===========================================================================
 # Pass 4 — PTA005: effects-table completeness.
 
 _ARGTYPES_RE = re.compile(r"lib\.(pt_\w+)\.argtypes")
@@ -1443,6 +1595,7 @@ def check_effects_table(ob: AbiObligation, lib=None) -> List[Finding]:
 
 _CHECKS: Dict[str, Callable] = {
     "fold_conformance": check_fold_conformance,
+    "rxring_interleavings": check_rxring_interleavings,
     "classify_conformance": check_classify_conformance,
     "hls_interleavings": check_hls_interleavings,
     "effects_table": check_effects_table,

@@ -1392,6 +1392,179 @@ def _model_delta_roundtrip(
     return findings
 
 
+def _model_raw_ingest(
+    root: ProveRoot, fn: Callable, site: Tuple[str, int]
+) -> List[Finding]:
+    """Device-resident ingest kernel (ops/ingest.py decode_fold_raw):
+    the raw-plane decode+fold dispatch checked against the python wire
+    decoder + reference join over real dv2 datagram bytes — PTP002
+    packet-order commutativity AND bit-agreement with the decoder,
+    PTP003 duplicated-plane idempotence plus strict all-or-nothing
+    corruption rejection (verdicts must match wire.decode_delta_packet
+    on every truncation and byte flip, and a rejected packet must merge
+    NOTHING), PTP004 join monotonicity over a pre-seeded state."""
+    import jax.numpy as jnp
+
+    from patrol_tpu.models.limiter import LimiterConfig, init_state
+    from patrol_tpu.ops import ingest as ingest_ops
+    from patrol_tpu.ops import wire
+
+    findings: List[Finding] = []
+
+    def bad(code: str, msg: str) -> None:
+        findings.append(Finding(code, *site, f"[{root.name}] {msg}"))
+
+    B, N = 8, 2
+    cfg = LimiterConfig(buckets=B, nodes=N)
+    ROW = 512
+    E = ingest_ops.max_entries(ROW)
+    names = ["a", "b", "", "bucket-µ"]
+    name_rows = {nm: i for i, nm in enumerate(names)}
+    big = (1 << 62) + 5
+    ents = [
+        wire.DeltaEntry(nm, s, c, a, t, e)
+        for nm in names
+        for s in (0, 1)
+        for c, a, t, e in ((0, 0, 0, 0), (3, 1, 2, big), (5, big, 4, 1))
+    ]
+    pkts: List[bytes] = []
+    i = 0
+    while i < len(ents):
+        data, k = wire.encode_delta_packet(
+            1, len(pkts) + 1, (7,), ents[i:], max_size=ROW
+        )
+        pkts.append(data)
+        i += k
+
+    def planes_of(packets):
+        pl = np.full((len(packets), ROW), 0xA5, np.uint8)  # stale tails
+        ln = np.zeros(len(packets), np.int32)
+        for j, b in enumerate(packets):
+            pl[j, : len(b)] = np.frombuffer(b, np.uint8)
+            ln[j] = len(b)
+        return pl, ln
+
+    def rows_of(packets):
+        rws = np.full((len(packets), E), 10**9, np.int32)
+        for j, b in enumerate(packets):
+            pk = wire.decode_delta_packet(b)
+            if pk is None:
+                continue
+            for k, e in enumerate(pk.entries):
+                rws[j, k] = name_rows.get(e.name, 10**9)
+        return rws
+
+    def run(packets, state=None):
+        pl, ln = planes_of(packets)
+        rws = rows_of(packets)
+        walk = ingest_ops.host_walk(pl, ln)
+        eoff = np.maximum(walk.name_off - 1, 0)
+        st = init_state(cfg) if state is None else state
+        out = fn(
+            st, jnp.asarray(pl), jnp.asarray(ln), jnp.asarray(eoff),
+            jnp.asarray(rws),
+            jnp.asarray(np.zeros((len(packets), E), bool)),
+        )
+        return (
+            (np.asarray(out[0].pn), np.asarray(out[0].elapsed)),
+            np.asarray(out[1]),
+        )
+
+    def eq(a, b) -> bool:
+        return np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+
+    base, ok = run(pkts)
+    ref_pn = np.zeros((B, N, 2), np.int64)
+    ref_el = np.zeros(B, np.int64)
+    for b in pkts:
+        pk = wire.decode_delta_packet(b)
+        for e in pk.entries:
+            r = name_rows[e.name]
+            if e.slot >= N:
+                continue
+            ref_pn[r, e.slot, 0] = max(ref_pn[r, e.slot, 0], e.added_nt)
+            ref_pn[r, e.slot, 1] = max(ref_pn[r, e.slot, 1], e.taken_nt)
+            ref_el[r] = max(ref_el[r], max(e.elapsed_ns, 0))
+    if "PTP002" in root.obligations:
+        if not ok.all():
+            bad("PTP002", "legal delta-interval planes rejected by the verdict")
+        if not eq(base, (ref_pn, ref_el)):
+            bad(
+                "PTP002",
+                "raw-plane decode+fold disagrees with the python decoder + "
+                "reference join on the same datagram bytes",
+            )
+        rev, _ = run(pkts[::-1])
+        if not eq(rev, base):
+            bad(
+                "PTP002",
+                "raw ingest is packet-order dependent: reversed plane order "
+                "produced a different state",
+            )
+    if "PTP003" in root.obligations:
+        dup, _ = run(pkts + pkts)
+        if not eq(dup, base):
+            bad("PTP003", "raw ingest is not idempotent under duplicated planes")
+        # Corruption sweep: the kernel's verdicts must match the python
+        # decoder's on every truncation and byte flip of a real packet,
+        # and rejected planes must merge NOTHING (one batch per sweep).
+        probe = pkts[0]
+        variants = [probe[:j] for j in range(len(probe))]
+        variants += [
+            bytes(probe[:j]) + bytes([probe[j] ^ 0x41]) + bytes(probe[j + 1:])
+            for j in range(len(probe))
+        ]
+        want = np.array(
+            [wire.decode_delta_packet(v) is not None for v in variants]
+        )
+        got_state, got_ok = run(variants)
+        if not np.array_equal(got_ok, want):
+            j = _first_bad(got_ok == want)
+            bad(
+                "PTP003",
+                f"verdict diverges from wire.decode_delta_packet on hostile "
+                f"variant {j} (truncation/flip sweep): all-or-nothing "
+                "validation is the replica-fork guard",
+            )
+        # Every surviving variant carries probe's own entries (absolute
+        # values ⇒ idempotent); rejected ones contribute nothing — so the
+        # fold must equal the accepted-subset reference.
+        sub_pn = np.zeros((B, N, 2), np.int64)
+        sub_el = np.zeros(B, np.int64)
+        for v in variants:
+            pk = wire.decode_delta_packet(v)
+            if pk is None:
+                continue
+            for e in pk.entries:
+                r = name_rows[e.name]
+                if e.slot >= N:
+                    continue
+                sub_pn[r, e.slot, 0] = max(sub_pn[r, e.slot, 0], e.added_nt)
+                sub_pn[r, e.slot, 1] = max(sub_pn[r, e.slot, 1], e.taken_nt)
+                sub_el[r] = max(sub_el[r], max(e.elapsed_ns, 0))
+        if not eq(got_state, (sub_pn, sub_el)):
+            bad(
+                "PTP003",
+                "a rejected (or corrupted) plane leaked values into state: "
+                "invalid packets must merge nothing",
+            )
+    if "PTP004" in root.obligations:
+        from patrol_tpu.models.limiter import LimiterState
+
+        seed_pn = np.zeros((B, N, 2), np.int64)
+        seed_pn[:4, :, :] = 2
+        seed_el = np.full(B, 3, np.int64)
+        seeded = LimiterState(
+            pn=jnp.asarray(seed_pn), elapsed=jnp.asarray(seed_el)
+        )
+        grown, _ = run(pkts, state=seeded)
+        if not (
+            (grown[0] >= seed_pn).all() and (grown[1] >= seed_el).all()
+        ):
+            bad("PTP004", "raw ingest shrank a state plane: join must be monotone")
+    return findings
+
+
 _MODELS: Dict[str, Callable] = {
     "dense_join": _model_dense_join,
     "tree_converge": _model_tree_converge,
@@ -1402,6 +1575,7 @@ _MODELS: Dict[str, Callable] = {
     "wire_roundtrip": _model_wire_roundtrip,
     "delta_roundtrip": _model_delta_roundtrip,
     "pallas_interpret": _model_pallas_interpret,
+    "raw_ingest": _model_raw_ingest,
 }
 # "join_batch:<adapter>" tags dispatch through the adapter registry the
 # obligations module fills in (the batch constructors live with the

@@ -640,6 +640,9 @@ RACE_FILES: Tuple[str, ...] = (
     "patrol_tpu/net/delta.py",
     "patrol_tpu/net/antientropy.py",
     "patrol_tpu/net/audit.py",
+    # Zero-copy rx ring (device-resident ingest): the lease/commit
+    # bookkeeping spans the rx thread and the engine completer.
+    "patrol_tpu/native/__init__.py",
 )
 
 # Additional files scanned for the lock graph (native-mutex call sites
@@ -728,6 +731,10 @@ GUARDS: Dict[str, Dict[str, Dict[str, Guard]]] = {
             "_dirty": Guard("_mu", "rw"),
             "_peers": Guard("_mu", "rw"),
             "_tick": Guard("_mu", "rw"),
+            # Raw-ingest plane pool: leased on the rx thread, recycled by
+            # the engine completer's release callback — its own leaf lock
+            # (never nested with _mu or any engine lock).
+            "_raw_free": Guard("_raw_mu", "rw"),
         },
     },
     "patrol_tpu/net/antientropy.py": {
@@ -738,6 +745,16 @@ GUARDS: Dict[str, Dict[str, Dict[str, Guard]]] = {
             "_last_trigger": Guard("_mu", "rw"),
             "_worker": Guard("_mu", "mutate"),
             "_stopped": Guard("_mu", "mutate"),
+        },
+    },
+    # Zero-copy rx ring: the lease set mutates on the rx thread (lease)
+    # and the engine completer (commit callback); the native free-list is
+    # the authority, this mirror is observability/teardown — still
+    # lock-disciplined like everything shared.
+    "patrol_tpu/native/__init__.py": {
+        "RxRing": {
+            "_leased": Guard("_mu", "rw"),
+            "_closed": Guard("_mu", "rw"),
         },
     },
     # patrol-audit plane: the window store + divergence gauges mutate on
@@ -816,6 +833,16 @@ RETAINED_BUFFERS: Dict[str, Dict[str, Dict[str, str]]] = {
             "cap_base_nt": "pt_hls_create",
             "created_ns": "pt_hls_create",
             "last_used_ns": "pt_hls_create",
+        },
+    },
+    # The rx ring inverts the usual borrow: the .so OWNS the page-aligned
+    # planes and Python's ``_views`` alias that memory zero-copy until
+    # pt_rx_ring_destroy. Rebinding the views outside __init__ (or
+    # destroying while a lease is out — the C side defers for that) is
+    # the same use-after-recycle class, so the registry pins them.
+    "patrol_tpu/native/__init__.py": {
+        "RxRing": {
+            "_views": "pt_rx_ring_create",
         },
     },
 }

@@ -80,6 +80,23 @@ NATIVE_EFFECTS: Dict[str, NativeEffect] = {
     "pt_send_fanout": _E(True, False, False, False),  # POLLOUT stall wait
     "pt_decode_batch": _E(False, False, False, True),
     "pt_encode_batch": _E(False, False, False, True),
+    # -- zero-copy rx ring (device-resident ingest) --
+    # pt_rx_ring_create allocates C++-OWNED page-aligned planes that
+    # Python views zero-copy via pt_rx_ring_plane until destroy: the
+    # inverse of the usual borrow, declared owns_buffers so the
+    # ownership pass tracks the retained-memory lifetime — rebinding or
+    # freeing while the engine's H2D still reads a leased plane is the
+    # use-after-recycle class (destroy therefore DEFERS while any plane
+    # is leased; the last commit frees).
+    "pt_rx_ring_create": _E(
+        False, False, False, False,
+        owns_buffers=True, borrows_until="pt_rx_ring_destroy",
+    ),
+    "pt_rx_ring_plane": _E(False, False, False, False),
+    "pt_rx_ring_lease": _E(False, False, False, False),   # leaf mutex
+    "pt_rx_ring_commit": _E(False, False, False, False),  # leaf mutex
+    "pt_rx_ring_stats": _E(False, False, False, False),
+    "pt_rx_ring_destroy": _E(False, False, False, False),
     # -- directory / rx fast path --
     # pt_dir_create RETAINS name_bytes/name_len: the C++ directory
     # verifies hash hits against those rows through the stored pointers
@@ -212,6 +229,20 @@ def load() -> Optional[ctypes.CDLL]:
             _u16p, ctypes.c_int,
         ]
         lib.pt_send_fanout.restype = ctypes.c_int
+        lib.pt_rx_ring_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.pt_rx_ring_create.restype = ctypes.c_int
+        lib.pt_rx_ring_plane.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.pt_rx_ring_plane.restype = ctypes.c_int64
+        lib.pt_rx_ring_lease.argtypes = [ctypes.c_int]
+        lib.pt_rx_ring_lease.restype = ctypes.c_int
+        lib.pt_rx_ring_commit.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.pt_rx_ring_commit.restype = ctypes.c_int
+        lib.pt_rx_ring_stats.argtypes = [ctypes.c_int, _u64p]
+        lib.pt_rx_ring_stats.restype = ctypes.c_int
+        lib.pt_rx_ring_destroy.argtypes = [ctypes.c_int]
+        lib.pt_rx_ring_destroy.restype = ctypes.c_int
         lib.pt_decode_batch.argtypes = [
             _u8p, _i32p, ctypes.c_int, ctypes.c_int, _f64p, _f64p, _u64p,
             _u8p, _i32p, _i32p, _i64p, _i64p, _i64p, _u64p, _i32p,
@@ -369,14 +400,20 @@ class NativeSocket:
 
     def recv_batch(self, timeout_ms: int = 100):
         """→ (packets[n,row] uint8 view, sizes[n], src_ips[n], src_ports[n])."""
+        return self.recv_batch_into(self._rx_buf, timeout_ms)
+
+    def recv_batch_into(self, buf: np.ndarray, timeout_ms: int = 100):
+        """recvmmsg directly into ``buf`` (uint8[max_batch, row] — an rx
+        ring plane for the zero-copy ingest path, or the socket's own
+        staging buffer). Same return shape as :meth:`recv_batch`."""
         n = self.lib.pt_recv_batch(
-            self.fd, self._rx_buf, self.max_batch, self.row, self._rx_sizes,
-            self._rx_ips, self._rx_ports, timeout_ms,
+            self.fd, buf, min(self.max_batch, len(buf)), buf.shape[1],
+            self._rx_sizes, self._rx_ips, self._rx_ports, timeout_ms,
         )
         if n < 0:
             raise OSError(-n, os.strerror(-n))
         return (
-            self._rx_buf[:n],
+            buf[:n],
             self._rx_sizes[:n],
             self._rx_ips[:n],
             self._rx_ports[:n],
@@ -403,6 +440,87 @@ class NativeSocket:
 
     def close(self) -> None:
         self.lib.pt_udp_close(self.fd)
+
+
+class RxRing:
+    """Zero-copy rx ring (device-resident ingest): C++-owned page-aligned
+    byte planes the recvmmsg loop fills directly and Python views without
+    copying (``plane()``), shipped to the device with ``jax.device_put``
+    and recycled via lease/commit. The rx thread LEASES before receiving;
+    the engine's completion pipeline COMMITS once the shipped operand is
+    ready — until then the plane bytes are pinned by contract (the C side
+    refuses to free them: destroy defers while leased). Python-side
+    bookkeeping (``_leased``) mirrors the native free-list under ``_mu``
+    for observability and teardown sanity, registered in
+    analysis/race.py::GUARDS like every other shared-state discipline."""
+
+    def __init__(self, n_planes: int = 4, max_batch: int = 512,
+                 row: int = RX_RING_ROW):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self.lib = lib
+        self.n_planes = n_planes
+        self.max_batch = max_batch
+        self.row = row
+        h = lib.pt_rx_ring_create(n_planes, max_batch, row)
+        if h < 0:
+            raise OSError(-h, os.strerror(-h))
+        self.h = h
+        self._mu = threading.Lock()
+        self._leased: set = set()
+        self._closed = False
+        self._views = []
+        size = max_batch * row
+        for i in range(n_planes):
+            ptr = lib.pt_rx_ring_plane(h, i)
+            buf = (ctypes.c_uint8 * size).from_address(ptr)
+            self._views.append(
+                np.ctypeslib.as_array(buf).reshape(max_batch, row)
+            )
+
+    def lease(self) -> Optional[int]:
+        """→ plane index, or None when every plane is in flight (the
+        caller falls back to its copying path for this batch)."""
+        idx = self.lib.pt_rx_ring_lease(self.h)
+        if idx < 0:
+            return None
+        with self._mu:
+            self._leased.add(idx)
+        return idx
+
+    def plane(self, idx: int) -> np.ndarray:
+        """Zero-copy numpy view of one plane (valid until close)."""
+        return self._views[idx]
+
+    def commit(self, idx: int) -> None:
+        """Return a leased plane (engine completion callback — may run
+        on any thread)."""
+        with self._mu:
+            self._leased.discard(idx)
+        self.lib.pt_rx_ring_commit(self.h, idx)
+
+    def stats(self) -> dict:
+        out = np.zeros(4, np.uint64)
+        if self.lib.pt_rx_ring_stats(self.h, out) < 0:
+            return {}
+        return {
+            "rx_ring_leases": int(out[0]),
+            "rx_ring_commits": int(out[1]),
+            "rx_ring_lease_reuse": int(out[2]),
+            "rx_ring_exhausted": int(out[3]),
+        }
+
+    def close(self) -> None:
+        """Destroy (deferred natively while planes are leased — an
+        in-flight H2D can never read freed memory). The numpy views are
+        invalid once the last lease commits; callers stop reading them
+        before close."""
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
+        self.lib.pt_rx_ring_destroy(self.h)
 
 
 class DecodeBuffers:

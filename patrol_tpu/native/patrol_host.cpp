@@ -22,6 +22,7 @@
 #include <cerrno>
 #include <cmath>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <fcntl.h>
 #include <netinet/in.h>
@@ -227,6 +228,169 @@ int pt_send_fanout(int fd, const uint8_t* payloads, const int* sizes,
   int rc = flush();
   if (rc < 0) return rc;
   return sent_total;
+}
+
+// ---------------------------------------------------------------- rx ring
+//
+// Device-resident ingest (ops/ingest.py): the recvmmsg loop writes
+// datagrams DIRECTLY into reusable page-aligned byte planes that Python
+// views zero-copy (pt_rx_ring_plane) and ships with jax.device_put —
+// no intermediate numpy copy between the wire and the H2D transfer.
+// Lease/commit is the ownership protocol: the rx thread LEASES a plane
+// before receiving into it, hands the filled plane to the engine, and
+// the engine's completion pipeline COMMITS it back once the shipped
+// operand is ready (the StagingPool contract). The mutex serializes
+// lease/commit across those two threads; planes are C++-owned
+// (posix_memalign, page boundaries — the pinned-allocation seam a real
+// accelerator transport would mlock/host-register) and freed only at
+// destroy, which defers while any plane is still leased so an in-flight
+// transfer can never read freed memory.
+
+namespace {
+
+struct PtRxRing {
+  std::mutex mu;
+  int n_planes = 0;
+  int max_batch = 0;
+  int row = 0;
+  std::vector<uint8_t*> planes;
+  std::vector<uint8_t> leased;
+  std::vector<uint8_t> used;  // plane saw a prior lease (reuse counter)
+  uint64_t leases = 0, commits = 0, reuse = 0, exhausted = 0;
+  bool closing = false;
+};
+
+PtRxRing* g_rings[16] = {nullptr};
+std::mutex g_ring_mu;
+
+void ptring_free(PtRxRing* r) {
+  for (uint8_t* p : r->planes) std::free(p);
+  delete r;
+}
+
+}  // namespace
+
+// Allocate a ring of n_planes page-aligned planes, each max_batch rows
+// of row_stride bytes. Returns handle or -errno.
+int pt_rx_ring_create(int n_planes, int max_batch, int row_stride) {
+  if (n_planes <= 0 || n_planes > 64 || max_batch <= 0 ||
+      max_batch > kMaxBatch || row_stride < kPacketSize)
+    return -EINVAL;
+  std::lock_guard<std::mutex> reg(g_ring_mu);
+  int h = -1;
+  for (int i = 0; i < 16; i++)
+    if (!g_rings[i]) {
+      h = i;
+      break;
+    }
+  if (h < 0) return -EMFILE;
+  PtRxRing* r = new PtRxRing();
+  r->n_planes = n_planes;
+  r->max_batch = max_batch;
+  r->row = row_stride;
+  size_t bytes = static_cast<size_t>(max_batch) * row_stride;
+  for (int i = 0; i < n_planes; i++) {
+    void* p = nullptr;
+    if (posix_memalign(&p, 4096, bytes) != 0) {
+      ptring_free(r);
+      return -ENOMEM;
+    }
+    std::memset(p, 0, bytes);
+    r->planes.push_back(static_cast<uint8_t*>(p));
+  }
+  r->leased.assign(n_planes, 0);
+  r->used.assign(n_planes, 0);
+  g_rings[h] = r;
+  return h;
+}
+
+// Base address of one plane (Python builds a zero-copy numpy view).
+int64_t pt_rx_ring_plane(int h, int plane) {
+  PtRxRing* r = (h >= 0 && h < 16) ? g_rings[h] : nullptr;
+  if (!r || plane < 0 || plane >= r->n_planes) return 0;
+  return reinterpret_cast<int64_t>(r->planes[plane]);
+}
+
+// Lease the lowest free plane (deterministic — the abi schedule
+// explorer's model relies on it). Returns plane index, or -EAGAIN when
+// every plane is in flight (caller falls back / retries next batch).
+int pt_rx_ring_lease(int h) {
+  PtRxRing* r = (h >= 0 && h < 16) ? g_rings[h] : nullptr;
+  if (!r) return -EBADF;
+  std::lock_guard<std::mutex> lk(r->mu);
+  if (r->closing) return -EBADF;
+  for (int i = 0; i < r->n_planes; i++) {
+    if (!r->leased[i]) {
+      r->leased[i] = 1;
+      r->leases++;
+      if (r->used[i]) r->reuse++;
+      r->used[i] = 1;
+      return i;
+    }
+  }
+  r->exhausted++;
+  return -EAGAIN;
+}
+
+// Return a leased plane to the free set. -EINVAL on a plane that was
+// never leased (double-commit / stray index — the ownership bug class
+// the PTA004 schedule scenario drives). Frees the ring when a deferred
+// destroy is pending and this was the last outstanding lease.
+int pt_rx_ring_commit(int h, int plane) {
+  std::lock_guard<std::mutex> reg(g_ring_mu);
+  PtRxRing* r = (h >= 0 && h < 16) ? g_rings[h] : nullptr;
+  if (!r) return -EBADF;
+  bool free_now = false;
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    if (plane < 0 || plane >= r->n_planes || !r->leased[plane])
+      return -EINVAL;
+    r->leased[plane] = 0;
+    r->commits++;
+    if (r->closing) {
+      free_now = true;
+      for (int i = 0; i < r->n_planes; i++)
+        if (r->leased[i]) free_now = false;
+    }
+  }
+  if (free_now) {
+    g_rings[h] = nullptr;
+    ptring_free(r);
+  }
+  return 0;
+}
+
+// leases, commits, reuse, exhausted — observability (rx_ring_* counters).
+int pt_rx_ring_stats(int h, uint64_t* out4) {
+  PtRxRing* r = (h >= 0 && h < 16) ? g_rings[h] : nullptr;
+  if (!r) return -EBADF;
+  std::lock_guard<std::mutex> lk(r->mu);
+  out4[0] = r->leases;
+  out4[1] = r->commits;
+  out4[2] = r->reuse;
+  out4[3] = r->exhausted;
+  return 0;
+}
+
+// Destroy: immediate when no plane is leased; otherwise DEFERRED — the
+// ring is marked closing (no new leases) and the last commit frees it,
+// so an in-flight H2D transfer can never read freed plane memory.
+int pt_rx_ring_destroy(int h) {
+  std::lock_guard<std::mutex> reg(g_ring_mu);
+  PtRxRing* r = (h >= 0 && h < 16) ? g_rings[h] : nullptr;
+  if (!r) return -EBADF;
+  bool free_now = true;
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closing = true;
+    for (int i = 0; i < r->n_planes; i++)
+      if (r->leased[i]) free_now = false;
+  }
+  if (free_now) {
+    g_rings[h] = nullptr;
+    ptring_free(r);
+  }
+  return 0;
 }
 
 // ------------------------------------------------------------------ codec

@@ -54,6 +54,8 @@ import time
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from patrol_tpu.ops import wire
 from patrol_tpu.utils import histogram as hist
 from patrol_tpu.utils import profiling
@@ -73,6 +75,15 @@ _ADVERT_PAYLOAD = struct.Struct(">I")
 # whose advert we have not (yet) seen: every backend can receive at least
 # the v1 packet size.
 MIN_DELTA_MTU = wire.PACKET_SIZE
+
+# Device-resident ingest (ops/ingest.py; ROADMAP item 1): when the
+# engine supports it, rx delta datagrams ship as RAW BYTE PLANES into
+# one decode+fold dispatch (engine.ingest_raw_planes) instead of the
+# per-datagram python decode + delta_fold two-step. The plane keeps the
+# header/ack bookkeeping host-side (a vectorized structure walk shared
+# with the engine's directory pass); entries never touch python. 0
+# restores the python decode path everywhere.
+RAW_INGEST = os.environ.get("PATROL_RAW_INGEST", "1") != "0"
 
 
 def _env_float(name: str, default: float) -> float:
@@ -151,6 +162,14 @@ class DeltaPlane:
         # (name, slot) -> wire.DeltaEntry: newest join-decomposition wins.
         self._dirty: Dict[Tuple[str, int], wire.DeltaEntry] = {}
         self._peers: Dict[Addr, _PeerDelta] = {}
+        # Raw-ingest plane pool (asyncio backend / P=1 packets): reusable
+        # [1, DELTA_PACKET_SIZE] byte planes filled per datagram and
+        # recycled once the engine's H2D transfer is ready — the same
+        # planes-per-batch shape the native rx ring feeds, slower but
+        # path-identical. Free list under its own leaf lock (the release
+        # callback runs on the engine completer thread).
+        self._raw_mu = threading.Lock()
+        self._raw_free: List["object"] = []
         self._tick = 0
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
@@ -444,9 +463,144 @@ class DeltaPlane:
 
     # -- rx ------------------------------------------------------------------
 
+    def raw_engine(self):
+        """The engine the raw-plane path dispatches to, or None: feature
+        off, no repo wired yet, or an engine that opts out (MeshEngine's
+        sharded planes). Callers fall back to the python decode path."""
+        if not RAW_INGEST:
+            return None
+        repo = getattr(self.rep, "repo", None)
+        eng = getattr(repo, "engine", None)
+        if eng is None or not getattr(eng, "_raw_ingest_capable", False):
+            return None
+        return eng
+
+    def _lease_raw_plane(self):
+        with self._raw_mu:
+            if self._raw_free:
+                profiling.COUNTERS.inc("rx_ring_lease_reuse")
+                return self._raw_free.pop()
+        return np.zeros((1, wire.DELTA_PACKET_SIZE), np.uint8)
+
+    def _release_raw_plane(self, plane) -> None:
+        with self._raw_mu:
+            if len(self._raw_free) < 8:
+                self._raw_free.append(plane)
+
+    def _on_packet_raw(self, eng, data: bytes, addr: Addr) -> bool:
+        """P=1 raw-plane ingest: the asyncio backend's half of the
+        device-resident path. Fills a pooled plane row (stale tail bytes
+        are masked by the walk/kernel length bounds — verified across the
+        hostile corpus) and runs the shared walk + dispatch."""
+        from patrol_tpu.ops import ingest as ingest_ops
+
+        t0 = time.perf_counter_ns()
+        plane = self._lease_raw_plane()
+        n = len(data)
+        plane[0, :n] = np.frombuffer(data, np.uint8)
+        lengths = np.array([n], np.int32)
+        walk = ingest_ops.host_walk(plane, lengths)
+        self._ingest_walk(
+            eng, plane, lengths, walk, [addr],
+            lambda: self._release_raw_plane(plane), t0,
+        )
+        return bool(walk.ok[0])
+
+    def on_raw_planes(
+        self, planes, lengths, addrs, release=None
+    ) -> bool:
+        """Batch raw-plane ingest — the native rx ring's entry: ``planes``
+        is the leased ring plane (uint8[P, row], shipped to the device
+        without an intermediate numpy copy), ``lengths`` carries each
+        row's datagram size with non-dv2 rows zeroed (they fail the
+        in-kernel verdict and cost only a verdict lane), ``addrs`` maps
+        rows to senders for the ack bookkeeping, and ``release`` commits
+        the ring plane back once the H2D transfer is ready. Returns False
+        when the engine can't take the raw path (caller falls back);
+        ``release`` is honored either way."""
+        eng = self.raw_engine()
+        if eng is None:
+            if release is not None:
+                release()
+            return False
+        from patrol_tpu.ops import ingest as ingest_ops
+
+        t0 = time.perf_counter_ns()
+        walk = ingest_ops.host_walk(planes, lengths)
+        self._ingest_walk(eng, planes, lengths, walk, addrs, release, t0)
+        return True
+
+    def _ingest_walk(
+        self, eng, planes, lengths, walk, addrs, release, t0_ns: int
+    ) -> None:
+        """Shared tail of the raw rx paths: per-packet header/ack
+        bookkeeping from the walk (the python decoder's exact counter
+        semantics), then ONE engine dispatch for the whole plane batch.
+        The walk rides into the engine so the directory pass never
+        re-walks the bytes."""
+        dur = time.perf_counter_ns() - t0_ns
+        hist.STAGE_RX_DECODE.record(dur)
+        tr = trace_mod.TRACE
+        if tr.enabled:
+            tr.record(
+                trace_mod.EV_RX_DECODE, dur, max(int(walk.count.sum()), 1)
+            )
+        max_slots = self.rep.slots.max_slots
+        data_live = False
+        with self._mu:
+            for i in range(len(lengths)):
+                if lengths[i] <= 0:
+                    continue  # non-dv2 ring row: not delta traffic
+                if not walk.ok[i]:
+                    self.rx_errors += 1
+                    continue
+                st = self._peer(addrs[i])
+                # A peer shipping deltas is v2-capable by demonstration.
+                st.capable = True
+                n_acks = int(walk.n_acks[i])
+                for k in range(n_acks):
+                    st.unacked.pop(int(walk.acks[i, k]), None)
+                if n_acks and tr.enabled:
+                    tr.record(trace_mod.EV_DELTA_ACK, 0, n_acks)
+                if walk.seq[i]:
+                    st.pending_acks.append(int(walk.seq[i]))
+                cnt = int(walk.count[i])
+                if cnt:
+                    st.last_rx_data_ns = time.perf_counter_ns()
+                    data_live = True
+                self.rx_packets += 1
+                self.rx_deltas += cnt
+                # Python-path parity for the per-entry error counter:
+                # out-of-range slots and control-channel names are
+                # counted (and never folded — the engine's entry filter
+                # sentinels them out of the dispatch).
+                if cnt:
+                    offs = walk.name_off[i, :cnt].astype(np.int64)
+                    first = np.asarray(planes)[
+                        i, np.clip(offs, 0, np.asarray(planes).shape[1] - 1)
+                    ]
+                    ctrl = (walk.name_len[i, :cnt] > 0) & (first == 0)
+                    bad = int(
+                        ((walk.slot[i, :cnt] >= max_slots) | ctrl).sum()
+                    )
+                    self.rx_errors += bad
+        # Acking needs a pacing tick even on nodes that ship no deltas.
+        self.start()
+        if data_live:
+            eng.ingest_raw_planes(planes, lengths, walk=walk, release=release)
+            hist.RX_APPLY.record(time.perf_counter_ns() - t0_ns)
+        elif release is not None:
+            release()
+
     def on_packet(self, data: bytes, addr: Addr) -> bool:
         """Decode + ingest one delta datagram. False ⇒ malformed (counted;
-        the caller's generic rx error accounting need not double-count)."""
+        the caller's generic rx error accounting need not double-count).
+        When the engine supports device-resident ingest the datagram
+        ships as a raw byte plane (ops/ingest.py) instead of through the
+        python decoder — same verdicts, same counters, one dispatch."""
+        eng = self.raw_engine()
+        if eng is not None and len(data) <= wire.DELTA_PACKET_SIZE:
+            return self._on_packet_raw(eng, data, addr)
         t0 = time.perf_counter_ns()
         pkt = wire.decode_delta_packet(data)
         if pkt is None:

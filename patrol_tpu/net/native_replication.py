@@ -26,6 +26,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from patrol_tpu import native
+from patrol_tpu.ops import ingest as ingest_ops
 from patrol_tpu.ops import wire
 from patrol_tpu.net.replication import (
     CTRL_PREFIX,
@@ -151,6 +152,25 @@ class NativeReplicator:
         # slices) before queueing, and this thread is their only writer.
         self._slots_staging = np.empty(1024, np.int64)
         self._nt_staging = np.empty(1024, bool)
+        # Reused decode output buffers (pt_decode_batch), one per rx loop.
+        self._dbuf: "native.DecodeBuffers | None" = None
+        # Zero-copy rx ring (device-resident ingest, ops/ingest.py): the
+        # recvmmsg loop receives straight into C++-owned page-aligned
+        # planes, dv2 rows ship to the device from the SAME memory (no
+        # intermediate numpy copy), and the engine's completion pipeline
+        # commits each plane back once its H2D transfer is ready. Ring
+        # exhaustion (every plane in a still-shipping batch) falls back
+        # to the socket's own staging buffer for that batch.
+        self._rx_ring = None
+        from patrol_tpu.net.delta import RAW_INGEST
+
+        if RAW_INGEST:
+            try:
+                self._rx_ring = native.RxRing(
+                    n_planes=4, max_batch=512, row=native.RX_RING_ROW
+                )
+            except (OSError, RuntimeError):  # pragma: no cover - no lib
+                self._rx_ring = None
         self._rx_thread = threading.Thread(
             target=self._rx_loop, name="patrol-native-rx", daemon=True
         )
@@ -175,167 +195,249 @@ class NativeReplicator:
     # -- receive path -------------------------------------------------------
 
     def _rx_loop(self) -> None:
-        dbuf: Optional[native.DecodeBuffers] = None
         while not self._stopped.is_set():
+            # Zero-copy ingest: receive straight into a leased ring plane
+            # (committed back by the engine's completion pipeline once
+            # the dv2 H2D transfer is ready); exhaustion or chaos mode
+            # falls back to the socket's own staging buffer.
+            ring = self._rx_ring
+            lease = None
+            if ring is not None and self.faultnet is None:
+                lease = ring.lease()
             try:
-                packets, sizes, ips, ports = self.sock.recv_batch(timeout_ms=100)
+                if lease is not None:
+                    packets, sizes, ips, ports = self.sock.recv_batch_into(
+                        ring.plane(lease), timeout_ms=100
+                    )
+                else:
+                    packets, sizes, ips, ports = self.sock.recv_batch(
+                        timeout_ms=100
+                    )
             except OSError as exc:
+                if lease is not None:
+                    ring.commit(lease)
                 if self._stopped.is_set():
                     return
                 self.log.warning("recv failed: %s", exc)
                 continue
-            n = len(packets)
-            fn = self.faultnet
-            if fn is not None:
-                # Chaos mode: per-packet python ingestion so every fault
-                # primitive (dup/reorder/delay release) applies exactly as
-                # on the asyncio backend. Throughput is not the point here.
-                for data, addr in fn.due():
-                    self._ingest_py(data, addr)
-                for i in range(n):
-                    addr = (_u32_to_ip(int(ips[i])), int(ports[i]))
-                    for payload in fn.filter(bytes(packets[i][: sizes[i]]), addr):
-                        self._ingest_py(payload, addr)
-                self._health_tick()
-                continue
-            if n == 0:
-                self._health_tick()
-                continue
-            self.rx_packets += n
-            # Fully vectorized wire→engine: batch C++ decode into reused
-            # buffers, resolve buckets through the directory's hash table —
-            # a Python string is materialized only for incast requests and
-            # first-seen bucket names (engine.ingest_deltas_batch_raw).
-            t_batch0 = time.perf_counter_ns()
-            dbuf, _ = native.decode_batch_raw(packets, sizes, dbuf)
-            dur = time.perf_counter_ns() - t_batch0
-            # One observation per rx BATCH (the C++ decode is the unit of
-            # work here, not the packet); arg carries the batch size.
-            hist.STAGE_RX_DECODE.record(dur)
-            tr = trace_mod.TRACE
-            if tr.enabled:
-                tr.record(trace_mod.EV_RX_DECODE, dur, n)
-            valid = dbuf.name_lens[:n] >= 0
-            self.rx_errors += int(n - valid.sum())
-            live = valid.copy()
-            # Peers are few: address-keyed decisions (fault injection,
-            # v1 slot resolution) run per unique address, not per packet.
-            addr_key = (ips.astype(np.uint64) << np.uint64(16)) | ports.astype(
-                np.uint64
-            )
-            if self.drop_addr is not None and live.any():
-                for k in np.unique(addr_key[live]):
-                    addr = (_u32_to_ip(int(k) >> 16), int(k) & 0xFFFF)
-                    if self.drop_addr(addr):
-                        live &= addr_key != k
-            if live.any():
-                # Liveness per unique sender; a quiet→alive transition
-                # triggers the heal-time anti-entropy exchange.
-                for k in np.unique(addr_key[live]):
-                    addr = (_u32_to_ip(int(k) >> 16), int(k) & 0xFFFF)
-                    healed = self.health.on_rx(addr)
-                    if healed is not None:
-                        self.antientropy.trigger(healed)
-                        self.delta.on_peer_heal(healed)
-            # Incast requests (zero-state packets, repo.go:86-90).
-            inc = (
-                live
-                & (dbuf.added[:n] == 0)
-                & (dbuf.taken[:n] == 0)
-                & (dbuf.elapsed[:n] == 0)
-            )
-            # Multi-lane trailers (compact incast replies): the flat batch
-            # decode surfaces only slot+cap for them — re-decode the few
-            # such packets (cold-start only) through the Python codec.
-            multi2 = live & ~inc & (dbuf.multi[:n] == 2)
-            deltas = live & ~inc & ~multi2
-            # Slot resolution: a valid trailer carries the slot; otherwise
-            # (v1 reference peer) resolve by sender address — per unique
-            # address, peers are few. Unresolvable ⇒ dropped (slot −1).
-            # Both planes live in reused staging, not fresh arrays: the
-            # engine hands copies to its queue, never these views.
-            slots = self._stage_slots(n, dbuf.slots)
-            no_trailer = np.less(slots, 0, out=self._nt_staging[:n])
-            need = deltas & (
-                no_trailer | (slots >= self.slots.max_slots)
-            )
-            if need.any():
-                for k in np.unique(addr_key[need]):
-                    addr = (_u32_to_ip(int(k) >> 16), int(k) & 0xFFFF)
-                    resolved = self.slots.resolve(addr)
-                    sel = need & (addr_key == k)
-                    slots[sel] = -1 if resolved is None else resolved
-                unresolved = need & (slots < 0)
-                self.rx_errors += int(unresolved.sum())
-            slots[~deltas] = -1  # the classify keep-filter drops these
-            # Data paths need the repo wired; control-channel handling
-            # below does not (parity with the asyncio backend, which
-            # dispatches control packets before its repo check).
-            if deltas.any() and self.repo is not None:
-                self.repo.engine.ingest_wire_batch(
-                    dbuf, n, slots, no_trailer.view(np.uint8)
+            committed = lease is None
+            try:
+                committed = self._rx_batch(
+                    packets, sizes, ips, ports, ring, lease
                 )
-                # rx→apply for the whole batch: decode start to engine
-                # queue handoff.
-                hist.RX_APPLY.record(time.perf_counter_ns() - t_batch0)
-            if multi2.any() and self.repo is not None:
-                for i in np.flatnonzero(multi2):
-                    st = wire.decode(bytes(packets[i][: sizes[i]]))
-                    if st.lanes is None:
-                        self.rx_errors += 1
-                        continue
-                    lanes = [l for l in st.lanes if l[0] < self.slots.max_slots]
-                    self.rx_errors += len(st.lanes) - len(lanes)
-                    if lanes:
-                        self.repo.engine.ingest_deltas_batch(
-                            [st.name] * len(lanes),
-                            [l[0] for l in lanes],
-                            [st.added_nt] * len(lanes),
-                            [st.taken_nt] * len(lanes),
-                            [max(st.elapsed_ns, 0)] * len(lanes),
-                            [st.cap_nt] * len(lanes),
-                            [l[1] for l in lanes],
-                            [l[2] for l in lanes],
-                        )
-            if inc.any():
-                incasts = []
-                for i in np.flatnonzero(inc):
-                    name = bytes(dbuf.names[i, : dbuf.name_lens[i]]).decode(
-                        "utf-8", "surrogateescape"
-                    )
-                    if name.startswith(CTRL_PREFIX):
-                        addr_i = (_u32_to_ip(int(ips[i])), int(ports[i]))
-                        if name == wire.DELTA_CHANNEL_NAME:
-                            # v2 delta interval: payload rides after the
-                            # reserved name in the raw datagram bytes.
-                            self.delta.on_packet(
-                                bytes(packets[i][: sizes[i]]), addr_i
-                            )
-                        elif name == wire.METRICS_CHANNEL_NAME:
-                            # patrol-fleet metrics gossip: same envelope.
-                            self.fleet.on_packet(
-                                bytes(packets[i][: sizes[i]]), addr_i
-                            )
-                        elif name == wire.AUDIT_CHANNEL_NAME:
-                            # patrol-audit digests + admitted windows.
-                            self.audit.on_packet(
-                                bytes(packets[i][: sizes[i]]), addr_i
-                            )
-                        else:
-                            # Probe pings / anti-entropy: never a bucket.
-                            self._handle_control(name, addr_i)
-                        continue
-                    incasts.append(
-                        (
-                            name,
-                            int(ips[i]),
-                            int(ports[i]),
-                            int(dbuf.multi[i]) >= 1,  # requester's multi advert
-                        )
-                    )
-                if incasts and self.repo is not None:
-                    self._reply_incasts(incasts)
+            finally:
+                if not committed and lease is not None:
+                    ring.commit(lease)
+
+    def _rx_batch(self, packets, sizes, ips, ports, ring, lease) -> bool:
+        """One recv batch. Returns True when the leased ring plane's
+        commit is already owned elsewhere (handed to the engine's
+        completion pipeline, or no lease was taken)."""
+        committed = lease is None
+        n = len(packets)
+        fn = self.faultnet
+        if fn is not None:
+            # Chaos mode: per-packet python ingestion so every fault
+            # primitive (dup/reorder/delay release) applies exactly as
+            # on the asyncio backend. Throughput is not the point here.
+            for data, addr in fn.due():
+                self._ingest_py(data, addr)
+            for i in range(n):
+                addr = (_u32_to_ip(int(ips[i])), int(ports[i]))
+                for payload in fn.filter(bytes(packets[i][: sizes[i]]), addr):
+                    self._ingest_py(payload, addr)
             self._health_tick()
+            return committed
+        if n == 0:
+            self._health_tick()
+            return committed
+        self.rx_packets += n
+        # Fully vectorized wire→engine: batch C++ decode into reused
+        # buffers, resolve buckets through the directory's hash table —
+        # a Python string is materialized only for incast requests and
+        # first-seen bucket names (engine.ingest_deltas_batch_raw).
+        t_batch0 = time.perf_counter_ns()
+        self._dbuf, _ = native.decode_batch_raw(packets, sizes, self._dbuf)
+        dbuf = self._dbuf
+        dur = time.perf_counter_ns() - t_batch0
+        # One observation per rx BATCH (the C++ decode is the unit of
+        # work here, not the packet); arg carries the batch size.
+        hist.STAGE_RX_DECODE.record(dur)
+        tr = trace_mod.TRACE
+        if tr.enabled:
+            tr.record(trace_mod.EV_RX_DECODE, dur, n)
+        valid = dbuf.name_lens[:n] >= 0
+        self.rx_errors += int(n - valid.sum())
+        live = valid.copy()
+        # Device-resident ingest: dv2 delta datagrams sitting in a leased
+        # ring plane ship to the device AS RAW BYTES (one decode+fold
+        # dispatch, ops/ingest.py) instead of the per-packet python
+        # decode the control-channel branch below would run. Decided up
+        # front so the classify masks can exclude them.
+        raw_dv2 = None
+        if lease is not None:
+            m = ingest_ops.dv2_mask(packets, sizes)
+            if m.any() and self.delta.raw_engine() is not None:
+                raw_dv2 = m
+        # Peers are few: address-keyed decisions (fault injection,
+        # v1 slot resolution) run per unique address, not per packet.
+        addr_key = (ips.astype(np.uint64) << np.uint64(16)) | ports.astype(
+            np.uint64
+        )
+        if self.drop_addr is not None and live.any():
+            for k in np.unique(addr_key[live]):
+                addr = (_u32_to_ip(int(k) >> 16), int(k) & 0xFFFF)
+                if self.drop_addr(addr):
+                    live &= addr_key != k
+        if live.any():
+            # Liveness per unique sender; a quiet→alive transition
+            # triggers the heal-time anti-entropy exchange.
+            for k in np.unique(addr_key[live]):
+                addr = (_u32_to_ip(int(k) >> 16), int(k) & 0xFFFF)
+                healed = self.health.on_rx(addr)
+                if healed is not None:
+                    self.antientropy.trigger(healed)
+                    self.delta.on_peer_heal(healed)
+        # Incast requests (zero-state packets, repo.go:86-90). dv2 rows
+        # decode as zero-state control packets; the raw path claims them
+        # out of the per-packet branch.
+        zero = (
+            live
+            & (dbuf.added[:n] == 0)
+            & (dbuf.taken[:n] == 0)
+            & (dbuf.elapsed[:n] == 0)
+        )
+        inc = zero if raw_dv2 is None else zero & ~raw_dv2
+        # Multi-lane trailers (compact incast replies): the flat batch
+        # decode surfaces only slot+cap for them — re-decode the few
+        # such packets (cold-start only) through the Python codec.
+        multi2 = live & ~zero & (dbuf.multi[:n] == 2)
+        deltas = live & ~zero & ~multi2
+        # Slot resolution: a valid trailer carries the slot; otherwise
+        # (v1 reference peer) resolve by sender address — per unique
+        # address, peers are few. Unresolvable ⇒ dropped (slot −1).
+        # Both planes live in reused staging, not fresh arrays: the
+        # engine hands copies to its queue, never these views.
+        slots = self._stage_slots(n, dbuf.slots)
+        no_trailer = np.less(slots, 0, out=self._nt_staging[:n])
+        need = deltas & (
+            no_trailer | (slots >= self.slots.max_slots)
+        )
+        if need.any():
+            for k in np.unique(addr_key[need]):
+                addr = (_u32_to_ip(int(k) >> 16), int(k) & 0xFFFF)
+                resolved = self.slots.resolve(addr)
+                sel = need & (addr_key == k)
+                slots[sel] = -1 if resolved is None else resolved
+            unresolved = need & (slots < 0)
+            self.rx_errors += int(unresolved.sum())
+        slots[~deltas] = -1  # the classify keep-filter drops these
+        # Data paths need the repo wired; control-channel handling
+        # below does not (parity with the asyncio backend, which
+        # dispatches control packets before its repo check).
+        if deltas.any() and self.repo is not None:
+            self.repo.engine.ingest_wire_batch(
+                dbuf, n, slots, no_trailer.view(np.uint8)
+            )
+            # rx→apply for the whole batch: decode start to engine
+            # queue handoff.
+            hist.RX_APPLY.record(time.perf_counter_ns() - t_batch0)
+        if multi2.any() and self.repo is not None:
+            for i in np.flatnonzero(multi2):
+                st = wire.decode(bytes(packets[i][: sizes[i]]))
+                if st.lanes is None:
+                    self.rx_errors += 1
+                    continue
+                lanes = [l for l in st.lanes if l[0] < self.slots.max_slots]
+                self.rx_errors += len(st.lanes) - len(lanes)
+                if lanes:
+                    self.repo.engine.ingest_deltas_batch(
+                        [st.name] * len(lanes),
+                        [l[0] for l in lanes],
+                        [st.added_nt] * len(lanes),
+                        [st.taken_nt] * len(lanes),
+                        [max(st.elapsed_ns, 0)] * len(lanes),
+                        [st.cap_nt] * len(lanes),
+                        [l[1] for l in lanes],
+                        [l[2] for l in lanes],
+                    )
+        if inc.any():
+            incasts = []
+            for i in np.flatnonzero(inc):
+                name = bytes(dbuf.names[i, : dbuf.name_lens[i]]).decode(
+                    "utf-8", "surrogateescape"
+                )
+                if name.startswith(CTRL_PREFIX):
+                    addr_i = (_u32_to_ip(int(ips[i])), int(ports[i]))
+                    if name == wire.DELTA_CHANNEL_NAME:
+                        # v2 delta interval: payload rides after the
+                        # reserved name in the raw datagram bytes.
+                        self.delta.on_packet(
+                            bytes(packets[i][: sizes[i]]), addr_i
+                        )
+                    elif name == wire.METRICS_CHANNEL_NAME:
+                        # patrol-fleet metrics gossip: same envelope.
+                        self.fleet.on_packet(
+                            bytes(packets[i][: sizes[i]]), addr_i
+                        )
+                    elif name == wire.AUDIT_CHANNEL_NAME:
+                        # patrol-audit digests + admitted windows.
+                        self.audit.on_packet(
+                            bytes(packets[i][: sizes[i]]), addr_i
+                        )
+                    else:
+                        # Probe pings / anti-entropy: never a bucket.
+                        self._handle_control(name, addr_i)
+                    continue
+                incasts.append(
+                    (
+                        name,
+                        int(ips[i]),
+                        int(ports[i]),
+                        int(dbuf.multi[i]) >= 1,  # requester's multi advert
+                    )
+                )
+            if incasts and self.repo is not None:
+                self._reply_incasts(incasts)
+        # Device-resident raw dispatch: the WHOLE leased plane ships
+        # (non-dv2 rows ride along with zeroed lengths and fail the
+        # in-kernel verdict for the cost of a verdict lane); the engine
+        # commits the plane back once the H2D transfer is ready.
+        if raw_dv2 is not None:
+            sel = raw_dv2 & live
+            if sel.any():
+                # Pad the batch dim to a power of two (still a zero-copy
+                # PREFIX view of the ring plane): recvmmsg batch sizes
+                # vary per sweep, and an unpadded P would compile one
+                # kernel variant per distinct batch size. Padding rows
+                # carry zero lengths and cost one failed verdict lane.
+                p2 = 1
+                while p2 < n:
+                    p2 <<= 1
+                p2 = min(p2, ring.max_batch)
+                lengths = np.zeros(p2, np.int32)
+                lengths[:n] = np.where(sel, sizes[:n], 0)
+                addrs_l = [
+                    (_u32_to_ip(int(ips[i])), int(ports[i])) if sel[i] else None
+                    for i in range(n)
+                ] + [None] * (p2 - n)
+                handed = self.delta.on_raw_planes(
+                    ring.plane(lease)[:p2], lengths, addrs_l,
+                    release=(lambda idx=lease: ring.commit(idx)),
+                )
+                # The release contract is honored either way (inline on
+                # refusal) — never double-commit from the loop.
+                committed = True
+                if not handed:
+                    # Engine raced away (repo detach): per-packet python
+                    # fallback; bytes() copies, so the committed plane
+                    # may recycle freely.
+                    for i in np.flatnonzero(sel):
+                        self.delta.on_packet(
+                            bytes(packets[i][: sizes[i]]), addrs_l[i]
+                        )
+        self._health_tick()
+        return committed
 
     def _ingest_py(self, data: bytes, addr: Tuple[str, int]) -> None:
         """Single-packet python ingestion — the chaos-mode (faultnet) and
@@ -658,6 +760,10 @@ class NativeReplicator:
         if self.antientropy is not None:
             self.antientropy.close()
         self._rx_thread.join(timeout=2)
+        if self._rx_ring is not None:
+            # Deferred-destroy contract: the native side frees only once
+            # the last leased plane commits (in-flight H2D safe).
+            self._rx_ring.close()
         self.sock.close()
 
     def stats(self) -> dict:
@@ -673,6 +779,8 @@ class NativeReplicator:
             "faultnet_active": int(self.faultnet.active) if self.faultnet else 0,
         }
         out.update(self.health.stats())
+        if self._rx_ring is not None:
+            out.update(self._rx_ring.stats())
         if self.delta is not None:
             out.update(self.delta.stats())
         if self.fleet is not None:

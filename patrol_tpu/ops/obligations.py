@@ -116,6 +116,25 @@ def _trace_delta_fold(fn) -> Trace:
     return _mk_trace(fn, _state(), batch)
 
 
+def _trace_decode_fold_raw(fn) -> Trace:
+    # Tiny raw planes: 2 packets × 128-byte rows (max_entries(128) = 2).
+    # State invars are the leading (pn, elapsed) pair; the state outputs
+    # lead the verdict/decoded-field outputs, so indices (0, 1) hold on
+    # both sides.
+    from patrol_tpu.ops.ingest import max_entries
+
+    e = max_entries(128)
+    return _mk_trace(
+        fn,
+        _state(),
+        _S((2, 128), jnp.uint8),
+        _S((2,), jnp.int32),
+        _S((2, e), jnp.int32),  # entry_off (the host framing proposal)
+        _S((2, e), jnp.int32),  # rows (the host directory plan)
+        _S((2, e), jnp.bool_),
+    )
+
+
 def _trace_merge_rows_dense(fn) -> Trace:
     batch = RowDenseBatch(
         rows=_vec(jnp.int32),
@@ -291,6 +310,23 @@ PROVE_ROOTS: Tuple[ProveRoot, ...] = (
         tracer=_trace_delta_fold,
     ),
     ProveRoot(
+        # Device-resident ingest (r15): raw dv2 datagram byte planes →
+        # framing walk + entry extraction + checksum/validation verdicts
+        # + sentinel padding + scatter-max fold, ONE dispatch. The
+        # ``raw_ingest`` model (analysis/prove.py) checks it against the
+        # python wire decoder + reference join over real datagram bytes:
+        # packet-order commutativity, duplicated-plane idempotence,
+        # monotonicity, and strict all-or-nothing corruption rejection
+        # (every truncation/flip verdict must match decode_delta_packet,
+        # and rejected planes must merge NOTHING). PTP001 runs the join
+        # allowlist on the state planes — the decode arithmetic touches
+        # only untainted plane bytes, so the fold leg must stay pure
+        # scatter-max; PTP005 pins the state dtypes/shapes.
+        "ops.ingest.decode_fold_raw", "patrol_tpu.ops.ingest",
+        "decode_fold_raw", _ALL, structural="join", model="raw_ingest",
+        tracer=_trace_decode_fold_raw,
+    ),
+    ProveRoot(
         "ops.merge.merge_dense", "patrol_tpu.ops.merge", "merge_dense",
         _ALL, structural="join", model="dense_join",
         tracer=_trace_merge_dense,
@@ -384,6 +420,15 @@ ABI_OBLIGATIONS: Tuple[AbiObligation, ...] = (
     AbiObligation(
         "native.hls_schedules", "pt_hls_take_probe", ("PTA004",),
         "hls_interleavings",
+    ),
+    AbiObligation(
+        # Zero-copy rx ring (device-resident ingest): every interleaving
+        # of lease (rx thread) vs commit (engine completer — "the pump"
+        # of the plane hand-off) against a lowest-free-first model, plus
+        # the double-commit / stray-index refusals that guard the
+        # use-after-recycle class.
+        "native.rx_ring_schedules", "pt_rx_ring_lease", ("PTA004",),
+        "rxring_interleavings",
     ),
     AbiObligation(
         "native.effects_table", None, ("PTA005",), "effects_table",

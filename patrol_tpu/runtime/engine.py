@@ -89,7 +89,27 @@ MAX_MERGE_ROWS = int(os.environ.get("PATROL_MAX_MERGE_ROWS", 8192))
 # ingest_device_drain_ms for 10M deltas); coalescing K blocks into one
 # dispatch divides the per-dispatch constant by K and lets the staged
 # transfer overlap the previous tick's compute.
-COMMIT_BLOCKS = max(1, int(os.environ.get("PATROL_COMMIT_BLOCKS", 4)))
+#
+# Default ``auto`` (device-resident ingest, r15): the feeder SIZES the
+# drain per tick from the queue backlog and the completion pipeline's
+# measured per-row device-commit cost — light load drains one block
+# (lowest latency), floods coalesce toward the budget cap so the
+# 8-KiB-interval blocks wire v2 delivers commit in as few dispatches as
+# the latency budget allows. A numeric value pins the static r6
+# behavior; MeshEngine pins its own static copy (fused-step drains).
+_COMMIT_BLOCKS_ENV = os.environ.get("PATROL_COMMIT_BLOCKS", "auto")
+COMMIT_BLOCKS_AUTO = _COMMIT_BLOCKS_ENV.strip().lower() == "auto"
+COMMIT_BLOCKS = (
+    4 if COMMIT_BLOCKS_AUTO else max(1, int(_COMMIT_BLOCKS_ENV))
+)
+# Auto-mode bounds: the widest drain auto may size, and the latency
+# budget one coalesced commit dispatch may spend (the measured
+# device_commit_ns EWMA caps block count so a flood can't build a
+# dispatch whose completion stalls the pipeline past the budget).
+COMMIT_BLOCKS_MAX = max(1, int(os.environ.get("PATROL_COMMIT_BLOCKS_MAX", 8)))
+COMMIT_BUDGET_NS = int(
+    float(os.environ.get("PATROL_COMMIT_BUDGET_MS", 50)) * 1e6
+)
 # In-flight device ticks the feeder may dispatch ahead of the completer
 # (the completion-queue bound). > 1 keeps a tick queued on the device
 # while the completer blocks reading the previous tick's results; the
@@ -1045,6 +1065,16 @@ class DeviceEngine:
         # and the dispatch-ahead bound on in-flight device ticks.
         self._staging = StagingPool()
         self._dispatch_ahead = DISPATCH_AHEAD
+        # Adaptive commit-block sizing (PATROL_COMMIT_BLOCKS=auto):
+        # measured per-row device-commit cost (completer-written racy
+        # float gauge) and the feeder's current drain width. Starts at
+        # the static default so warmup compiles the same shape ladder;
+        # the first ticks then track the backlog.
+        self._commit_row_ns_ewma = 0.0
+        # Materialize the class default as an instance attr: auto mode
+        # mutates it per tick, and the class constant must stay pristine
+        # for the next engine.
+        self._commit_blocks = type(self)._commit_blocks
         self._completer = threading.Thread(
             target=self._complete_loop, name="patrol-engine-complete", daemon=True
         )
@@ -1868,6 +1898,15 @@ class DeviceEngine:
     # dispatch; MeshEngine opts down to 1 (its fused shard_map step has
     # its own per-block routing and no commit-ring kernel).
     _commit_blocks = COMMIT_BLOCKS
+    # Adaptive commit-block sizing (PATROL_COMMIT_BLOCKS=auto): the
+    # feeder re-sizes _commit_blocks per tick from backlog + measured
+    # device-commit cost. MeshEngine pins it off (fused-step drains have
+    # their own routing economics, unmeasured under auto).
+    _commit_blocks_auto = COMMIT_BLOCKS_AUTO
+    # Raw-plane device ingest (ops/ingest.py): MeshEngine opts out — a
+    # decode_fold_raw dispatch against its sharded planes is unmeasured,
+    # and the delta plane falls back to the python decode there.
+    _raw_ingest_capable = True
 
     def _maybe_demote(self, tickets, deltas) -> None:
         """Feeder-only: at demote-window rollover, return quiet promoted
@@ -2557,6 +2596,210 @@ class DeviceEngine:
             self.directory.unpin_rows(rows[live])
             accepted += n
         return accepted
+
+    def ingest_raw_planes(
+        self,
+        planes: np.ndarray,
+        lengths: np.ndarray,
+        walk=None,
+        release: Optional[Callable[[], None]] = None,
+    ) -> int:
+        """Device-resident ingest (ops/ingest.py; ROADMAP item 1): raw
+        dv2 datagram byte planes → joined state in ONE decode+fold
+        dispatch. The wire→state path ships BYTES — framing walk, entry
+        extraction, checksum/validation verdicts, sentinel-padding of
+        invalid packets, and the scatter-max fold all run inside the
+        kernel; the host contributes only what a device cannot: the
+        directory pass resolving entry names to rows (vectorized, via
+        the walk's name offsets/hashes — Python strings materialize only
+        for first-seen buckets) and the host-lane split, which absorbs
+        the kernel's ``hosted_mask`` output through the existing
+        host-lane join.
+
+        ``planes`` is uint8[P, ROW] (rows straight out of the rx ring —
+        non-dv2 rows simply fail the in-kernel verdict via a zeroed
+        length); ``walk`` is the caller's :func:`ops.ingest.host_walk`
+        result when it already ran one (the delta plane's ack
+        bookkeeping shares it); ``release`` is invoked on the completion
+        pipeline once the shipped planes operand is READY (the
+        StagingPool contract: device_put copies, so readiness means the
+        ring plane is refillable) — or inline if the dispatch never
+        happens. Returns deltas accepted (folded + host-absorbed)."""
+        from patrol_tpu.ops import ingest as ingest_ops
+
+        released = release is None
+
+        def _release_inline() -> None:
+            nonlocal released
+            if not released:
+                released = True
+                release()
+
+        try:
+            planes = np.asarray(planes)
+            lengths = np.ascontiguousarray(lengths, np.int32)
+            if walk is None:
+                walk = ingest_ops.host_walk(planes, lengths)
+            P, row_w = planes.shape
+            E = walk.name_len.shape[1]
+            now = self.clock()
+            live = walk.ok[:, None] & (
+                np.arange(E)[None, :] < walk.count[:, None]
+            )
+            pi, ei = np.nonzero(live)
+            rows_pe = np.full((P, E), _FOLD_PAD_ROW, np.int32)
+            hosted_pe = np.zeros((P, E), dtype=bool)
+            accepted = 0
+            pinned: Optional[np.ndarray] = None
+            keep_chunk_rows: Optional[np.ndarray] = None
+            if pi.size:
+                # Entry filter the python rx path applies per entry:
+                # out-of-range slots and control-channel names never
+                # reach the directory (nor the fold — their rows stay
+                # sentinels).
+                slots_f = walk.slot[pi, ei]
+                off_f = walk.name_off[pi, ei].astype(np.int64)
+                len_f = walk.name_len[pi, ei].astype(np.int32)
+                first = planes[pi, np.clip(off_f, 0, row_w - 1)]
+                ctrl = (len_f > 0) & (first == 0)
+                keep = (slots_f >= 0) & (slots_f < self.config.nodes) & ~ctrl
+                pi, ei = pi[keep], ei[keep]
+                off_f, len_f = off_f[keep], len_f[keep]
+            if pi.size:
+                # The existing directory pass, raw form: vectorized
+                # hashed lookup (pins hits), misses bound once per
+                # bucket lifetime with tombstone re-seed.
+                hashes_f = walk.name_hash[pi, ei]
+                name_buf = ingest_ops.gather_name_rows(
+                    planes, pi, off_f, len_f
+                )
+                rows_f = self.directory.lookup_hashed_pinned(
+                    hashes_f, name_buf, len_f, now
+                )
+                miss = np.flatnonzero(rows_f < 0)
+                for lo in range(0, miss.size, MAX_MERGE_ROWS):
+                    mi = miss[lo : lo + MAX_MERGE_ROWS]
+                    got = self._bind_wire_misses_pinned(
+                        name_buf, len_f, hashes_f, mi, now
+                    )
+                    if got is not None:
+                        rows_f[mi] = got
+                bound = rows_f >= 0
+                if bound.any():
+                    b_rows = rows_f[bound].astype(np.int64)
+                    pinned = b_rows
+                    # patrol-audit staleness stamp (remote absorb; racy
+                    # by design, sampler-only reader).
+                    self.directory.last_remote_ns[b_rows] = now
+                    caps_b = np.maximum(walk.cap[pi, ei][bound], 0)
+                    pos = caps_b > 0
+                    if pos.any():
+                        self.directory.init_cap_base_many(
+                            b_rows[pos], caps_b[pos]
+                        )
+                    if HOST_FASTPATH and self._hosted:
+                        hosted_b = self._hosted_flag[b_rows]
+                    else:
+                        hosted_b = np.zeros(len(b_rows), dtype=bool)
+                    rows_pe[pi[bound], ei[bound]] = b_rows
+                    hosted_pe[pi[bound], ei[bound]] = hosted_b
+
+            # ONE dispatch for the whole batch. The planes ship as-is
+            # (rx-ring rows, no intermediate numpy repack); entry_off is
+            # the walk's framing proposal the kernel RE-VALIDATES,
+            # rows/hosted are the host plan; everything else — framing
+            # chain, checksums, verdicts, sentinel padding, fold —
+            # happens in-kernel.
+            entry_off = np.maximum(walk.name_off - 1, 0)
+            t0 = time.perf_counter_ns()
+            planes_dev = jax.device_put(np.ascontiguousarray(planes))
+            _obs_stage(hist.STAGE_H2D, t0, trace_mod.EV_H2D_PUT, int(pi.size))
+            t0 = time.perf_counter_ns()
+            with self._state_mu, _annotate("decode_fold_raw"):
+                (
+                    self.state, _ok_d, _entry_ok, hosted_mask,
+                    d_slot, _d_cap, d_added, d_taken, d_elapsed,
+                ) = ingest_ops.decode_fold_raw_jit(
+                    self.state, planes_dev, jnp.asarray(lengths),
+                    jnp.asarray(entry_off), jnp.asarray(rows_pe),
+                    jnp.asarray(hosted_pe),
+                )
+            _obs_stage(
+                hist.STAGE_DISPATCH, t0, trace_mod.EV_COMMIT_DISPATCH,
+                int(pi.size),
+            )
+            self._observe_device_commit("decode_fold_raw", t0, max(int(pi.size), 1))
+            self._ticks += 1
+            profiling.COUNTERS.inc("ingest_raw_device_dispatches")
+            profiling.COUNTERS.inc(
+                "ingest_raw_bytes_on_device",
+                int(lengths[walk.ok].sum()) if walk.ok.any() else 0,
+            )
+            if release is not None:
+                released = True
+
+                def _commit_plane() -> None:
+                    jax.block_until_ready(planes_dev)
+                    release()
+
+                self._enqueue_completion(_commit_plane, (), {})
+
+            folded = int(((rows_pe != _FOLD_PAD_ROW) & ~hosted_pe).sum())
+            accepted += folded
+            if hosted_pe.any():
+                # Host-lane split, driven by the KERNEL's hosted-mask
+                # output (valid ∩ hosted) and decoded entry values: the
+                # readback joins them into the host lanes; entries whose
+                # row promoted mid-flight ride the feeder tick instead.
+                hm = np.asarray(hosted_mask)
+                hpi, hei = np.nonzero(hm)
+                if hpi.size:
+                    h_rows = rows_pe[hpi, hei].astype(np.int64)
+                    h_slots = np.asarray(d_slot)[hpi, hei]
+                    h_added = np.asarray(d_added)[hpi, hei]
+                    h_taken = np.asarray(d_taken)[hpi, hei]
+                    h_elapsed = np.maximum(
+                        np.asarray(d_elapsed)[hpi, hei], 0
+                    )
+                    keep_h = self._host_absorb_ingest(
+                        h_rows, h_slots, h_added, h_taken, h_elapsed, None
+                    )
+                    if keep_h is None:
+                        keep_h = np.ones(len(h_rows), dtype=bool)
+                    accepted += int((~keep_h).sum())
+                    if keep_h.any():
+                        keep_chunk_rows = h_rows[keep_h]
+                        chunk = _DeltaChunk(
+                            keep_chunk_rows, h_slots[keep_h],
+                            h_added[keep_h], h_taken[keep_h],
+                            h_elapsed[keep_h],
+                        )
+                        with self._cond:
+                            self._deltas.append(chunk)
+                            self._cond.notify()
+                        accepted += chunk.n
+            # Release this call's pins — except rows re-queued as a
+            # feeder chunk, whose pins the tick's finally releases.
+            if pinned is not None:
+                if keep_chunk_rows is not None and keep_chunk_rows.size:
+                    unpin = pinned.copy()
+                    # One pin per entry was taken; the chunk keeps one
+                    # per re-queued entry.
+                    drop = np.zeros(len(unpin), dtype=bool)
+                    remaining = {}
+                    for r in keep_chunk_rows:
+                        remaining[int(r)] = remaining.get(int(r), 0) + 1
+                    for i, r in enumerate(unpin):
+                        c = remaining.get(int(r), 0)
+                        if c:
+                            remaining[int(r)] = c - 1
+                            drop[i] = True
+                    self.directory.unpin_rows(unpin[~drop])
+                else:
+                    self.directory.unpin_rows(pinned)
+            return accepted
+        finally:
+            _release_inline()
 
     def _classify_queue_chunk(
         self,
@@ -3309,7 +3552,11 @@ class DeviceEngine:
                 # Drain up to _commit_blocks blocks per tick: everything
                 # past one block's budget coalesces into a single commit
                 # dispatch (_commit_coalesced) instead of riding extra
-                # ticks — one transfer + one dispatch either way.
+                # ticks — one transfer + one dispatch either way. In
+                # auto mode the block count tracks the backlog, capped
+                # by the measured per-row device-commit cost.
+                if self._commit_blocks_auto:
+                    self._auto_size_commit_blocks_locked()
                 deltas = self._drain_deltas(
                     MAX_MERGE_ROWS * self._commit_blocks
                 )
@@ -3399,6 +3646,30 @@ class DeviceEngine:
         while q and len(out) < limit:
             out.append(q.popleft())
         return out
+
+    def _auto_size_commit_blocks_locked(self) -> None:
+        """Adaptive commit-block sizing (PATROL_COMMIT_BLOCKS=auto;
+        caller holds ``_cond``). The drain width tracks the queue
+        backlog — light load drains one block per tick (lowest latency),
+        a flood coalesces toward COMMIT_BLOCKS_MAX — and the completion
+        pipeline's measured per-row device-commit cost caps the width so
+        one dispatch's completion never exceeds PATROL_COMMIT_BUDGET_MS.
+        Tascade's lesson (arXiv:2311.15810) with a governor: coalescing
+        beats per-update commits, but only up to the latency budget."""
+        backlog = sum(
+            d.n if isinstance(d, _DeltaChunk) else 1 for d in self._deltas
+        )
+        want = max(1, -(-backlog // MAX_MERGE_ROWS)) if backlog else 1
+        want = min(want, COMMIT_BLOCKS_MAX)
+        ewma = self._commit_row_ns_ewma
+        if ewma > 0.0:
+            budget_blocks = max(
+                1, int(COMMIT_BUDGET_NS / (ewma * MAX_MERGE_ROWS))
+            )
+            want = min(want, budget_blocks)
+        if want != self._commit_blocks:
+            self._commit_blocks = want
+            profiling.COUNTERS.inc("commit_blocks_auto_resized")
 
     def _drain_deltas(self, limit: int) -> Optional[DeltaArrays]:
         """Pop queued deltas (singles and pre-vectorized chunks) up to a row
@@ -3774,6 +4045,14 @@ class DeviceEngine:
             dur = time.perf_counter_ns() - t_dispatch_ns
             hist.STAGE_DEVICE_COMMIT.record(dur)
             kh.record(dur)
+            # Adaptive commit sizing input: per-row device-commit cost
+            # EWMA (completer writes, feeder reads — a racy float gauge
+            # by design; a stale read only mis-sizes one tick's drain).
+            per_row = dur / max(n, 1)
+            prev = self._commit_row_ns_ewma
+            self._commit_row_ns_ewma = (
+                per_row if prev == 0.0 else 0.8 * prev + 0.2 * per_row
+            )
             tr = trace_mod.TRACE
             if tr.enabled:
                 tr.record(trace_mod.EV_DEVICE_READY, dur, n)

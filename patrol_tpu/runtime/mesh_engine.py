@@ -101,7 +101,15 @@ class MeshEngine(DeviceEngine):
     # splits per block itself, so a multi-block drain coalesces into the
     # fewest dispatches the warmed diagonal allows (previously this class
     # opted down to 1 block per tick and left the device idle between
-    # short ticks).
+    # short ticks). The r15 ``auto`` sizing stays OFF here: the fused
+    # step's per-block routing economics are unmeasured under a moving
+    # drain width, so this class pins the static default.
+    _commit_blocks_auto = False
+    # Raw-plane device ingest (ops/ingest.py) opts out too: a
+    # decode_fold_raw dispatch against the SHARDED planes would reshard
+    # the scatter through host memory on the tunnel transport —
+    # unmeasured; the delta plane falls back to the python decode path.
+    _raw_ingest_capable = False
 
     def __init__(
         self,

@@ -292,6 +292,15 @@ class CounterRegistry:
         # the high-water measured overshoot (milli-factor, set_max so the
         # gauge is monotone and fleet-gossip-safe), audit frames shipped /
         # joined, and SLO overshoot breaches fired.
+        # Device-resident ingest (ops/ingest.py, r15): raw-plane
+        # decode+fold dispatches issued, raw dv2 bytes shipped to the
+        # device (the wire→state path's "bytes, not matrices" proof),
+        # rx-ring/pool plane reuse hits, and adaptive commit-block
+        # resizes (PATROL_COMMIT_BLOCKS=auto governor actuations).
+        "ingest_raw_device_dispatches",
+        "ingest_raw_bytes_on_device",
+        "rx_ring_lease_reuse",
+        "commit_blocks_auto_resized",
         "audit_lag_samples",
         "audit_divergence_checks",
         "audit_windows_evaluated",

@@ -74,6 +74,15 @@ TREND_GATES: Dict[str, dict] = {
     "soak_p99_drift_x": {
         "direction": "lower", "rel_tol": 2.0, "abs_floor": 1.0,
     },
+    # device-resident ingest (r15): the raw-plane decode+fold rate and
+    # the same-box speedup over the python decode path. Both are
+    # wall-clock-class on shared CI (wide bands); the smoke separately
+    # hard-fails under 2x, and the fixpoint gate below carries the
+    # correctness content.
+    "ingest_raw_decode_per_s": {"direction": "higher", "rel_tol": 0.75},
+    "ingest_raw_vs_python_speedup_x": {
+        "direction": "higher", "rel_tol": 0.5, "abs_floor": 0.5,
+    },
     # patrol-audit: the measured AP-overshoot factor of the chaos smoke's
     # seeded 2-side partition. Deterministic (frozen clocks, both sides
     # admit exactly one capacity: 20/10 = 2.0) — a drift means the
@@ -87,6 +96,9 @@ TREND_GATES: Dict[str, dict] = {
 # Hard boolean/exactness gates: value must equal the expectation.
 EXACT_GATES: Dict[str, object] = {
     "ingest_commit_equivalence": "bit-exact",
+    # Device-resident ingest: raw-plane device decode+fold must land
+    # bit-exactly on the host decode path's state — THE r15 hard gate.
+    "ingest_raw_vs_host_fixpoint": "bit-exact",
     "metrics_exposition": "parsed",
     "wire_fixpoint_equal": True,
     "wire_converged_delta": True,
@@ -124,6 +136,10 @@ EXACT_GATES: Dict[str, object] = {
 # the mesh path.
 NONZERO_GATES = (
     "mesh_kernel_step_samples",
+    # Device-resident ingest liveness: the smoke's raw leg dispatched,
+    # and the wire smoke's delta rx actually rode the raw-plane path.
+    "ingest_raw_device_dispatches",
+    "wire_raw_device_dispatches",
     # The lifecycle must actually CYCLE during the soak: buckets
     # reclaimed, and the frozen-clock shed probe drew explicit sheds.
     "soak_reclaimed",

@@ -284,6 +284,85 @@ class TestScheduleExplorer:
 # --- PTA005: effects-table completeness -------------------------------------
 
 
+class TestRxRingSchedules:
+    """PTA004 on the zero-copy rx ring (device-resident ingest): every
+    lease/commit-vs-pump interleaving matches the lowest-free-first
+    model on the shipped library, and seeded ownership bugs — a lease
+    policy that hands out the wrong plane, a commit that accepts
+    double-commits — are demonstrably rejected."""
+
+    def test_shipped_ring_is_silent(self, lib):
+        assert abi.check_rxring_interleavings(
+            OBS["rxring_interleavings"], lib
+        ) == []
+
+    def test_registered_with_pta004(self):
+        ob = OBS["rxring_interleavings"]
+        assert ob.codes == ("PTA004",)
+        assert ob.symbol == "pt_rx_ring_lease"
+
+    class _Shim:
+        """Delegating facade over the real lib for seeded mutations."""
+
+        def __init__(self, lib):
+            self._lib = lib
+
+        def __getattr__(self, name):
+            return getattr(self._lib, name)
+
+    def test_seeded_wrong_lease_policy_rejected(self, lib):
+        """A lease that returns the HIGHEST free plane instead of the
+        lowest — plausible after a free-list refactor — diverges from
+        the model and must fire PTA004."""
+        shim = self._Shim(lib)
+
+        def high_lease(h):
+            a = lib.pt_rx_ring_lease(h)
+            b = lib.pt_rx_ring_lease(h)
+            if b < 0:
+                return a
+            lib.pt_rx_ring_commit(h, a)
+            return b
+
+        shim.pt_rx_ring_lease = high_lease
+        f = abi.check_rxring_interleavings(OBS["rxring_interleavings"], shim)
+        assert codes(f) == ["PTA004"]
+        assert "lease" in f[0].message
+
+    def test_seeded_double_commit_acceptance_rejected(self, lib):
+        """A commit that silently accepts an un-leased plane (the
+        use-after-recycle door) must fire PTA004 via the refusal probe."""
+        shim = self._Shim(lib)
+
+        def lax_commit(h, plane):
+            rc = lib.pt_rx_ring_commit(h, plane)
+            return 0 if rc == -22 else rc  # swallow EINVAL
+
+        shim.pt_rx_ring_commit = lax_commit
+        f = abi.check_rxring_interleavings(OBS["rxring_interleavings"], shim)
+        assert codes(f) == ["PTA004"]
+
+    def test_deferred_destroy_protects_leased_planes(self, lib):
+        """destroy while a plane is leased must NOT free it: the handle
+        refuses new leases, the outstanding commit still lands, and only
+        then does the ring free (exercised via a fresh handle reusing
+        the slot table without crashing)."""
+        h = lib.pt_rx_ring_create(2, 4, 256)
+        assert h >= 0
+        plane = lib.pt_rx_ring_lease(h)
+        assert plane >= 0
+        ptr = lib.pt_rx_ring_plane(h, plane)
+        assert ptr != 0
+        assert lib.pt_rx_ring_destroy(h) == 0  # deferred
+        assert lib.pt_rx_ring_lease(h) < 0  # closing: no new leases
+        # The leased plane's memory is still live — write through the view.
+        import ctypes
+
+        buf = (ctypes.c_uint8 * 16).from_address(ptr)
+        buf[0] = 0x5A
+        assert lib.pt_rx_ring_commit(h, plane) == 0  # last commit frees
+
+
 class TestEffectsTable:
     def test_table_is_complete_both_ways(self):
         assert abi.check_effects_table(OBS["effects_table"]) == []

@@ -25,6 +25,9 @@ def healthy_receipts():
     out.update(
         {
             "ingest_commit_equivalence": "bit-exact",
+            "ingest_raw_vs_host_fixpoint": "bit-exact",
+            "ingest_raw_device_dispatches": 25,
+            "wire_raw_device_dispatches": 15,
             "metrics_exposition": "parsed",
             "wire_fixpoint_equal": True,
             "wire_converged_delta": True,

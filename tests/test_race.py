@@ -564,12 +564,13 @@ class TestOwnership:
     def test_shipped_effects_table_declares_the_retainers(self):
         from patrol_tpu.native import NATIVE_EFFECTS
 
-        for sym in ("pt_dir_create", "pt_hls_create"):
+        owners = ("pt_dir_create", "pt_hls_create", "pt_rx_ring_create")
+        for sym in owners:
             assert NATIVE_EFFECTS[sym].owns_buffers
             assert NATIVE_EFFECTS[sym].borrows_until in NATIVE_EFFECTS
         # Everything else borrows for the call only.
         for sym, eff in NATIVE_EFFECTS.items():
-            if sym not in ("pt_dir_create", "pt_hls_create"):
+            if sym not in owners:
                 assert not eff.owns_buffers, sym
                 assert eff.borrows_until == "call", sym
 
@@ -721,6 +722,62 @@ class TestMeshGuardCoverage:
         )
         assert codes(f) == ["PTR003"]
         assert "_mesh_metrics" in f[0].message
+
+
+class TestRxRingGuardCoverage:
+    """Device-resident ingest satellite: the zero-copy rx ring's shared
+    lease bookkeeping is registered in GUARDS (rx thread leases, engine
+    completer commits), the retained plane views are pinned in
+    RETAINED_BUFFERS against the owns_buffers row, and the discipline
+    demonstrably has teeth (a seeded unlocked lease mutation → PTR003)."""
+
+    def test_ring_state_registered(self):
+        assert "patrol_tpu/native/__init__.py" in race.RACE_FILES
+        g = race.GUARDS["patrol_tpu/native/__init__.py"]["RxRing"]
+        assert g["_leased"].lock == "_mu" and g["_leased"].mode == "rw"
+        r = race.RETAINED_BUFFERS["patrol_tpu/native/__init__.py"]["RxRing"]
+        assert r["_views"] == "pt_rx_ring_create"
+
+    def test_shipped_ring_accesses_are_nonvacuous(self):
+        src = race.race_sources(REPO_ROOT)["patrol_tpu/native/__init__.py"]
+        assert src.count("_leased") >= 3  # lease add, commit discard, init
+        assert "pt_rx_ring_commit" in src
+
+    def test_seeded_unlocked_lease_mutation_rejected(self):
+        """A ring wrapper that mutates the lease set outside _mu — the
+        exact slip a lease-path refactor could make (the commit callback
+        runs on the completer thread) — must fire PTR003."""
+        src = (
+            "import threading\n"
+            "class RxRing:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._leased = set()\n"
+            "    def lease(self, idx):\n"
+            "        self._leased.add(idx)\n"
+        )
+        guards = {
+            _FIX: {"RxRing": {"_leased": race.Guard("_mu", "rw")}}
+        }
+        f = _static(src, guards=guards)
+        assert codes(f) == ["PTR003"]
+        assert "_leased" in f[0].message
+
+    def test_locked_lease_mutation_clean(self):
+        src = (
+            "import threading\n"
+            "class RxRing:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._leased = set()\n"
+            "    def lease(self, idx):\n"
+            "        with self._mu:\n"
+            "            self._leased.add(idx)\n"
+        )
+        guards = {
+            _FIX: {"RxRing": {"_leased": race.Guard("_mu", "rw")}}
+        }
+        assert _static(src, guards=guards) == []
 
 
 class TestGcGuardCoverage:
