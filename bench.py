@@ -1979,6 +1979,450 @@ def chaos_main() -> int:
     return 0
 
 
+def churn_main() -> int:
+    """``bench.py --churn-smoke``: the elastic-membership churn gate
+    (ROADMAP 3 — zero-downtime cluster churn + live mesh resharding).
+    Boots a REAL 3-node cluster of full Command supervisors (python HTTP
+    fronts, asyncio replicators, frozen clocks) where node 0 serves from
+    a MeshEngine, then — under continuous keep-alive HTTP load — runs
+    the whole membership schedule:
+
+      * grow 3→5: two joiners admitted at runtime via
+        ``POST /admin/peers?op=add`` (lane assignment must agree with the
+        joiner's own boot rank — asserted);
+      * live resharding mid-soak: the meshed node grows 4→8 host devices
+        through :meth:`MeshEngine.resize` while takes keep flowing;
+      * rolling restart: one node checkpoints, is retired behind a lane
+        tombstone (``op=remove``), and rejoins under a NEW address on its
+        ORIGINAL lane via the tombstone-epoch handshake.
+
+    Hard gates (rc ≠ 0 on any): ZERO non-429 HTTP errors across the
+    schedule (zero-downtime is the claim), bit-exact post-quiesce digest
+    agreement across all five nodes, token conservation (Σ converged
+    taken == admitted × NANO — no admitted take is lost by churn), and a
+    bit-identical quiesced relayout cycle (8→4→8) on the meshed node.
+    Emits ``churn_digest_fixpoint`` / ``churn_non429_errors`` /
+    ``churn_admitted`` / ``churn_shed`` + the membership counters
+    (benchmarks/PROBES.md r16) and prints the greppable
+    ``BENCH_CHURN verdict=...`` line."""
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    # Backend forcing must precede the first jax import: the meshed node
+    # needs 8 forced host devices for the 4→8 resize (mesh_main idiom).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import re as _re
+
+    _flags = os.environ.get("XLA_FLAGS", "")
+    _flags = _re.sub(r"--xla_force_host_platform_device_count=\d+\s*", "", _flags)
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    # Deterministic accounting: no gossip/audit pacing threads, no host
+    # fastpath (digest comparison reads device planes), no idle GC.
+    os.environ["PATROL_HOST_FASTPATH"] = "0"
+    os.environ.setdefault("PATROL_FLEET_GOSSIP_MS", "0")
+    os.environ.setdefault("PATROL_GC_WINDOW_MS", "0")
+
+    OUT["metric"] = "elastic membership churn (join/leave/rejoin + live resharding gate)"
+    OUT["unit"] = "takes"
+    OUT["churn_smoke"] = True
+    t0 = time.time()
+    try:
+        import asyncio
+        import shutil
+        import socket as sk
+        import tempfile
+        import threading
+
+        import numpy as np
+
+        import jax
+
+        import patrol_tpu  # noqa: F401  (enables x64)
+        from patrol_tpu.command import Command
+        from patrol_tpu.models.limiter import NANO, LimiterConfig
+        from patrol_tpu.utils import profiling
+
+        OUT["platform"] = jax.default_backend()
+        if len(jax.devices()) < 8:
+            raise RuntimeError("forced 8-way host mesh unavailable")
+
+        cfg = LimiterConfig(buckets=64, nodes=8)
+        frozen = lambda: NANO  # noqa: E731  (frozen clock: bit-exact digests)
+
+        # Six node addresses allocated up front and ROLE-ASSIGNED IN
+        # LEXICOGRAPHIC ORDER: a joiner's boot-time rank (sorted member
+        # list) must equal the admin's next-free-lane assignment, so the
+        # sorted slots become [A, B, C, D, E, C'] by construction.
+        def alloc_ports(n):
+            socks = [sk.socket() for _ in range(n)]
+            for s in socks:
+                s.bind(("127.0.0.1", 0))
+            ports = [s.getsockname()[1] for s in socks]
+            for s in socks:
+                s.close()
+            return ports
+
+        node_addrs = sorted(f"127.0.0.1:{p}" for p in alloc_ports(6))
+        addr_a, addr_b, addr_c, addr_d, addr_e, addr_c2 = node_addrs
+        api_ports = alloc_ports(6)
+
+        loop = asyncio.new_event_loop()
+        thread = threading.Thread(target=lambda: (
+            asyncio.set_event_loop(loop), loop.run_forever()
+        ), daemon=True)
+        thread.start()
+
+        def boot(api_port, node_addr, peers, checkpoint_dir=None, mesh_replicas=0):
+            cmd = Command(
+                api_addr=f"127.0.0.1:{api_port}",
+                node_addr=node_addr,
+                peer_addrs=[p for p in peers if p != node_addr],
+                clock=frozen,
+                config=cfg,
+                handle_signals=False,
+                udp_backend="asyncio",
+                http_front="python",  # injected clock end-to-end
+                checkpoint_dir=checkpoint_dir,
+                mesh_replicas=mesh_replicas,
+                shutdown_timeout_s=10.0,
+            )
+            stop = asyncio.run_coroutine_threadsafe(
+                _make_event(), loop
+            ).result(5)
+            fut = asyncio.run_coroutine_threadsafe(cmd.run(stop), loop)
+            for _ in range(600):
+                if cmd.started.is_set():
+                    break
+                if fut.done():
+                    fut.result()  # surfaces the boot exception
+                time.sleep(0.05)
+            else:
+                raise RuntimeError(f"node {node_addr} never started")
+            return cmd, stop, fut
+
+        async def _make_event():
+            return asyncio.Event()
+
+        def shutdown(stop, fut):
+            loop.call_soon_threadsafe(stop.set)
+            fut.result(timeout=30)
+
+        def request(port, method, path_q):
+            """One admin HTTP request (content-length framed)."""
+            c = sk.create_connection(("127.0.0.1", port), timeout=5)
+            try:
+                c.sendall(
+                    f"{method} {path_q} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+                )
+                buf = b""
+                while b"\r\n\r\n" not in buf:
+                    chunk = c.recv(65536)
+                    if not chunk:
+                        raise ConnectionError("closed")
+                    buf += chunk
+                head, _, body = buf.partition(b"\r\n\r\n")
+                clen = 0
+                for line in head.split(b"\r\n"):
+                    if line.lower().startswith(b"content-length:"):
+                        clen = int(line.split(b":")[1])
+                while len(body) < clen:
+                    body += c.recv(65536)
+                return int(head.split(b" ", 2)[1]), body.decode()
+            finally:
+                c.close()
+
+        BUCKETS = [
+            ("churn-0", "1000000:1h"),
+            ("churn-1", "1000000:1h"),
+            ("churn-2", "1000000:1h"),
+            ("churn-3", "1000000:1h"),
+            ("churn-tiny", "5:1h"),  # exhausts → steady 429 shed signal
+        ]
+
+        class Client(threading.Thread):
+            """Keep-alive take load against one node; every response is
+            classified — anything outside {200, 429} (or a broken
+            connection) is a downtime violation."""
+
+            def __init__(self, api_port, label):
+                super().__init__(daemon=True, name=f"churn-client-{label}")
+                self.port = api_port
+                self.stop_ev = threading.Event()
+                self.admitted = 0
+                self.shed = 0
+                self.errors = 0
+
+            def run(self):
+                try:
+                    sock = sk.create_connection(
+                        ("127.0.0.1", self.port), timeout=5
+                    )
+                except OSError:
+                    self.errors += 1
+                    return
+                i = 0
+                try:
+                    while not self.stop_ev.is_set():
+                        name, rate = BUCKETS[i % len(BUCKETS)]
+                        i += 1
+                        sock.sendall(
+                            f"POST /take/{name}?rate={rate}&count=1 "
+                            "HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+                        )
+                        buf = b""
+                        while b"\r\n\r\n" not in buf:
+                            chunk = sock.recv(65536)
+                            if not chunk:
+                                raise ConnectionError("closed")
+                            buf += chunk
+                        head, _, body = buf.partition(b"\r\n\r\n")
+                        clen = 0
+                        for line in head.split(b"\r\n"):
+                            if line.lower().startswith(b"content-length:"):
+                                clen = int(line.split(b":")[1])
+                        while len(body) < clen:
+                            body += sock.recv(65536)
+                        status = int(head.split(b" ", 2)[1])
+                        if status == 200:
+                            self.admitted += 1
+                        elif status == 429:
+                            self.shed += 1
+                        else:
+                            self.errors += 1
+                        time.sleep(0.002)
+                except (OSError, ConnectionError):
+                    self.errors += 1
+                finally:
+                    sock.close()
+
+            def halt(self):
+                self.stop_ev.set()
+                self.join(timeout=15)
+
+        COUNTER_KEYS = (
+            "peer_joins", "peer_leaves", "lane_tombstones", "mesh_resizes",
+        )
+        counters0 = {k: profiling.COUNTERS.get(k) for k in COUNTER_KEYS}
+        ckpt_dir = tempfile.mkdtemp(prefix="patrol-churn-")
+        nodes = {}    # addr -> (cmd, stop, fut)
+        clients = {}  # addr -> Client
+        try:
+            # -- boot the 3-node seed cluster (node A meshed) ----------------
+            roster3 = [addr_a, addr_b, addr_c]
+            _log("churn: booting 3-node seed cluster (node A meshed)")
+            nodes[addr_a] = boot(api_ports[0], addr_a, roster3, mesh_replicas=1)
+            nodes[addr_b] = boot(api_ports[1], addr_b, roster3)
+            nodes[addr_c] = boot(
+                api_ports[2], addr_c, roster3, checkpoint_dir=ckpt_dir
+            )
+            cmd_a = nodes[addr_a][0]
+            # Pre-soak shrink to a 4-device mesh so the mid-soak growth is
+            # a genuine 4→8 reshard.
+            pre = cmd_a.engine.resize(replicas=1, devices=jax.devices()[:4])
+            OUT["churn_mesh_devices_pre"] = pre["devices"]
+
+            for addr, port in ((addr_a, 0), (addr_b, 1), (addr_c, 2)):
+                clients[addr] = Client(api_ports[port], addr)
+                clients[addr].start()
+            time.sleep(0.8)
+
+            # -- grow 3→5 under load ----------------------------------------
+            joins = []
+            for j, (addr_j, port_j, roster_j) in enumerate((
+                (addr_d, 3, roster3 + [addr_d]),
+                (addr_e, 4, roster3 + [addr_d, addr_e]),
+            )):
+                nodes[addr_j] = boot(api_ports[port_j], addr_j, roster_j)
+                status, body = request(
+                    api_ports[0], "POST", f"/admin/peers?op=add&addr={addr_j}"
+                )
+                if status != 200:
+                    raise RuntimeError(f"admin add {addr_j}: {status} {body}")
+                receipt = json.loads(body)
+                # Lane agreement: the admin's next-free lane must be the
+                # joiner's own boot rank (sorted-address discipline).
+                if receipt["lane"] != nodes[addr_j][0].replicator.slots.self_slot:
+                    raise RuntimeError(
+                        f"lane disagreement for {addr_j}: admin assigned "
+                        f"{receipt['lane']}, joiner booted on "
+                        f"{nodes[addr_j][0].replicator.slots.self_slot}"
+                    )
+                joins.append(receipt)
+                time.sleep(0.2)  # announce fan-out
+                clients[addr_j] = Client(api_ports[port_j], addr_j)
+                clients[addr_j].start()
+            OUT["churn_joins"] = joins
+            _log(f"churn: grew 3→5 (lanes {[r['lane'] for r in joins]})")
+            time.sleep(0.8)
+
+            # -- live mesh resharding mid-soak (4→8 devices) ----------------
+            mid = cmd_a.engine.resize(replicas=2, devices=jax.devices())
+            OUT["churn_mesh_devices_post"] = mid["devices"]
+            _log(f"churn: mesh resized {pre['devices']}→{mid['devices']} under load")
+            time.sleep(0.8)
+
+            # -- rolling restart: C leaves, rejoins as C' on its lane --------
+            clients[addr_c].halt()
+            status, body = request(
+                api_ports[0], "POST", f"/admin/peers?op=remove&addr={addr_c}"
+            )
+            if status != 200:
+                raise RuntimeError(f"admin remove {addr_c}: {status} {body}")
+            leave = json.loads(body)
+            OUT["churn_leave"] = leave
+            time.sleep(0.2)  # tombstone announce fan-out
+            shutdown(*nodes.pop(addr_c)[1:])  # final checkpoint + flush
+            nodes[addr_c2] = boot(
+                api_ports[5], addr_c2,
+                [addr_a, addr_b, addr_d, addr_e],
+                checkpoint_dir=ckpt_dir,  # pins self back onto C's lane
+            )
+            cmd_c2 = nodes[addr_c2][0]
+            if cmd_c2.replicator.slots.self_slot != leave["lane"]:
+                raise RuntimeError(
+                    f"restart lost its lane: {cmd_c2.replicator.slots.self_slot}"
+                    f" != {leave['lane']}"
+                )
+            cmd_c2.replicator.membership.announce_rejoin(
+                leave["lane"], leave["tombstone_epoch"]
+            )
+            time.sleep(0.3)  # rejoin handshake fan-out
+            clients[addr_c2] = Client(api_ports[5], addr_c2)
+            clients[addr_c2].start()
+            _log(
+                f"churn: rolling restart done — lane {leave['lane']} rejoined "
+                f"under new address with tombstone epoch {leave['tombstone_epoch']}"
+            )
+            time.sleep(0.8)
+
+            # -- quiesce + converge -----------------------------------------
+            for cl in clients.values():
+                cl.halt()
+            admitted = sum(c.admitted for c in clients.values())
+            shed = sum(c.shed for c in clients.values())
+            non429 = sum(c.errors for c in clients.values())
+
+            live = [nodes[a][0] for a in (addr_a, addr_b, addr_d, addr_e, addr_c2)]
+
+            def digests():
+                out = []
+                for cmd in live:
+                    per = []
+                    for name, _rate in BUCKETS:
+                        row = cmd.engine.directory.lookup(name)
+                        if row is None:
+                            return None
+                        pn, el = cmd.engine.row_view(row)
+                        per.append((np.asarray(pn).tolist(), int(el)))
+                    out.append(per)
+                return out
+
+            deadline = time.time() + 45
+            converged = False
+            while time.time() < deadline:
+                d = digests()
+                if d is not None and all(per == d[0] for per in d[1:]):
+                    converged = True
+                    break
+                for cmd in live:
+                    for peer in list(cmd.replicator.peers):
+                        try:
+                            cmd.replicator.antientropy.trigger(peer, force=True)
+                        except Exception:
+                            pass
+                time.sleep(0.5)
+            OUT["churn_converged"] = converged
+
+            # Token conservation: every admitted take (count=1) landed
+            # exactly NANO on some node lane, and churn lost none of them.
+            taken_total = 0
+            for name, _rate in BUCKETS:
+                row = cmd_a.engine.directory.lookup(name)
+                if row is not None:
+                    pn, _el = cmd_a.engine.row_view(row)
+                    taken_total += int(np.asarray(pn)[:, 1].sum())
+            conservation = converged and taken_total == admitted * NANO
+            OUT["churn_token_conservation"] = bool(conservation)
+
+            # Quiesced relayout cycle: 8→4→8 must be a bit-exact state
+            # transfer (no load now, so the planes are comparable).
+            s0 = cmd_a.engine.snapshot_planes()
+            cmd_a.engine.resize(replicas=1, devices=jax.devices()[:4])
+            s1 = cmd_a.engine.snapshot_planes()
+            cmd_a.engine.resize(replicas=2, devices=jax.devices())
+            s2 = cmd_a.engine.snapshot_planes()
+            relayout = all(
+                np.array_equal(a, b) and np.array_equal(a, c)
+                for a, b, c in zip(s0, s1, s2)
+            )
+            OUT["churn_relayout_exact"] = bool(relayout)
+
+            OUT["churn_debug_mbr"] = {
+                addr: nodes[addr][0].replicator.membership.stats()
+                for addr in (addr_a, addr_b, addr_d, addr_e, addr_c2)
+            }
+            view = cmd_a.replicator.membership.view()
+            OUT["churn_members_final"] = len(view["members"])
+            OUT["churn_tombstones_final"] = len(view["tombstones"])
+            OUT["churn_epoch_final"] = view["epoch"]
+            OUT.update(cmd_a.replicator.membership.stats())
+            for k in COUNTER_KEYS:
+                OUT[f"churn_counter_{k}"] = profiling.COUNTERS.get(k) - counters0[k]
+
+            OUT["churn_admitted"] = admitted
+            OUT["churn_shed"] = shed
+            OUT["churn_non429_errors"] = non429
+            fixpoint = converged and relayout and conservation
+            OUT["churn_digest_fixpoint"] = "bit-exact" if fixpoint else "diverged"
+            OUT["value"] = admitted
+            ok = (
+                fixpoint
+                and non429 == 0
+                and admitted > 0
+                and shed > 0
+                and OUT["churn_members_final"] == 5
+                and OUT["churn_tombstones_final"] == 0
+                and OUT["churn_epoch_final"] >= 4
+            )
+            OUT["churn_verdict"] = "pass" if ok else "fail"
+        finally:
+            for cl in clients.values():
+                try:
+                    cl.halt()
+                except Exception:
+                    pass
+            for addr, (cmd, stop, fut) in list(nodes.items()):
+                try:
+                    shutdown(stop, fut)
+                except Exception as e:  # teardown must not mask the verdict
+                    _log(f"churn: shutdown of {addr} failed: {e}")
+            loop.call_soon_threadsafe(loop.stop)
+            thread.join(timeout=5)
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+        OUT["churn_smoke_seconds"] = round(time.time() - t0, 2)
+        OUT["stages_completed"] = 1
+        OUT["stages"] = ["churn-smoke"]
+        print(
+            f"BENCH_CHURN verdict={OUT['churn_verdict']} "
+            f"fixpoint={OUT['churn_digest_fixpoint']} "
+            f"non429={OUT['churn_non429_errors']}"
+        )
+    except BaseException as e:
+        _log(f"churn smoke failed: {type(e).__name__}: {e}")
+        OUT["error"] = f"{type(e).__name__}: {e}"
+        OUT["churn_digest_fixpoint"] = "diverged"
+        OUT["churn_verdict"] = "error"
+        print("BENCH_CHURN verdict=error fixpoint=diverged non429=-1")
+        _emit()
+        if not isinstance(e, Exception):
+            raise
+        return 1
+    _emit()
+    return 0 if OUT["churn_verdict"] == "pass" else 1
+
+
 def wire_main() -> int:
     """``bench.py --wire-smoke``: a seconds-class, CPU-safe gate for the
     wire-v2 delta-interval data plane (net/delta.py). First asserts the
@@ -2944,7 +3388,8 @@ def soak_main() -> int:
 def trend_main() -> int:
     """``bench.py --trend``: the perf-regression sentinel driver. Runs
     the seconds-class CI smokes (``--smoke`` / ``--wire-smoke`` /
-    ``--chaos-smoke`` / ``--mesh --smoke`` / ``--soak --smoke``) as
+    ``--chaos-smoke`` / ``--mesh --smoke`` / ``--soak --smoke`` /
+    ``--churn-smoke``) as
     subprocesses (each owns its env/pacing), merges
     their receipt lines, and compares the merged fields against the
     pinned ``benchmarks/TREND_BASELINE.json`` with the noise-aware
@@ -2974,6 +3419,7 @@ def trend_main() -> int:
             ("--chaos-smoke",),
             ("--mesh", "--smoke"),
             ("--soak", "--smoke"),
+            ("--churn-smoke",),
         ):
             flag = " ".join(flags)
             proc = subprocess.run(
@@ -3067,6 +3513,8 @@ if __name__ == "__main__":
         sys.exit(mesh_main())
     if "--soak" in sys.argv:  # before --smoke: "--soak --smoke" is a mode
         sys.exit(soak_main())
+    if "--churn-smoke" in sys.argv:
+        sys.exit(churn_main())
     if "--smoke" in sys.argv:
         sys.exit(smoke_main())
     if "--chaos-smoke" in sys.argv:

@@ -74,6 +74,21 @@ spend and the conservation bound breaks), and
 bucket's incoming state (AE/delta must treat collected as ZERO-state,
 not unknown — deafness diverges the heal fixpoint).
 
+Elastic-membership semantics (patrol-membership, net/membership.py): a
+``membership`` law schedules scripted join/leave/rejoin transitions
+(:func:`check_membership`). Lanes are identity, exactly like the real
+SlotTable — an address change keeps the lane (``realias``), and the law
+decides which lane a (re)joiner writes and what history it keeps. The
+clean "epoch" law retires a departed member's lane behind a tombstone (a
+new joiner gets the next FREE lane; a rejoiner restores its OWN lane
+from its checkpoint), and the invariant is zero admitted-token loss
+(PTC006 family): the converged Σtaken covers every take ever admitted,
+including the departed member's. The two seeded mutations —
+``lane-reuse-without-tombstone`` (a joiner restarts a retired lane from
+zero) and ``rejoin-forgets-own-lane`` (a rejoiner spends 0→k below its
+own watermark) — both let stale echoes of the old (higher) lane values
+absorb the restarted spend in the max-join, breaking conservation.
+
 Trust story (same shape as patrol-prove): the checker must also be able
 to FAIL. ``MUTATIONS`` registers seeded protocol bugs — resync that
 overwrites instead of joins, merge that sums instead of maxes, takes that
@@ -150,6 +165,16 @@ class Semantics:
     # (the naive reclaim, no tombstone); "deaf" = clean predicate but a
     # collected node ignores the bucket's incoming state afterward.
     gc: str = "off"  # "off" | "iszero" | "always" | "deaf"
+    # Elastic-membership law (patrol-membership, net/membership.py):
+    # "off" = no membership transitions scheduled; "epoch" = clean (a
+    # departed member's lane is retired behind a tombstone — a new
+    # joiner gets the next FREE lane, a rejoiner restores its OWN lane
+    # from its checkpoint); "reuse-no-tombstone" = a joiner is handed a
+    # retired lane zeroed from scratch (the SlotTable bug the tombstone
+    # epoch makes structurally impossible); "forget-own-lane" = a
+    # rejoiner returns on its original lane with the lane history
+    # zeroed (restart without checkpoint restore onto a live lane).
+    membership: str = "off"  # "off" | "epoch" | "reuse-no-tombstone" | "forget-own-lane"
 
 
 CLEAN = Semantics()
@@ -157,6 +182,8 @@ CLEAN_DELTA = Semantics(wire="delta")
 CLEAN_MIXED = Semantics(wire="mixed")
 CLEAN_GC = Semantics(gc="iszero")
 CLEAN_GC_DELTA = Semantics(wire="delta", gc="iszero")
+CLEAN_MEMBER = Semantics(membership="epoch")
+CLEAN_MEMBER_DELTA = Semantics(wire="delta", membership="epoch")
 
 # Seeded protocol bugs the checker must reject (name → (semantics, what a
 # correct checker reports about it)).
@@ -190,6 +217,18 @@ MUTATIONS: Dict[str, Semantics] = {
     # plane — a node that treats it as unknown (ignores incoming state
     # for it) never reconverges after heal (PTC001).
     "gc-treats-collected-as-unknown": Semantics(gc="deaf"),
+    # Elastic-membership bugs (patrol-membership, net/membership.py).
+    # Handing a RETIRED lane to a new joiner without the tombstone-epoch
+    # handshake restarts the lane's PN counters from zero below the
+    # departed member's final values: the joiner's fresh spend is
+    # absorbed by any stale echo of the old (higher) lane values in the
+    # max-join, and the forgotten takes re-admit — the SlotTable
+    # tombstone makes this structurally impossible in the real table.
+    "lane-reuse-without-tombstone": Semantics(membership="reuse-no-tombstone"),
+    # A rejoiner returning on its ORIGINAL lane must restore that lane's
+    # history (checkpoint restore / incast before first spend): spending
+    # 0→k below its own pre-restart watermark is absorbed the same way.
+    "rejoin-forgets-own-lane": Semantics(membership="forget-own-lane"),
 }
 
 
@@ -991,6 +1030,136 @@ def check_gc_conservation(
     return findings
 
 
+def _membership_conservation(
+    c: Cluster, total_admitted: int, scenario: str
+) -> None:
+    """Zero admitted-token loss across membership churn (the PTC006
+    family): every admitted take debited one token into SOME lane, and
+    lanes only grow — so the converged Σtaken must cover every take ever
+    admitted, including the departed member's. A membership law that
+    lets a lane restart below its watermark breaks this: the restarted
+    spend is absorbed by stale echoes of the old (higher) values."""
+    n = len(c.nodes)
+    converged = c.nodes[0].state()
+    total_taken = sum(converged[n:])
+    if total_taken < total_admitted:
+        raise _Violation(
+            "PTC006",
+            f"membership churn lost admitted tokens ({scenario}): "
+            f"converged taken {total_taken} < {total_admitted} admitted "
+            "— a lane restarted below its watermark and stale echoes "
+            "absorbed the difference",
+        )
+
+
+def check_membership(sem: Semantics = CLEAN_MEMBER) -> List[Finding]:
+    """Elastic-membership transitions (patrol-membership): scripted
+    join/leave/rejoin/address-change scenarios over the model cluster,
+    each driving the dangerous window — a (re)joiner spending BEFORE its
+    first sync — and checking zero admitted-token loss (PTC006 family)
+    plus exact convergence (PTC001/PTC002 via heal).
+
+    Lanes are identity here, exactly like the real SlotTable: an address
+    change is the no-op case (``realias`` keeps the lane, so the state
+    is untouched by construction — scenario 2's rejoiner IS the
+    new-address rolling restart), and the membership law decides only
+    *which lane* a (re)joiner writes and *what history* that lane keeps.
+
+    * Scenario 1 — leave + new joiner: a member exhausts the bucket and
+      leaves; a new node joins unsynced and spends. Clean ("epoch"): the
+      joiner gets the next FREE lane — both spends survive the join.
+      "reuse-no-tombstone": the joiner restarts the RETIRED lane from
+      zero — its spend is absorbed by the departed member's stale
+      echoes and the conservation bound breaks.
+    * Scenario 2 — rolling restart (leave + rejoin under a new address
+      on the ORIGINAL lane): clean restores the lane from the
+      checkpoint, so post-restart spend lands ABOVE the watermark;
+      "forget-own-lane" restarts at zero below it.
+    * Both terminals heal twice: the second heal must be a fixpoint
+      (membership events are idempotent facts — a replayed announce
+      changes nothing)."""
+    findings: List[Finding] = []
+    limit = 2
+
+    # -- scenario 1: leave, then a NEW member joins unsynced ----------------
+    c = Cluster(3, limit, sem)
+    try:
+        # Boot members are lanes {0, 1}; lane 2 is unallocated (its node
+        # exists in the model but neither takes nor receives until join).
+        c.take(1)
+        c.take(1)  # node 1 admits `limit`, exhausting the bucket
+        c.flush(1)
+        while c.links[(1, 0)]:
+            c.deliver(1, 0, 0)  # intra-member delivery only
+        departed_admitted = c.nodes[1].admitted
+        # Node 1 leaves. Its lane is retired; in-flight packets from it
+        # (the (1, 2) link) are now STALE ECHOES of the departed member.
+        reused = sem.membership == "reuse-no-tombstone"
+        if reused:
+            # The seeded bug: the joiner is handed the retired lane,
+            # zeroed — no tombstone, no epoch handshake. Its admitted
+            # counter restarts too (a different process), so the
+            # departed member's takes ride `departed_admitted`.
+            c.nodes[1] = Node(1, 3, limit)
+            joiner = 1
+        else:
+            joiner = 2  # clean: next FREE lane; tombstoned lane 1 keeps
+            # its final values forever (join-absorbed, never reassigned)
+        # The dangerous window: the joiner spends before its first sync.
+        c.take(joiner)
+        c.take(joiner)
+        c.flush(joiner)
+        c.heal_and_converge()
+        total_admitted = sum(n.admitted for n in c.nodes) + (
+            departed_admitted if reused else 0
+        )
+        _membership_conservation(c, total_admitted, "leave+join")
+        snap = [n.state() for n in c.nodes]
+        c.heal_and_converge()  # idempotence: replayed announces are no-ops
+        if [n.state() for n in c.nodes] != snap:
+            raise _Violation(
+                "PTC004", "membership heal is not a fixpoint (leave+join)"
+            )
+    except _Violation as v:
+        findings.append(Finding(v.check, _SELF, 0, v.message))
+
+    # -- scenario 2: rolling restart — rejoin on the ORIGINAL lane ----------
+    c = Cluster(2, limit, sem)
+    try:
+        c.take(1)  # one admitted take below capacity
+        c.flush(1)
+        c.deliver_all()
+        old = c.nodes[1]
+        departed_admitted = old.admitted
+        # Node 1 checkpoints, leaves, and rejoins under a NEW address on
+        # its original lane (the realias+tombstone-epoch handshake of the
+        # real SlotTable — address is not lane, so the model's slot stays
+        # 1). A fresh process: admitted restarts, lane history per law.
+        fresh = Node(1, 2, limit)
+        if sem.membership != "forget-own-lane":
+            fresh.added = list(old.added)  # checkpoint restore: the lane
+            fresh.taken = list(old.taken)  # resumes AT its watermark
+        c.nodes[1] = fresh
+        # Unsynced post-restart spend.
+        c.take(1)
+        c.take(1)
+        c.flush(1)
+        c.heal_and_converge()
+        total_admitted = departed_admitted + sum(n.admitted for n in c.nodes)
+        _membership_conservation(c, total_admitted, "rolling-restart")
+        snap = [n.state() for n in c.nodes]
+        c.heal_and_converge()
+        if [n.state() for n in c.nodes] != snap:
+            raise _Violation(
+                "PTC004",
+                "membership heal is not a fixpoint (rolling-restart)",
+            )
+    except _Violation as v:
+        findings.append(Finding(v.check, _SELF, 0, v.message))
+
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # entry points
 
@@ -1010,6 +1179,10 @@ def check_protocol(sem: Semantics = CLEAN) -> List[Finding]:
         # non-GC semantics (clean or mutated) is covered by the suites
         # above without paying the extra enumeration.
         findings += check_gc_conservation(sem=sem)
+    if sem.membership != "off":
+        # Elastic-membership transitions only exist under a membership
+        # law (same gating shape as the gc suite).
+        findings += check_membership(sem=sem)
     # De-duplicate identical findings from overlapping suites.
     seen = set()
     out = []
@@ -1032,6 +1205,8 @@ def check_repo() -> List[Finding]:
     findings += check_protocol(CLEAN_MIXED)
     findings += check_protocol(CLEAN_GC)
     findings += check_protocol(CLEAN_GC_DELTA)
+    findings += check_protocol(CLEAN_MEMBER)
+    findings += check_protocol(CLEAN_MEMBER_DELTA)
     for name, sem in MUTATIONS.items():
         caught = check_protocol(sem)
         if not caught:

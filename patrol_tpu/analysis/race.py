@@ -689,6 +689,11 @@ GUARDS: Dict[str, Dict[str, Dict[str, Guard]]] = {
             # The host-fastpath GC kick flag rides the work condvar like
             # the queues it wakes.
             "_gc_due": Guard("_cond", "mutate"),
+            # Live-resharding quiesce flag (mesh resize): raised/lowered
+            # and read in the feeder's wait predicate under the same
+            # condvar — a bare read could dispatch a tick into a mesh
+            # swap.
+            "_tick_paused": Guard("_cond", "rw"),
             "_gc_reclaimed": Guard("_evict_mu", "mutate"),
             "_gc_shed": Guard("_evict_mu", "mutate"),
             "_gc_sweeps": Guard("_evict_mu", "mutate"),
@@ -712,6 +717,9 @@ GUARDS: Dict[str, Dict[str, Dict[str, Guard]]] = {
             # lock of its own (never nested with the engine's shared
             # locks), so it adds no ordering edge.
             "_mesh_metrics": Guard("_mesh_mu", "rw"),
+            # resize() raises/lowers the inherited quiesce flag — same
+            # condvar discipline as the feeder's wait predicate.
+            "_tick_paused": Guard("_cond", "mutate"),
         },
     },
     "patrol_tpu/net/replication.py": {
@@ -723,6 +731,15 @@ GUARDS: Dict[str, Dict[str, Dict[str, Guard]]] = {
             # path, every WRITE runs under _mu.
             "slot_of": Guard("_mu", "mutate"),
             "_next_dynamic": Guard("_mu", "rw"),
+            # Elastic membership (patrol-membership): the active-member
+            # map, the monotone membership epoch, and the lane
+            # tombstones move together under _mu — admin calls arrive
+            # from the API executor while membership datagrams land on
+            # the rx context, and a torn view could hand out a retired
+            # lane without its epoch.
+            "_members": Guard("_mu", "rw"),
+            "_epoch": Guard("_mu", "rw"),
+            "_tombstones": Guard("_mu", "rw"),
         },
     },
     "patrol_tpu/net/native_replication.py": {},
@@ -796,6 +813,11 @@ HOLDERS: Dict[str, Dict[str, Tuple[str, ...]]] = {
         # (mark_capable / capable_peers / flush / on_packet / stats /
         # on_peer_heal) is already inside `with self._mu`.
         "DeltaPlane._peer": ("_mu",),
+    },
+    "patrol_tpu/net/replication.py": {
+        # Epoch arithmetic shared by add_member / remove_member /
+        # rejoin; every caller is already inside `with self._mu`.
+        "SlotTable._bump_epoch_locked": ("_mu",),
     },
 }
 

@@ -93,9 +93,30 @@ class Command:
         stop = stop or asyncio.Event()
         self.started.clear()
 
+        from patrol_tpu.runtime import checkpoint as ckpt
+
+        # Rejoin pinning (patrol-membership): a restarting node must come
+        # back on its ORIGINAL lane — its checkpointed PN spend lives
+        # there — even when its peer list changed (rolling restart under a
+        # new address). The checkpoint's membership meta carries the lane;
+        # without it, rank-order assignment could hand self a different
+        # lane and strand the restored spend where stale echoes absorb it.
+        self_slot = None
+        mem = None
+        if self.checkpoint_dir and ckpt.exists(self.checkpoint_dir):
+            mem = ckpt.load_membership(self.checkpoint_dir)
+            if mem is not None and isinstance(mem.get("self_slot"), int):
+                self_slot = mem["self_slot"]
         slots = SlotTable(
-            self.node_addr, self.peer_addrs, max_slots=self.config.nodes
+            self.node_addr,
+            self.peer_addrs,
+            max_slots=self.config.nodes,
+            self_slot=self_slot,
         )
+        if mem is not None:
+            # The epoch counter survives restarts (monotone; a reborn
+            # admin must never re-issue historical epochs).
+            slots.restore_epoch(mem.get("epoch"))
         from patrol_tpu.utils import histogram as hist_mod
 
         # Node identity rides every histogram summary and gossip packet,
@@ -148,8 +169,6 @@ class Command:
         engine.on_broadcast = replicator.broadcast_states
         if getattr(replicator, "fleet", None) is not None:
             replicator.fleet.set_identity(node_name)
-
-        from patrol_tpu.runtime import checkpoint as ckpt
 
         if self.checkpoint_dir and ckpt.exists(self.checkpoint_dir):
             n = ckpt.restore(self.checkpoint_dir, engine)
@@ -210,6 +229,8 @@ class Command:
         api.fleet = getattr(replicator, "fleet", None)
         # /debug/audit (patrol-audit): the consistency plane's gauges.
         api.audit = getattr(replicator, "audit", None)
+        # /admin/peers (patrol-membership): runtime join/leave/rejoin.
+        api.membership = getattr(replicator, "membership", None)
         host, _, port = self.api_addr.rpartition(":")
         native_front = None
         server = None
@@ -250,6 +271,12 @@ class Command:
         log.info("API serving", extra={"addr": self.api_addr})
         self.started.set()
 
+        # Membership meta rides every checkpoint so a restart (possibly
+        # under a new address) can pin itself back onto its original lane.
+        def _membership_meta():
+            mem = getattr(replicator, "membership", None)
+            return mem.view() if mem is not None else None
+
         ckpt_task = None
         if self.checkpoint_dir and self.checkpoint_interval_s > 0:
             loop = asyncio.get_running_loop()
@@ -258,7 +285,13 @@ class Command:
                 while True:
                     await asyncio.sleep(self.checkpoint_interval_s)
                     try:
-                        await loop.run_in_executor(None, ckpt.save, self.checkpoint_dir, engine)
+                        await loop.run_in_executor(
+                            None,
+                            ckpt.save,
+                            self.checkpoint_dir,
+                            engine,
+                            _membership_meta(),
+                        )
                     except Exception:  # pragma: no cover
                         log.exception("periodic checkpoint failed")
 
@@ -271,7 +304,7 @@ class Command:
                 ckpt_task.cancel()
             if self.checkpoint_dir:
                 try:
-                    ckpt.save(self.checkpoint_dir, engine)
+                    ckpt.save(self.checkpoint_dir, engine, _membership_meta())
                     log.info("checkpoint saved", extra={"dir": self.checkpoint_dir})
                 except Exception:  # pragma: no cover
                     log.exception("final checkpoint failed")

@@ -145,6 +145,9 @@ class API:
         # patrol-audit: the replicator's consistency plane (set by the
         # supervisor); None ⇒ /debug/audit answers 503.
         self.audit = None
+        # patrol-membership: the replicator's elastic-membership plane
+        # (set by the supervisor); None ⇒ /admin/peers answers 503.
+        self.membership = None
         self.started_at = time.time()  # patrol-lint: clock-seam (uptime)
         self._batcher = (
             _TakeBatcher(repo)
@@ -170,6 +173,8 @@ class API:
             if method != "GET":
                 return 405, b"method not allowed\n", "text/plain"
             return self._cluster(path)
+        if path == "/admin/peers":
+            return self._admin_peers(method, query)
         return 404, b"not found\n", "text/plain"
 
     # -- the hot route (api.go:51-86) ---------------------------------------
@@ -427,6 +432,47 @@ class API:
             ).encode()
             return 200, body, "application/json"
         return 404, b"not found\n", "text/plain"
+
+    def _admin_peers(self, method: str, query: str) -> Tuple[int, bytes, str]:
+        """patrol-membership admin surface (net/membership.py). Input
+        rides the query string — both HTTP fronts drain but IGNORE
+        request bodies, like /take.
+
+        * ``GET /admin/peers`` → the live SlotTable view (epoch, lanes,
+          tombstones) + the membership plane's counters.
+        * ``POST /admin/peers?op=add&addr=host:port`` → admit a member;
+          200 with the receipt (lane + epoch), 409 when no lane is
+          assignable (lane space exhausted, or the address's lane is
+          tombstoned — a retired lane needs the rejoin handshake).
+        * ``POST /admin/peers?op=remove&addr=host:port`` → retire the
+          member's lane behind a tombstone; 200 with the receipt carrying
+          ``tombstone_epoch`` (the leaver's future rejoin credential),
+          409 for self/unknown addresses.
+        """
+        if self.membership is None:
+            return 503, b"no membership plane on this node\n", "text/plain"
+        if method == "GET":
+            body = json.dumps(
+                {**self.membership.view(), **self.membership.stats()},
+                indent=2,
+            ).encode()
+            return 200, body, "application/json"
+        if method != "POST":
+            return 405, b"method not allowed\n", "text/plain"
+        q = parse_qs(query, keep_blank_values=True)
+        op = q.get("op", [""])[0]
+        addr = q.get("addr", [""])[0]
+        if op not in ("add", "remove") or not addr or ":" not in addr:
+            return 400, b"need op=add|remove and addr=host:port\n", "text/plain"
+        receipt = (
+            self.membership.local_join(addr)
+            if op == "add"
+            else self.membership.local_leave(addr)
+        )
+        if receipt is None:
+            return 409, f"cannot {op} {addr}\n".encode(), "text/plain"
+        receipt["epoch_now"] = self.membership.view()["epoch"]
+        return 200, json.dumps(receipt, indent=2).encode(), "application/json"
 
     def _metrics(self) -> bytes:
         """Prometheus text exposition (patrol-scope): every numeric stat

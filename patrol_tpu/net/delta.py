@@ -283,6 +283,14 @@ class DeltaPlane:
             st.capable = False
             st.last_advert_tick = -(1 << 30)
 
+    def on_peer_leave(self, addr: Addr) -> None:
+        """Elastic membership: a peer left the cluster — drop its per-peer
+        delta bookkeeping entirely (unacked interval log, capability, seq
+        state). A rejoin under a new address negotiates from scratch; the
+        departed lane's shipped values are already join-absorbed."""
+        with self._mu:
+            self._peers.pop(addr, None)
+
     # -- tx: accumulate + flush ---------------------------------------------
 
     @staticmethod

@@ -135,6 +135,11 @@ class NativeReplicator:
         # patrol-audit consistency plane (net/audit.py): the rx ring rows
         # bound the frame size exactly like the delta/fleet planes.
         self.audit = AuditPlane(self, tx_mtu=native.RX_RING_ROW)
+        # Elastic membership (net/membership.py): runtime join / leave /
+        # rejoin events over the control channel.
+        from patrol_tpu.net.membership import MembershipPlane
+
+        self.membership = MembershipPlane(self)
         if peers:
             self.fleet.start()
             self.audit.start()
@@ -385,6 +390,11 @@ class NativeReplicator:
                         self.audit.on_packet(
                             bytes(packets[i][: sizes[i]]), addr_i
                         )
+                    elif name == wire.MEMBER_CHANNEL_NAME:
+                        # Elastic-membership events (join/leave/rejoin).
+                        self.membership.on_packet(
+                            bytes(packets[i][: sizes[i]]), addr_i
+                        )
                     else:
                         # Probe pings / anti-entropy: never a bucket.
                         self._handle_control(name, addr_i)
@@ -473,6 +483,9 @@ class NativeReplicator:
             if state.name == wire.AUDIT_CHANNEL_NAME:
                 self.audit.on_packet(data, addr)
                 return
+            if state.name == wire.MEMBER_CHANNEL_NAME:
+                self.membership.on_packet(data, addr)
+                return
             self._handle_control(state.name, addr)
             return
         if self.repo is None:
@@ -527,6 +540,10 @@ class NativeReplicator:
                 self.unicast(self._probe_bytes, addr)
             for p in resolves:
                 self._reresolve_peer(p)
+            if self.membership is not None:
+                # Membership loss repair: re-announce recent local
+                # events (bounded; duplicates are receiver no-ops).
+                self.membership.maybe_replay()
         except Exception:  # pragma: no cover - rx loop must survive
             self.log.exception("health tick failed")
 
@@ -541,14 +558,46 @@ class NativeReplicator:
         self.slots.realias(old, new)
         self.health.mark_resolved(p, new)
         peers = [a for a in self.peers if a != old] + [new]
+        self._swap_peers(peers)
+        self.log.info("peer %s re-resolved to %s:%d", p.addr_str, new[0], new[1])
+
+    def _swap_peers(self, peers: List[Tuple[str, int]]) -> None:
+        """Adopt a new fan-out list. One atomic attribute swap per array
+        pair: the engine thread reads ips+ports as a single tuple, so it
+        can never see a half-updated fan-out."""
         self.peers = peers
-        # One atomic attribute swap: the engine thread reads ips+ports as
-        # a single tuple, so it can never see a half-updated fan-out.
         self._endpoints = (
             np.array([_ip_to_u32(h) for h, _ in peers], np.uint32),
             np.array([pt for _, pt in peers], np.uint16),
         )
-        self.log.info("peer %s re-resolved to %s:%d", p.addr_str, new[0], new[1])
+
+    # -- elastic membership (net/membership.py drives these) ----------------
+
+    def _adopt_peer(self, addr_str: str) -> Optional[Tuple[str, int]]:
+        """Add a peer to the fan-out at runtime (membership join/rejoin).
+        Idempotent. Starts the paced planes if this is the first peer."""
+        if addr_str == self.node_addr:
+            return None
+        a = _resolve(addr_str)
+        ok = _is_ip(a[0])
+        if a not in self.health.peers:
+            self.health.add_peer(addr_str, a, resolved=ok)
+        if ok and a not in self.peers:
+            self._swap_peers(self.peers + [a])
+        if self.peers:
+            self.fleet.start()
+            self.audit.start()
+        return a if ok else None
+
+    def _drop_peer(self, addr_str: str) -> None:
+        """Remove a departed peer from the fan-out (membership leave).
+        Its lane stays tombstoned in the SlotTable — late datagrams from
+        the address still attribute correctly and max-join to no-ops."""
+        a = _resolve(addr_str)
+        self._swap_peers([p for p in self.peers if p != a])
+        self.health.remove_peer(a)
+        if self.delta is not None:
+            self.delta.on_peer_leave(a)
 
     def _encode_py(self, states):
         """Python-codec encode into the (n, 256) fan-out layout — the cold
@@ -779,6 +828,8 @@ class NativeReplicator:
             "faultnet_active": int(self.faultnet.active) if self.faultnet else 0,
         }
         out.update(self.health.stats())
+        if self.membership is not None:
+            out.update(self.membership.stats())
         if self._rx_ring is not None:
             out.update(self._rx_ring.stats())
         if self.delta is not None:

@@ -129,6 +129,14 @@ class PeerHealth:
     probes yet must keep receiving state. Only unresolved peers are
     excluded from the fan-out (there is no address to send to).
 
+    Suspect demotion (elastic membership, ROADMAP 3b): a peer whose
+    consecutive unanswered probes reach ``suspect_after`` is demoted to a
+    *suspect* state — an observable signal (``stats()['peer_suspect']``,
+    :meth:`is_suspect`) for operators and the membership plane. Suspicion
+    gates NOTHING on the data path: a suspect peer keeps receiving
+    broadcasts and its rx keeps being merged (its next datagram instantly
+    heals it). Only an explicit admin ``remove`` retires a lane.
+
     Thread-safety: mutated by the owner backend's single rx/health
     context; ``stats()`` readers take the same lock.
     """
@@ -141,12 +149,14 @@ class PeerHealth:
         alive_ttl_s: float = 3.0,
         backoff_cap_s: float = 15.0,
         reresolve_after: int = 2,
+        suspect_after: int = 3,
     ):
         self.clock = clock
         self.probe_interval_s = probe_interval_s
         self.alive_ttl_s = alive_ttl_s
         self.backoff_cap_s = backoff_cap_s
         self.reresolve_after = reresolve_after
+        self.suspect_after = suspect_after
         self._rng = random.Random(seed)
         self._mu = threading.Lock()
         self.peers: Dict[Addr, _Peer] = {}
@@ -158,6 +168,18 @@ class PeerHealth:
         with self._mu:
             self.peers[addr] = p
         return p
+
+    def remove_peer(self, addr: Addr) -> None:
+        """Forget a departed peer (membership leave): stops probing it.
+        Late datagrams from the address still ingest fine — on_rx simply
+        finds no health entry."""
+        with self._mu:
+            self.peers.pop(addr, None)
+
+    def is_suspect(self, addr: Addr) -> bool:
+        with self._mu:
+            p = self.peers.get(addr)
+            return p is not None and p.resolved and p.failures >= self.suspect_after
 
     def configure(
         self,
@@ -272,11 +294,14 @@ class PeerHealth:
             unresolved = 0
             probes = 0
             reresolves = 0
+            suspect = 0
             for p in self.peers.values():
                 probes += p.probes_sent
                 reresolves += p.reresolves
                 if not p.resolved:
                     unresolved += 1
+                elif p.failures >= self.suspect_after:
+                    suspect += 1
                 if p.ever_heard and now - p.last_rx <= self.alive_ttl_s:
                     alive += 1
                 else:
@@ -285,6 +310,7 @@ class PeerHealth:
             "peer_alive": alive,
             "peer_backoff_ms": backoff_ms,
             "peer_unresolved": unresolved,
+            "peer_suspect": suspect,
             "peer_probes_tx": probes,
             "peer_reresolves": reresolves,
             "peer_heals": self.heals,
@@ -358,13 +384,40 @@ class ReplyGate:
 
 
 class SlotTable:
-    """Deterministic node-slot assignment: rank in the sorted static member
-    list (peers ∪ self), identical on every correctly-configured node.
-    Unknown senders (e.g. reference nodes not in the static list) get
-    dynamic slots from the remainder of the lane space — membership is
-    static in the reference too (README.md:78-86)."""
+    """Node-slot assignment: boot members get their rank in the sorted
+    static member list (peers ∪ self), identical on every
+    correctly-configured node. Unknown senders (e.g. reference nodes not
+    in the static list) get dynamic slots from the remainder of the lane
+    space — membership is static in the reference (README.md:78-86).
 
-    def __init__(self, self_addr: str, peers: Iterable[str], max_slots: int):
+    Elastic membership (ROADMAP 3b) turns the table into runtime state:
+
+    * ``add_member`` assigns the next free lane to a joiner and bumps the
+      membership ``_epoch``;
+    * ``remove_member`` retires a leaver's lane behind a **tombstone**
+      stamped with the retirement epoch. The lane's final PN values stay
+      join-absorbed forever (max-join never forgets them) and the
+      addr→lane aliases are kept, so late echoes from the departed owner
+      still attribute correctly and collapse into no-ops;
+    * a tombstoned lane can ONLY be re-attached through :meth:`rejoin`,
+      which demands the exact retirement epoch (the tombstone-epoch
+      handshake) and bumps the epoch again. ``resolve`` allocates
+      strictly fresh lanes (``_next_dynamic`` is monotone) and
+      ``realias`` refuses tombstoned lanes — lane reuse without a
+      tombstone epoch bump is structurally impossible, not merely
+      discouraged.
+
+    Lane lifecycle:  free → active → tombstoned(e) → active  (rejoin
+    with epoch e only; every arrow bumps ``_epoch``).
+    """
+
+    def __init__(
+        self,
+        self_addr: str,
+        peers: Iterable[str],
+        max_slots: int,
+        self_slot: Optional[int] = None,
+    ):
         members = sorted(set(peers) | {self_addr})
         if len(members) > max_slots:
             raise ValueError(
@@ -373,9 +426,33 @@ class SlotTable:
             )
         self.max_slots = max_slots
         self._mu = threading.Lock()
-        self.slot_of: Dict[Addr, int] = {_resolve(a): i for i, a in enumerate(members)}
+        if self_slot is None:
+            self.slot_of: Dict[Addr, int] = {
+                _resolve(a): i for i, a in enumerate(members)
+            }
+        else:
+            # Rejoin boot (checkpoint restore under a possibly-new
+            # address): self is PINNED to its original lane — a rank
+            # recomputed over the new address could fork the node's PN
+            # lane. Other members take the remaining lanes in sorted
+            # order; v2 origin-slot trailers make their exact local
+            # ranks cosmetic (attribution rides the wire).
+            if not 0 <= self_slot < max_slots:
+                raise ValueError(f"self_slot {self_slot} out of range")
+            lanes = [i for i in range(max_slots) if i != self_slot]
+            self.slot_of = {}
+            for a in members:
+                self.slot_of[_resolve(a)] = (
+                    self_slot if a == self_addr else lanes.pop(0)
+                )
         self.self_slot = self.slot_of[_resolve(self_addr)]
-        self._next_dynamic = len(members)
+        self._next_dynamic = max(self.slot_of.values()) + 1
+        # Elastic membership state (all under _mu): lane → member address
+        # for ACTIVE members, the monotone membership epoch, and lane →
+        # retirement-epoch tombstones.
+        self._members: Dict[int, str] = {self.slot_of[_resolve(a)]: a for a in members}
+        self._epoch = 0
+        self._tombstones: Dict[int, int] = {}
 
     def resolve(self, addr: Addr) -> Optional[int]:
         slot = self.slot_of.get(addr)
@@ -398,11 +475,172 @@ class SlotTable:
         lane — a fresh dynamic slot would fork the peer's PN lane and
         permanently double its contribution after the old lane's state
         re-merges. The old alias is kept: late packets from the previous
-        address still attribute correctly."""
+        address still attribute correctly.
+
+        A tombstoned lane is NOT realias-able: an arbitrary new endpoint
+        adopting a retired lane would resurrect it without the epoch
+        handshake, and its sub-tombstone counter restarts would be
+        silently absorbed by the dead lane's final values (erased spend).
+        Only :meth:`rejoin` — presenting the retirement epoch — may
+        re-attach a tombstoned lane."""
         with self._mu:
             slot = self.slot_of.get(old)
-            if slot is not None and new not in self.slot_of:
-                self.slot_of[new] = slot
+            if slot is None or new in self.slot_of:
+                return
+            if slot in self._tombstones:
+                return
+            self.slot_of[new] = slot
+
+    # -- elastic membership (ROADMAP 3b) ------------------------------------
+
+    def add_member(self, addr_str: str, epoch: Optional[int] = None) -> Optional[int]:
+        """Admit a joiner: assign the next FREE lane (never a tombstoned
+        one — ``_next_dynamic`` is monotone) and bump the epoch. Idempotent
+        for an already-active address. Returns the lane, or ``None`` when
+        the lane space is exhausted or the address's lane is tombstoned
+        (a retired lane needs the :meth:`rejoin` handshake).
+
+        ``epoch`` is the ANNOUNCED assign epoch when the event arrived
+        over the wire: the receiver max-joins it into its local epoch so
+        every node's epoch counter converges to the admin's — the value a
+        later tombstone will be stamped with. A local (admin-origin) add
+        passes ``None`` and increments."""
+        a = _resolve(addr_str)
+        with self._mu:
+            slot = self.slot_of.get(a)
+            if slot is not None:
+                if slot in self._tombstones:
+                    return None
+                if slot not in self._members:
+                    # A sender we only knew dynamically is now a member.
+                    self._members[slot] = addr_str
+                    self._bump_epoch_locked(epoch)
+                elif epoch is not None:
+                    self._epoch = max(self._epoch, epoch)
+                return slot
+            if self._next_dynamic >= self.max_slots:
+                return None
+            slot = self._next_dynamic
+            self._next_dynamic += 1
+            self.slot_of[a] = slot
+            self._members[slot] = addr_str
+            self._bump_epoch_locked(epoch)
+            return slot
+
+    def _bump_epoch_locked(self, epoch: Optional[int]) -> None:
+        # Local events increment; announced events max-join the admin's
+        # value so independently-booted tables converge to the SAME
+        # epoch sequence (the rejoin handshake compares tombstone epochs
+        # across nodes with different event histories).
+        if epoch is None:
+            self._epoch += 1
+        else:
+            self._epoch = max(self._epoch, epoch)
+
+    def remove_member(
+        self, addr_str: str, epoch: Optional[int] = None
+    ) -> Optional[Tuple[int, int]]:
+        """Retire a leaver's lane behind a tombstone. The addr→lane alias
+        is kept (stale echoes still attribute, harmlessly max-joined);
+        the lane leaves the active member set and can never be handed out
+        again without the epoch handshake. Returns ``(lane,
+        tombstone_epoch)`` — the leaver carries the epoch to its eventual
+        rejoin — or ``None`` for self/unknown addresses. Idempotent:
+        re-removing returns the original tombstone epoch.
+
+        ``epoch`` is the ANNOUNCED tombstone epoch for wire-received
+        leaves: the tombstone is stamped with the admin's value (not the
+        local counter) so the leaver's rejoin credential validates on
+        EVERY node, whatever subset of prior announces each one saw."""
+        a = _resolve(addr_str)
+        with self._mu:
+            slot = self.slot_of.get(a)
+            if slot is None or slot == self.self_slot:
+                return None
+            ts = self._tombstones.get(slot)
+            if ts is not None:
+                return (slot, ts)
+            owner = self._members.get(slot)
+            if owner is None or _resolve(owner) != a:
+                # The lane outlived this alias: it is active under a
+                # DIFFERENT address (the leaver already rejoined under a
+                # new one) or was never an admitted member. Only the
+                # CURRENT owner's leave retires a lane — a stale or
+                # replayed leave arriving after the rejoin must not
+                # re-tombstone it (the re-announce repair path and UDP
+                # reordering both produce exactly this sequence).
+                return None
+            self._bump_epoch_locked(epoch)
+            stamp = self._epoch if epoch is None else epoch
+            self._tombstones[slot] = stamp
+            self._members.pop(slot, None)
+            return (slot, stamp)
+
+    def rejoin(self, addr_str: str, lane: int, epoch: int) -> bool:
+        """The tombstone-epoch handshake: a node returning under a NEW
+        address re-attaches to its ORIGINAL lane by presenting the exact
+        epoch at which that lane was tombstoned. A match pops the
+        tombstone, bumps the epoch, and aliases the new address onto the
+        lane; anything else is rejected — this is the only arrow from
+        tombstoned(e) back to active."""
+        new = _resolve(addr_str)
+        with self._mu:
+            if (
+                self.slot_of.get(new) == lane
+                and lane not in self._tombstones
+            ):
+                # Already applied: the new address owns the lane. A
+                # replayed handshake (re-announce repair) is a success
+                # with NO epoch bump — idempotence, not a transition.
+                return True
+            ts = self._tombstones.get(lane)
+            if ts is None or ts != epoch:
+                return False
+            existing = self.slot_of.get(new)
+            if existing is not None and existing != lane:
+                return False  # the new address already owns another lane
+            del self._tombstones[lane]
+            self._epoch += 1
+            self.slot_of[new] = lane
+            self._members[lane] = addr_str
+            return True
+
+    def restore_epoch(self, epoch) -> None:
+        """Max-join a checkpoint-saved epoch back in at boot. The epoch
+        is the one truly monotone piece of the membership view: a
+        restarted node that regressed it to 0 could (as admin) re-issue
+        assign/tombstone epochs that collide with history, breaking the
+        exact-epoch rejoin handshake cluster-wide. Tombstones are NOT
+        restored — lanes may have legitimately rejoined while this node
+        was down, and a stale tombstone would evict the new owner."""
+        if isinstance(epoch, int):
+            with self._mu:
+                self._epoch = max(self._epoch, epoch)
+
+    @property
+    def epoch(self) -> int:
+        with self._mu:
+            return self._epoch
+
+    def is_tombstoned(self, lane: int) -> bool:
+        with self._mu:
+            return lane in self._tombstones
+
+    def tombstone_epoch(self, lane: int) -> Optional[int]:
+        with self._mu:
+            return self._tombstones.get(lane)
+
+    def view(self) -> dict:
+        """Admin snapshot of the membership state (GET /admin/peers)."""
+        with self._mu:
+            return {
+                "epoch": self._epoch,
+                "self_slot": self.self_slot,
+                "members": {str(s): a for s, a in sorted(self._members.items())},
+                "tombstones": {str(s): e for s, e in sorted(self._tombstones.items())},
+                "next_dynamic": self._next_dynamic,
+                "max_slots": self.max_slots,
+            }
 
 
 class Replicator(asyncio.DatagramProtocol):
@@ -485,6 +723,12 @@ class Replicator(asyncio.DatagramProtocol):
         # read-only divergence digests, AP-overshoot auditor. Like the
         # fleet gossip, the paced tick only runs when there are peers.
         self.audit = AuditPlane(self)
+        # Elastic membership (net/membership.py): runtime join / leave /
+        # rejoin events over the control channel, driving SlotTable lane
+        # lifecycle + this backend's fan-out list.
+        from patrol_tpu.net.membership import MembershipPlane
+
+        self.membership = MembershipPlane(self)
         if self.peers:
             self.fleet.start()
             self.audit.start()
@@ -541,6 +785,10 @@ class Replicator(asyncio.DatagramProtocol):
                     self._send(self._probe_bytes, addr)
                 for p in resolves:
                     await self._reresolve_peer(p)
+                if self.membership is not None:
+                    # Membership loss repair: re-announce recent local
+                    # events (bounded; duplicates are receiver no-ops).
+                    self.membership.maybe_replay()
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -565,6 +813,36 @@ class Replicator(asyncio.DatagramProtocol):
             self.log.info(
                 "peer re-resolved", extra={"peer": p.addr_str, "addr": f"{new[0]}:{new[1]}"}
             )
+
+    # -- elastic membership (net/membership.py drives these) ----------------
+
+    def _adopt_peer(self, addr_str: str) -> Optional[Addr]:
+        """Add a peer to the fan-out at runtime (membership join/rejoin).
+        Idempotent. Starts the paced planes if this is the first peer —
+        the constructor only starts them when booted with peers."""
+        if addr_str == self.node_addr:
+            return None
+        a = _resolve(addr_str)
+        ok = _is_ip(a[0])
+        if a not in self.health.peers:
+            self.health.add_peer(addr_str, a, resolved=ok)
+        if ok and a not in self.peers:
+            # Atomic list swap: broadcast paths snapshot self.peers.
+            self.peers = self.peers + [a]
+        if self.peers:
+            self.fleet.start()
+            self.audit.start()
+        return a if ok else None
+
+    def _drop_peer(self, addr_str: str) -> None:
+        """Remove a departed peer from the fan-out (membership leave).
+        Its lane stays tombstoned in the SlotTable — late datagrams from
+        the address still attribute correctly and max-join to no-ops."""
+        a = _resolve(addr_str)
+        self.peers = [p for p in self.peers if p != a]
+        self.health.remove_peer(a)
+        if self.delta is not None:
+            self.delta.on_peer_leave(a)
 
     def _handle_control(self, name: str, addr: Addr) -> None:
         """Reserved-name zero-state packets: probe pings/acks and the
@@ -637,6 +915,10 @@ class Replicator(asyncio.DatagramProtocol):
             if state.name == wire.AUDIT_CHANNEL_NAME and self.audit is not None:
                 # patrol-audit digests + admitted-window lanes.
                 self.audit.on_packet(data, addr)
+                return
+            if state.name == wire.MEMBER_CHANNEL_NAME and self.membership is not None:
+                # Elastic-membership events (join/leave/rejoin).
+                self.membership.on_packet(data, addr)
                 return
             self._handle_control(state.name, addr)
             return
@@ -849,6 +1131,8 @@ class Replicator(asyncio.DatagramProtocol):
             "faultnet_active": int(self.faultnet.active) if self.faultnet else 0,
         }
         out.update(self.health.stats())
+        if self.membership is not None:
+            out.update(self.membership.stats())
         if self.delta is not None:
             out.update(self.delta.stats())
         if self.fleet is not None:

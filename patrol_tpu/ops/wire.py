@@ -1191,3 +1191,117 @@ def decode_audit_packet(data: bytes) -> Optional[AuditPacket]:
     if off != end:
         return None  # trailing garbage ⇒ reject whole
     return AuditPacket(sender_slot, digests, tuple(windows))
+
+
+# ---------------------------------------------------------------------------
+# Membership channel (``\x00pt!mbr``) — elastic-membership events
+# (net/membership.py, ROADMAP 3b). Same envelope trick as dv2/mtr/adt:
+# a v1 zero-state packet whose reserved name no bucket can have, with the
+# real payload after the name — invisible to reference peers. One event
+# per datagram, bounded well under the v1 PACKET_SIZE so the native
+# recvmmsg backend (fixed 256-B slots) receives it unconditionally.
+# Events are idempotent facts about the lane-lifecycle lattice (join /
+# leave-tombstone / rejoin-handshake), so loss and duplication are both
+# safe: a re-announce is a no-op, a lost announce is repaired the next
+# time the sender emits (or at the admin's retry). Validation is
+# all-or-nothing like the other framings — a torn membership event must
+# never half-apply (a lane adoption without its epoch would be exactly
+# the lane-reuse bug the tombstone rule forbids).
+
+MEMBER_CHANNEL_NAME = "\x00pt!mbr"
+_MEMBER_NAME_BYTES = MEMBER_CHANNEL_NAME.encode()
+_MEMBER_BASE = FIXED_SIZE + len(_MEMBER_NAME_BYTES)  # payload offset (32)
+MEMBER_VERSION = 1
+MEMBER_JOIN = 1  # subject address admitted on a fresh lane
+MEMBER_LEAVE = 2  # subject's lane tombstoned at `epoch`
+MEMBER_REJOIN = 3  # subject re-attaches to `lane` by presenting `epoch`
+_MBR_HEAD = struct.Struct(">BHI")  # version | sender_slot | sender_epoch
+_MBR_EVENT = struct.Struct(">BHI")  # op | lane | tombstone/assign epoch
+_MEMBER_MAX_ADDR = PACKET_SIZE - _MEMBER_BASE - _MBR_HEAD.size - _MBR_EVENT.size - 2
+
+
+@dataclasses.dataclass(frozen=True)
+class MemberEvent:
+    op: int  # MEMBER_JOIN | MEMBER_LEAVE | MEMBER_REJOIN
+    lane: int  # subject lane (join: assigned lane; leave/rejoin: the lane)
+    epoch: int  # leave: tombstone epoch; rejoin: presented epoch; join: assign epoch
+    addr: str  # subject "host:port"
+
+
+@dataclasses.dataclass(frozen=True)
+class MemberPacket:
+    sender_slot: int
+    sender_epoch: int  # sender's membership epoch AFTER the event
+    event: MemberEvent
+
+
+def encode_member_packet(
+    sender_slot: int, sender_epoch: int, event: MemberEvent
+) -> bytes:
+    """One membership event as one ``\\x00pt!mbr`` datagram (≤256 B)."""
+    raw = event.addr.encode("utf-8", "surrogateescape")
+    if len(raw) > _MEMBER_MAX_ADDR:
+        raise ValueError(f"member address too long ({len(raw)} bytes)")
+    env = bytearray(_MEMBER_BASE)
+    env[24] = len(_MEMBER_NAME_BYTES)
+    env[FIXED_SIZE:] = _MEMBER_NAME_BYTES
+    body = bytearray(
+        _MBR_HEAD.pack(
+            MEMBER_VERSION, sender_slot & 0xFFFF, sender_epoch & 0xFFFFFFFF
+        )
+    )
+    body += _MBR_EVENT.pack(
+        event.op & 0xFF, event.lane & 0xFFFF, event.epoch & 0xFFFFFFFF
+    )
+    body.append(len(raw))
+    body += raw
+    body.append(sum(body) & 0xFF)
+    out = bytes(env) + bytes(body)
+    assert len(out) <= PACKET_SIZE
+    return out
+
+
+def is_member_packet(data: bytes) -> bool:
+    return (
+        len(data) > _MEMBER_BASE
+        and data[:24] == b"\x00" * 24
+        and data[24] == len(_MEMBER_NAME_BYTES)
+        and data[FIXED_SIZE:_MEMBER_BASE] == _MEMBER_NAME_BYTES
+    )
+
+
+def decode_member_packet(data: bytes) -> Optional[MemberPacket]:
+    """Strict all-or-nothing decode; ``None`` for anything malformed."""
+    end = len(data) - 1
+    if end < _MEMBER_BASE + _MBR_HEAD.size + _MBR_EVENT.size + 1:
+        return None
+    if (
+        data[:24] != b"\x00" * 24
+        or data[24] != len(_MEMBER_NAME_BYTES)
+        or data[FIXED_SIZE:_MEMBER_BASE] != _MEMBER_NAME_BYTES
+    ):
+        return None
+    if data[end] != sum(data[_MEMBER_BASE:end]) & 0xFF:
+        return None
+    try:
+        version, sender_slot, sender_epoch = _MBR_HEAD.unpack_from(
+            data, _MEMBER_BASE
+        )
+        if version != MEMBER_VERSION:
+            return None
+        off = _MEMBER_BASE + _MBR_HEAD.size
+        op, lane, epoch = _MBR_EVENT.unpack_from(data, off)
+        off += _MBR_EVENT.size
+        if op not in (MEMBER_JOIN, MEMBER_LEAVE, MEMBER_REJOIN):
+            return None
+        ln = data[off]
+        off += 1
+        if off + ln > end:
+            return None
+        addr = data[off : off + ln].decode("utf-8", "surrogateescape")
+        off += ln
+    except (IndexError, struct.error):
+        return None
+    if off != end:
+        return None  # trailing garbage ⇒ reject whole
+    return MemberPacket(sender_slot, sender_epoch, MemberEvent(op, lane, epoch, addr))

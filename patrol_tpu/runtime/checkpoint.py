@@ -25,11 +25,18 @@ import numpy as np
 FORMAT_VERSION = 1
 
 
-def save(directory: str, engine) -> str:
+def save(directory: str, engine, membership: dict | None = None) -> str:
     """Snapshot an engine's device state + host directory. Returns the dir.
 
     Safe to call while the engine is live: drains queued work first, then
     reads under the state lock.
+
+    ``membership`` (patrol-membership, ROADMAP 3b): the node's
+    ``SlotTable.view()`` at snapshot time. Rides as an extra meta key —
+    older builds restoring this checkpoint ignore it — and a restarting
+    node reads it back via :func:`load_membership` to pin itself onto its
+    ORIGINAL lane (``SlotTable(self_slot=...)``) before the rejoin
+    handshake, so its checkpointed PN spend and its live lane line up.
     """
     os.makedirs(directory, exist_ok=True)
     engine.flush()
@@ -60,6 +67,8 @@ def save(directory: str, engine) -> str:
             name: list(tomb) for name, tomb in d.export_tombstones().items()
         },
     }
+    if membership is not None:
+        meta["membership"] = membership
 
     # Atomic write: temp files + rename.
     fd, tmp_npz = tempfile.mkstemp(dir=directory, suffix=".npz.tmp")
@@ -74,6 +83,23 @@ def save(directory: str, engine) -> str:
         json.dump(meta, f)
     os.replace(tmp_json, os.path.join(directory, "directory.json"))
     return directory
+
+
+def load_membership(directory: str) -> dict | None:
+    """The membership view saved with the checkpoint, or ``None`` (absent
+    file, pre-membership checkpoint). Read at boot BEFORE the engine is
+    built: the ``self_slot`` inside pins the restarting node to its
+    original lane."""
+    path = os.path.join(directory, "directory.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return None
+    mem = meta.get("membership")
+    return mem if isinstance(mem, dict) else None
 
 
 def exists(directory: str) -> bool:

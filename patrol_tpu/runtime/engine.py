@@ -1039,6 +1039,13 @@ class DeviceEngine:
             slo_mod.SENTINEL.watch_budget(self._budget_snapshot)
         self._stopped = False
         self._busy = False
+        # Tick pause (MeshEngine.resize quiesce): while True the feeder
+        # parks between ticks — work queues keep absorbing submissions,
+        # nothing dispatches — so device geometry (mesh/plan/step/
+        # sharding) can swap atomically with NO tick in flight. Guarded
+        # by _cond like the work queues; _stopped overrides it so a
+        # shutdown never deadlocks behind a forgotten pause.
+        self._tick_paused = False
         self._ticks = 0  # device calls issued (observability)
         # Cross-node tracing: (trace_id, bucket) pairs drained into the
         # current tick; the feeder records their merge spans after _apply.
@@ -1907,6 +1914,14 @@ class DeviceEngine:
     # decode_fold_raw dispatch against its sharded planes is unmeasured,
     # and the delta plane falls back to the python decode there.
     _raw_ingest_capable = True
+    # Inline interval fold (ingest_interval's delta_fold dispatch on the
+    # rx thread): MeshEngine opts out — against SHARDED planes the fold
+    # is a collective program, and launching one from the rx context
+    # both holds the state mutex across a mesh rendezvous and (on the
+    # forced-host-device platform) can wedge the shared event loop.
+    # Opt-outs route the interval through the queued classify path so
+    # the lanes merge inside the feeder's own fused step.
+    _interval_fold_capable = True
 
     def _maybe_demote(self, tickets, deltas) -> None:
         """Feeder-only: at demote-window rollover, return quiet promoted
@@ -2517,6 +2532,21 @@ class DeviceEngine:
         whole interval lands as one batched plane commit instead of
         hundreds of queued per-delta objects. Returns deltas accepted;
         drops are loss-tolerant by CRDT design, like every ingest path."""
+        if not self._interval_fold_capable:
+            # Sharded planes (_interval_fold_capable=False): the entries
+            # are exact PN lane values with caps, which is precisely the
+            # lane-trailer case of the classify path — queue them for the
+            # feeder's fused step instead of folding here on rx.
+            return self.ingest_deltas_batch(
+                names,
+                slots,
+                added_nt,
+                taken_nt,
+                elapsed_ns,
+                caps_nt=caps_nt,
+                lane_added_nt=added_nt,
+                lane_taken_nt=taken_nt,
+            )
         now = self.clock()
         slots_a = np.asarray(slots, dtype=np.int64)
         keep = (slots_a >= 0) & (slots_a < self.config.nodes)
@@ -2640,6 +2670,13 @@ class DeviceEngine:
             lengths = np.ascontiguousarray(lengths, np.int32)
             if walk is None:
                 walk = ingest_ops.host_walk(planes, lengths)
+            if not walk.ok.any():
+                # Nothing dispatch-worthy: every row failed the framing
+                # walk, so the kernel would sentinel-pad the whole batch
+                # and fold nothing. Skip the dispatch (a garbage flood
+                # must not burn device programs) — the finally releases
+                # the planes inline, honoring the ring contract.
+                return 0
             P, row_w = planes.shape
             E = walk.name_len.shape[1]
             now = self.clock()
@@ -3538,7 +3575,11 @@ class DeviceEngine:
     def _run_loop(self) -> None:
         while True:
             with self._cond:
-                while not (
+                # Single predicate — pause and work-availability re-check
+                # together on every wake, so a pause raised while this
+                # thread waits for work can never be skipped (two
+                # sequential loops would have that race).
+                while (self._tick_paused and not self._stopped) or not (
                     self._takes
                     or self._deltas
                     or self._promote_pending
@@ -4025,17 +4066,25 @@ class DeviceEngine:
             return None
 
     def _observe_device_commit(
-        self, kernel: str, t_dispatch_ns: int, n: int
+        self, kernel: str, t_dispatch_ns: int, n: int, marker=None
     ) -> None:
         """patrol-fleet device-dispatch timing: ride the completion
         pipeline to record this commit dispatch's device-side
         dispatch→ready duration into the ``device_commit_ns`` stage
         histogram and the per-kernel histogram. The wait runs on the
         completer thread (which blocks on device results anyway);
-        dispatch-ahead keeps the feeder unblocked."""
+        dispatch-ahead keeps the feeder unblocked.
+
+        ``marker`` lets a caller supply a fresh output of the observed
+        program itself. MeshEngine must: the default ``_device_marker``
+        slice is a NEW program over the sharded state, and launching it
+        outside the state mutex races whatever collective another thread
+        dispatches under it (host-platform device pools interleave the
+        two rendezvous and deadlock)."""
         if not DEVICE_TIMING:
             return
-        marker = self._device_marker()
+        if marker is None:
+            marker = self._device_marker()
         if marker is None:
             return
         kh = hist.kernel_histogram(kernel)

@@ -65,6 +65,7 @@ from patrol_tpu.runtime.engine import (
     _pad_size,
 )
 from patrol_tpu.utils import histogram as hist
+from patrol_tpu.utils import profiling
 from patrol_tpu.utils import trace as trace_mod
 
 log = logging.getLogger("patrol.mesh")
@@ -82,6 +83,38 @@ log = logging.getLogger("patrol.mesh")
 # — warmup() pre-compiles _jit_merge_scalar_packed's pad diagonal too, so
 # a first reference-peer batch no longer compiles lazily mid-serve.
 MESH_WARM_MAX = 1 << 12
+
+
+class _HostSyncStateLock(profiling.ProfiledLock):
+    """State mutex for HOST-PLATFORM meshes: materializes the in-flight
+    device program before every release. XLA's forced host "devices"
+    (``--xla_force_host_platform_device_count``) execute on one shared
+    thread pool with no per-device stream FIFO, so two concurrently
+    in-flight collective programs can interleave their rendezvous across
+    the pool and deadlock — endless ``participant ... may be stuck``
+    spins, first hit by the churn gate's incast snapshot gathers racing
+    the fused step on an 8-device mesh. Holding every dispatch to
+    completion inside the state lock keeps at most ONE collective
+    program in flight; real accelerators have proper per-device streams
+    and keep the plain lock (async dispatch-ahead intact)."""
+
+    __slots__ = ("_engine",)
+
+    def __init__(self, name: str, engine: "MeshEngine"):
+        super().__init__(name)
+        self._engine = engine
+
+    def release(self) -> None:
+        st = getattr(self._engine, "state", None)
+        if st is not None:
+            try:
+                jax.block_until_ready(st.pn)
+            except Exception:  # a poisoned dispatch must still unlock
+                pass
+        super().release()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
 
 
 class MeshEngine(DeviceEngine):
@@ -110,6 +143,15 @@ class MeshEngine(DeviceEngine):
     # the scatter through host memory on the tunnel transport —
     # unmeasured; the delta plane falls back to the python decode path.
     _raw_ingest_capable = False
+    # The rx-thread interval fold opts out for the same reason the raw
+    # ingest does, plus a liveness one: delta_fold against SHARDED
+    # planes is a collective program, and dispatching it from the rx
+    # context holds the state mutex across a mesh rendezvous — racing
+    # the feeder's own collective step (a deadlock on host-platform
+    # device pools, which have no per-device stream FIFO). Decoded
+    # intervals route through the queued classify path and merge inside
+    # the fused tick instead.
+    _interval_fold_capable = False
 
     def __init__(
         self,
@@ -127,11 +169,22 @@ class MeshEngine(DeviceEngine):
                 f"buckets ({config.buckets}) must divide over {shards} shards"
             )
         super().__init__(config, node_slot=node_slot, clock=clock, on_broadcast=on_broadcast)
+        # Host-platform collective safety (_HostSyncStateLock): swap the
+        # state mutex BEFORE the first sharded dispatch (place_state
+        # below). Nothing touches state concurrently this early — no
+        # bucket exists for the feeder/lifecycle threads to reach.
+        if next(iter(self.mesh.devices.flat)).platform == "cpu":
+            self._state_mu = _HostSyncStateLock("engine.state", self)
         # Host-side mesh tick accounting, read by stats() from API
         # threads while the feeder mutates it — its own lock (leaf-only:
         # never held together with the engine's shared locks), registered
         # in analysis/race.py::GUARDS like every other shared attribute.
         self._mesh_mu = threading.Lock()
+        # Serializes resize() calls (admin-driven, rare); never held
+        # together with _cond/_state_mu acquisition ordering conflicts —
+        # resize takes _resize_mu → _cond → _state_mu, and no other path
+        # takes _resize_mu at all.
+        self._resize_mu = threading.Lock()
         self._mesh_metrics: Dict[str, int] = {
             "mesh_fused_dispatches": 0,
             "mesh_split_ticks": 0,
@@ -152,6 +205,90 @@ class MeshEngine(DeviceEngine):
             # engine in the process inherits a shrunken handle registry.
             self.stop()
             raise
+
+    # -- elasticity ---------------------------------------------------------
+
+    def resize(
+        self,
+        replicas: int = 1,
+        devices=None,
+        timeout: float = 30.0,
+    ) -> dict:
+        """Live mesh resharding (patrol-membership, ROADMAP 3c): grow or
+        shrink the device mesh WITHOUT restarting the engine or losing a
+        single queued take.
+
+        Protocol — quiesce, swap, resume:
+
+        1. **Pause** the feeder between ticks (``_tick_paused`` under
+           ``_cond``): work queues keep absorbing submissions — /take
+           callers just see one tick's extra latency — but nothing new
+           dispatches.
+        2. **Wait** for the in-flight tick (``_busy``) to clear. Pending
+           completions need no wait: their device results are already
+           materialized arrays, indifferent to where state lives next.
+        3. **Swap** under ``_state_mu``: build the new mesh's plan, fused
+           step, and matrix sharding, then ``device_put`` the state under
+           the new :class:`~jax.sharding.NamedSharding` — a straight
+           cross-sharding transfer, no recompile dance and no host
+           round-trip of the planes. State is a join-semilattice, and the
+           transfer is a bit-exact relayout: per-bucket digests before
+           and after are identical (the churn bench gates on this).
+        4. **Resume** the feeder; the next tick routes against the new
+           plan and JITs the new step's first shapes (call
+           :meth:`warmup` after, if p99 matters more than the pause).
+
+        Validates ``buckets %% shards == 0`` BEFORE pausing, so an
+        invalid target never stalls serving. Returns a receipt dict.
+        """
+        new_mesh = topo.make_mesh(replicas=replicas, devices=devices)
+        shards = new_mesh.shape[topo.BUCKET_AXIS]
+        if self.config.buckets % shards:
+            raise ValueError(
+                f"buckets ({self.config.buckets}) must divide over "
+                f"{shards} shards"
+            )
+        with self._resize_mu:
+            old_shape = (self.plan.replicas, self.plan.shards)
+            with self._cond:
+                self._tick_paused = True
+            try:
+                # In-flight tick drains; _busy flips under _cond, and with
+                # the pause already visible the feeder cannot start another.
+                deadline = time.monotonic() + timeout
+                while True:
+                    with self._cond:
+                        if not self._busy:
+                            break
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            "resize quiesce timed out waiting for the "
+                            "in-flight tick"
+                        )
+                    time.sleep(0.0005)
+                plan = topo.plan_for(new_mesh, self.config)
+                step = topo.build_cluster_step_packed(new_mesh, self.node_slot)
+                sharding = topo.batch_sharding(new_mesh)
+                with self._state_mu:
+                    self.state = topo.place_state(self.state, new_mesh)
+                    self.mesh = new_mesh
+                    self.plan = plan
+                    self._step = step
+                    self._mat_sharding = sharding
+            finally:
+                with self._cond:
+                    self._tick_paused = False
+                    self._cond.notify_all()
+        from patrol_tpu.utils import profiling
+
+        profiling.COUNTERS.inc("mesh_resizes")
+        receipt = {
+            "from": {"replicas": old_shape[0], "shards": old_shape[1]},
+            "to": {"replicas": plan.replicas, "shards": plan.shards},
+            "devices": len(new_mesh.devices.flatten()),
+        }
+        log.info("mesh resized", extra=receipt)
+        return receipt
 
     # -- tick ---------------------------------------------------------------
 
@@ -176,6 +313,14 @@ class MeshEngine(DeviceEngine):
         finally:
             if scalar_subset is not None:
                 self._apply_scalar_merges(scalar_subset)
+
+    def _device_marker(self):
+        # Never slice the sharded state into a fresh marker program: any
+        # caller without an explicit marker would dispatch it OUTSIDE the
+        # state mutex and interleave its collective rendezvous with a
+        # concurrently-locked gather (see _observe_device_commit). Mesh
+        # dispatch sites pass their own program output as the marker.
+        return None
 
     def _apply_fused(
         self,
@@ -372,11 +517,14 @@ class MeshEngine(DeviceEngine):
 
         if not keys_d:
             # Merge-only dispatch: device timing rides the completion
-            # pipeline (dispatch→ready on a fresh marker), like every
-            # single-device commit kernel.
+            # pipeline. The marker is the step's OWN fresh output — never
+            # the default _device_marker slice, which would launch a new
+            # collective over the sharded state outside the state mutex
+            # and interleave with a concurrently-locked gather.
             self._observe_device_commit(
                 "mesh_step", t_dispatch,
                 len(deltas_d[0]) if deltas_d else 0,
+                marker=out,
             )
             return
 

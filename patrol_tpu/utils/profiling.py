@@ -308,6 +308,16 @@ class CounterRegistry:
         "audit_packets_tx",
         "audit_packets_rx",
         "audit_overshoot_breaches",
+        # patrol-membership (net/membership.py + runtime/mesh_engine.py):
+        # members admitted (join + successful rejoin handshakes), members
+        # retired, lanes tombstoned behind a retirement epoch, and live
+        # device-mesh reshardings (MeshEngine.resize quiesce-swap-resume
+        # cycles). Churn observability: /debug/vars + Prometheus carry
+        # them zero-filled, and bench --churn-smoke gates on them.
+        "peer_joins",
+        "peer_leaves",
+        "lane_tombstones",
+        "mesh_resizes",
     )
 
     def __init__(self):

@@ -5,7 +5,7 @@ The BENCH_r* receipts were write-only: every round pinned numbers into
 the repo, and nothing ever compared the next run against them — a
 regression shipped silently as a slightly different JSON line. This
 gate turns the seconds-class CI smokes (``bench.py --smoke`` /
-``--wire-smoke`` / ``--chaos-smoke``) into a *trend*:
+``--wire-smoke`` / ``--chaos-smoke`` / ``--churn-smoke``) into a *trend*:
 
 * ``benchmarks/TREND_BASELINE.json`` pins the receipt fields (seeded
   from the BENCH_r05-era gates on this container class; re-pin by
@@ -129,6 +129,18 @@ EXACT_GATES: Dict[str, object] = {
     # sides estimate of the seeded 2-side partition is exactly 2.
     "audit_divergent_buckets": 0,
     "audit_sides_estimate": 2,
+    # elastic membership churn (r16): the zero-downtime tentpole is an
+    # EXACT claim, not a trend — every node's per-bucket digest agrees at
+    # the post-churn quiesce (and the meshed node's quiesced relayout
+    # cycle is bit-identical), the client load saw zero non-429 errors
+    # across the whole join/leave/rejoin + 4→8 resize schedule, no
+    # admitted token was lost, and the membership lattice ends clean
+    # (5 members, no standing tombstones).
+    "churn_digest_fixpoint": "bit-exact",
+    "churn_non429_errors": 0,
+    "churn_token_conservation": True,
+    "churn_members_final": 5,
+    "churn_tombstones_final": 0,
 }
 
 # Fields that must be present AND strictly positive (no baseline needed):
@@ -151,6 +163,15 @@ NONZERO_GATES = (
     "audit_divergence_checks",
     "audit_divergent_buckets_divergent_phase",
     "audit_windows_evaluated",
+    # churn smoke liveness: takes were admitted AND shed (the exhausted
+    # bucket drew 429s), and every membership arrow actually fired —
+    # joins adopted fleet-wide, a lane retired, the mesh resharded.
+    "churn_admitted",
+    "churn_shed",
+    "churn_counter_peer_joins",
+    "churn_counter_peer_leaves",
+    "churn_counter_lane_tombstones",
+    "churn_counter_mesh_resizes",
 )
 
 # Device-stage columns (patrol-fleet device-dispatch timing): the smoke's

@@ -19,7 +19,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def healthy_receipts():
     """A receipt set shaped like a real --smoke/--wire-smoke/--chaos-smoke
-    merge, at the pinned baseline's values."""
+    /--mesh/--soak/--churn-smoke merge, at the pinned baseline's values."""
     base = json.load(open(os.path.join(REPO, "benchmarks", "TREND_BASELINE.json")))
     out = {k: v for k, v in base.items() if not k.startswith("_")}
     out.update(
@@ -53,6 +53,17 @@ def healthy_receipts():
             "audit_divergence_checks": 8,
             "audit_divergent_buckets_divergent_phase": 1,
             "audit_windows_evaluated": 1,
+            "churn_digest_fixpoint": "bit-exact",
+            "churn_non429_errors": 0,
+            "churn_token_conservation": True,
+            "churn_members_final": 5,
+            "churn_tombstones_final": 0,
+            "churn_admitted": 900,
+            "churn_shed": 40,
+            "churn_counter_peer_joins": 4,
+            "churn_counter_peer_leaves": 1,
+            "churn_counter_lane_tombstones": 1,
+            "churn_counter_mesh_resizes": 3,
             "ingest_stage_breakdown": {
                 "device_commit_ns": {"count": 3, "p50_ns": 1, "p99_ns": 2},
                 "device_take_ns": {"count": 32, "p50_ns": 1, "p99_ns": 2},

@@ -1,0 +1,739 @@
+"""patrol-membership: elastic cluster membership (net/membership.py).
+
+Unit layers: the SlotTable lane-lifecycle lattice (free → active →
+tombstoned(e) → active again ONLY through the exact-epoch rejoin
+handshake), the ``\\x00pt!mbr`` wire codec's strict decode, the
+MembershipPlane's event application + counters, and PeerHealth's suspect
+demotion (which gates NOTHING on the data path).
+
+Chaos layers (frozen clocks, like the rest of the chaos suite): a
+rolling restart — checkpoint, leave, rejoin under a NEW address on the
+ORIGINAL lane via the tombstone-epoch handshake — with zero
+admitted-token loss and bit-exact lane continuity; and a slow joiner
+admitted mid-partition whose late heal converges bit-exactly within the
+AE packet budget.
+"""
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+
+from patrol_tpu.models.limiter import NANO, LimiterConfig
+from patrol_tpu.net.faultnet import FaultNet
+from patrol_tpu.net.membership import MembershipPlane
+from patrol_tpu.net.replication import PeerHealth, SlotTable
+from patrol_tpu.ops import wire
+from patrol_tpu.ops.rate import Rate
+from patrol_tpu.runtime import checkpoint as ckpt
+from patrol_tpu.runtime.engine import DeviceEngine
+from patrol_tpu.utils import profiling
+
+CFG = LimiterConfig(buckets=64, nodes=4)
+RATE_SLOW = Rate(freq=100, per_ns=3600 * NANO)  # ~no refill on frozen clocks
+
+A = "127.0.0.1:9000"
+B = "127.0.0.1:9001"
+C = "127.0.0.1:9002"
+D = "127.0.0.1:9005"
+
+
+# ---------------------------------------------------------------------------
+# SlotTable: the lane-lifecycle lattice
+
+
+class TestSlotTableElastic:
+    def _table(self):
+        return SlotTable(A, [A, B], max_slots=6)
+
+    def test_add_member_assigns_next_free_lane(self):
+        st = self._table()
+        lane = st.add_member(C)
+        assert lane == 2
+        assert st.view()["members"]["2"] == C
+        assert st.epoch == 1
+
+    def test_add_member_idempotent_same_lane_no_epoch_bump(self):
+        st = self._table()
+        assert st.add_member(C) == 2
+        e = st.epoch
+        assert st.add_member(C) == 2  # duplicate announce: a no-op
+        assert st.epoch == e
+
+    def test_remove_member_tombstones_lane(self):
+        st = self._table()
+        st.add_member(C)
+        lane, ts = st.remove_member(C)
+        assert lane == 2 and ts == st.epoch
+        assert st.is_tombstoned(2)
+        assert "2" not in st.view()["members"]
+        # Idempotent: re-remove returns the ORIGINAL tombstone epoch.
+        assert st.remove_member(C) == (2, ts)
+
+    def test_remove_self_refused(self):
+        st = self._table()
+        assert st.remove_member(A) is None
+
+    def test_retired_lane_never_reassigned_to_fresh_joiner(self):
+        """Satellite regression (illegal adoption): a NEW member must get
+        a NEW lane, never the retired one — lane reuse without a
+        tombstone-epoch bump is structurally impossible."""
+        st = self._table()
+        st.add_member(C)
+        st.remove_member(C)
+        assert st.add_member(C) is None  # the retired addr needs rejoin
+        lane = st.add_member(D)  # a fresh joiner skips the tombstone
+        assert lane == 3 and lane != 2
+
+    def test_realias_refuses_tombstoned_lane(self):
+        """Satellite regression: realias (probe-driven address drift)
+        must not resurrect a retired lane under a new address."""
+        st = self._table()
+        st.add_member(C)
+        st.remove_member(C)
+        c_addr = ("127.0.0.1", 9002)
+        d_addr = ("127.0.0.1", 9005)
+        st.realias(c_addr, d_addr)
+        assert d_addr not in st.slot_of
+
+    def test_realias_live_lane_still_works(self):
+        st = self._table()
+        st.add_member(C)
+        st.realias(("127.0.0.1", 9002), ("127.0.0.1", 9005))
+        assert st.slot_of[("127.0.0.1", 9005)] == 2
+
+    def test_rejoin_requires_exact_tombstone_epoch(self):
+        """Satellite regression (legal rejoin): the original lane comes
+        back ONLY through the exact retirement-epoch handshake."""
+        st = self._table()
+        st.add_member(C)
+        _, ts = st.remove_member(C)
+        assert not st.rejoin(D, 2, ts + 1)  # wrong epoch
+        assert not st.rejoin(D, 1, ts)  # wrong lane (not tombstoned)
+        assert st.is_tombstoned(2)
+        assert st.rejoin(D, 2, ts)  # new address, right credentials
+        assert not st.is_tombstoned(2)
+        assert st.view()["members"]["2"] == D
+        assert st.epoch == ts + 1  # every lifecycle arrow bumps the epoch
+
+    def test_rejoin_refused_when_new_addr_owns_another_lane(self):
+        st = self._table()
+        st.add_member(C)
+        _, ts = st.remove_member(C)
+        assert not st.rejoin(B, 2, ts)  # B already owns its own lane
+
+    def test_self_slot_override_pins_rejoin_boot(self):
+        """A restarting node pins itself to its checkpointed lane even
+        when rank-order would assign differently."""
+        st = SlotTable(D, [A, B, D], max_slots=6, self_slot=1)
+        assert st.self_slot == 1
+        others = sorted(
+            v for k, v in st.slot_of.items() if k != ("127.0.0.1", 9005)
+        )
+        assert others == [0, 2]  # remaining members skip the pinned lane
+        assert st._next_dynamic == 3
+
+    def test_announced_tombstone_epoch_stamped_not_local(self):
+        """Cross-node agreement: a table that never saw the joins that
+        advanced the admin's epoch still stamps the ANNOUNCED tombstone
+        epoch, so the leaver's rejoin credential validates everywhere."""
+        st = self._table()  # local epoch 0 — missed every prior announce
+        assert st.remove_member(B, epoch=5) == (1, 5)
+        assert st.tombstone_epoch(1) == 5
+        assert st.epoch == 5  # max-joined up to the admin's counter
+        assert st.rejoin(D, 1, 5)
+
+    def test_announced_join_epoch_max_joins(self):
+        st = self._table()
+        assert st.add_member(C, epoch=7) == 2
+        assert st.epoch == 7
+        assert st.add_member(D) == 3  # a local add increments past it
+        assert st.epoch == 8
+
+    def test_epoch_monotone_across_lifecycle(self):
+        st = self._table()
+        seen = [st.epoch]
+        st.add_member(C)
+        seen.append(st.epoch)
+        _, ts = st.remove_member(C)
+        seen.append(st.epoch)
+        st.rejoin(D, 2, ts)
+        seen.append(st.epoch)
+        assert seen == sorted(seen) and len(set(seen)) == len(seen)
+
+    def test_stale_leave_after_rejoin_is_refused(self):
+        """Loss-repair safety: a replayed (or reordered) leave for the
+        OLD address must not re-tombstone a lane that already rejoined
+        under a new one — only the current owner's leave retires it."""
+        st = self._table()
+        st.add_member(C)
+        _, ts = st.remove_member(C)
+        assert st.rejoin(D, 2, ts)
+        e = st.epoch
+        assert st.remove_member(C, epoch=ts) is None  # stale replay
+        assert not st.is_tombstoned(2)
+        assert st.view()["members"]["2"] == D
+        assert st.epoch == e
+        # A FRESH leave naming the current owner still works.
+        assert st.remove_member(D) == (2, e + 1)
+
+    def test_rejoin_replay_is_idempotent_success(self):
+        """A replayed handshake that already applied is a success with
+        NO epoch bump — idempotence, not a transition."""
+        st = self._table()
+        st.add_member(C)
+        _, ts = st.remove_member(C)
+        assert st.rejoin(D, 2, ts)
+        e = st.epoch
+        assert st.rejoin(D, 2, ts)  # replay
+        assert st.epoch == e
+        assert st.view()["members"]["2"] == D
+
+    def test_restore_epoch_max_joins_checkpointed_value(self):
+        """Boot restore: the epoch counter survives restarts monotonically
+        (a reborn admin must never re-issue historical epochs)."""
+        st = self._table()
+        st.restore_epoch(7)
+        assert st.epoch == 7
+        st.restore_epoch(3)  # never regresses
+        assert st.epoch == 7
+        st.restore_epoch(None)  # absent meta: no-op
+        st.restore_epoch("9")  # malformed meta: no-op
+        assert st.epoch == 7
+        assert st.add_member(C) == 2
+        assert st.epoch == 8  # local adds increment past the restore
+
+
+# ---------------------------------------------------------------------------
+# wire codec: the \x00pt!mbr control channel
+
+
+class TestMemberWire:
+    EV = wire.MemberEvent(wire.MEMBER_JOIN, 2, 7, "127.0.0.1:9002")
+
+    def test_roundtrip(self):
+        data = wire.encode_member_packet(0, 7, self.EV)
+        assert len(data) <= wire.PACKET_SIZE
+        pkt = wire.decode_member_packet(data)
+        assert pkt is not None
+        assert pkt.sender_slot == 0 and pkt.sender_epoch == 7
+        assert pkt.event == self.EV
+
+    def test_all_ops_roundtrip(self):
+        for op in (wire.MEMBER_JOIN, wire.MEMBER_LEAVE, wire.MEMBER_REJOIN):
+            ev = wire.MemberEvent(op, 3, 11, "10.0.0.1:16000")
+            pkt = wire.decode_member_packet(
+                wire.encode_member_packet(1, 11, ev)
+            )
+            assert pkt is not None and pkt.event == ev
+
+    def test_invisible_to_v1_decode(self):
+        """A membership datagram reads as a zero-state v1 packet named
+        with the reserved control channel — v1 peers shrug it off."""
+        data = wire.encode_member_packet(0, 1, self.EV)
+        st = wire.decode(data)
+        assert st.name == wire.MEMBER_CHANNEL_NAME
+        assert st.added == 0 and st.taken == 0 and st.elapsed_ns == 0
+
+    def test_is_member_packet_envelope(self):
+        data = wire.encode_member_packet(0, 1, self.EV)
+        assert wire.is_member_packet(data)
+        assert not wire.is_member_packet(b"\x00" * 64)
+
+    def test_strict_decode_rejects_damage(self):
+        data = wire.encode_member_packet(0, 7, self.EV)
+        assert wire.decode_member_packet(data[:-2]) is None  # truncated
+        flipped = bytearray(data)
+        flipped[-1] ^= 0xFF  # checksum
+        assert wire.decode_member_packet(bytes(flipped)) is None
+        assert wire.decode_member_packet(data + b"x") is None  # trailing
+        bad_op = bytearray(data)
+        # op byte lives right after the head struct in the payload.
+        off = wire.FIXED_SIZE + len(wire.MEMBER_CHANNEL_NAME) + 7
+        bad_op[off] = 99
+        bad_op[-1] = sum(bad_op[wire.FIXED_SIZE + len(wire.MEMBER_CHANNEL_NAME):-1]) & 0xFF
+        assert wire.decode_member_packet(bytes(bad_op)) is None
+
+    def test_overlong_address_refused_at_encode(self):
+        with pytest.raises(ValueError):
+            wire.encode_member_packet(
+                0, 1, wire.MemberEvent(wire.MEMBER_JOIN, 0, 1, "h" * 300)
+            )
+
+
+# ---------------------------------------------------------------------------
+# MembershipPlane: event application + counters
+
+
+class _FakeRep:
+    def __init__(self):
+        self.node_addr = A
+        self.slots = SlotTable(A, [A, B], max_slots=6)
+        self.peers = [("127.0.0.1", 9001)]
+        self.sent = []
+        self.adopted = []
+        self.dropped = []
+
+    def _adopt_peer(self, addr_str):
+        self.adopted.append(addr_str)
+
+    def _drop_peer(self, addr_str):
+        self.dropped.append(addr_str)
+
+    def unicast(self, data, addr):
+        self.sent.append((data, addr))
+
+
+class TestMembershipPlane:
+    def test_local_join_announces_and_adopts(self):
+        rep = _FakeRep()
+        mp = MembershipPlane(rep)
+        joins0 = profiling.COUNTERS.get("peer_joins")
+        receipt = mp.local_join(C)
+        assert receipt == {"op": "add", "addr": C, "lane": 2, "epoch": 1}
+        assert rep.adopted == [C]
+        assert len(rep.sent) == 1  # one peer, one announce
+        assert profiling.COUNTERS.get("peer_joins") == joins0 + 1
+        # Duplicate admin add: no epoch move, no counter, but re-announce
+        # (the loss-repair path).
+        mp.local_join(C)
+        assert profiling.COUNTERS.get("peer_joins") == joins0 + 1
+        assert len(rep.sent) == 2
+
+    def test_local_leave_receipt_carries_tombstone_epoch(self):
+        rep = _FakeRep()
+        mp = MembershipPlane(rep)
+        mp.local_join(C)
+        leaves0 = profiling.COUNTERS.get("peer_leaves")
+        ts0 = profiling.COUNTERS.get("lane_tombstones")
+        receipt = mp.local_leave(C)
+        assert receipt["lane"] == 2
+        assert receipt["tombstone_epoch"] == rep.slots.tombstone_epoch(2)
+        assert rep.dropped == [C]
+        assert profiling.COUNTERS.get("peer_leaves") == leaves0 + 1
+        assert profiling.COUNTERS.get("lane_tombstones") == ts0 + 1
+        assert mp.local_leave("127.0.0.1:9999") is None  # unknown
+        assert mp.local_leave(A) is None  # self
+
+    def test_rx_join_leave_rejoin(self):
+        rep = _FakeRep()
+        mp = MembershipPlane(rep)
+        # JOIN from a peer's announce.
+        data = wire.encode_member_packet(
+            1, 1, wire.MemberEvent(wire.MEMBER_JOIN, 2, 1, C)
+        )
+        assert mp.on_packet(data, ("127.0.0.1", 9001))
+        assert rep.slots.view()["members"]["2"] == C
+        assert rep.adopted == [C]
+        # LEAVE retires the lane.
+        data = wire.encode_member_packet(
+            1, 2, wire.MemberEvent(wire.MEMBER_LEAVE, 2, 2, C)
+        )
+        assert mp.on_packet(data, ("127.0.0.1", 9001))
+        assert rep.slots.is_tombstoned(2)
+        assert rep.dropped == [C]
+        ts = rep.slots.tombstone_epoch(2)
+        # REJOIN with the wrong epoch is rejected and counted.
+        bad = wire.encode_member_packet(
+            2, 9, wire.MemberEvent(wire.MEMBER_REJOIN, 2, ts + 5, D)
+        )
+        assert mp.on_packet(bad, ("127.0.0.1", 9005))
+        assert mp.rejected == 1
+        assert rep.slots.is_tombstoned(2)
+        # REJOIN with the exact epoch re-activates the lane for the new
+        # address.
+        good = wire.encode_member_packet(
+            2, 9, wire.MemberEvent(wire.MEMBER_REJOIN, 2, ts, D)
+        )
+        assert mp.on_packet(good, ("127.0.0.1", 9005))
+        assert not rep.slots.is_tombstoned(2)
+        assert rep.slots.view()["members"]["2"] == D
+
+    def test_rx_malformed_counted(self):
+        rep = _FakeRep()
+        mp = MembershipPlane(rep)
+        assert not mp.on_packet(b"\x00garbage", ("127.0.0.1", 9001))
+        assert mp.rx_errors == 1
+
+    def test_self_events_ignored(self):
+        rep = _FakeRep()
+        mp = MembershipPlane(rep)
+        data = wire.encode_member_packet(
+            1, 3, wire.MemberEvent(wire.MEMBER_LEAVE, 0, 3, A)
+        )
+        assert mp.on_packet(data, ("127.0.0.1", 9001))
+        assert not rep.slots.is_tombstoned(0)  # our own lane stays ours
+
+    def test_stats_shape(self):
+        mp = MembershipPlane(_FakeRep())
+        s = mp.stats()
+        for key in (
+            "membership_epoch",
+            "membership_members",
+            "membership_tombstones",
+            "membership_events_tx",
+            "membership_events_rx",
+            "membership_rx_errors",
+            "membership_rejected",
+            "membership_replays",
+        ):
+            assert key in s
+
+    def test_maybe_replay_reannounces_local_events(self):
+        """Loss repair: every locally-originated event is re-announced
+        (paced, bounded) so a dropped datagram heals without an admin."""
+        from patrol_tpu.net import membership as mbr
+
+        rep = _FakeRep()
+        mp = MembershipPlane(rep)
+        mp.local_join(C)
+        sent0 = len(rep.sent)
+        assert mp.maybe_replay() == 0  # paced: too soon after init
+        mp._last_replay = 0.0
+        assert mp.maybe_replay() == 1
+        assert len(rep.sent) == sent0 + 1
+        assert mp.replays == 1
+        # The replay burst is BOUNDED: after REPLAYS rounds the log dries
+        # up and the channel goes quiet.
+        for _ in range(mbr.REPLAYS):
+            mp._last_replay = 0.0
+            mp.maybe_replay()
+        mp._last_replay = 0.0
+        assert mp.maybe_replay() == 0
+        assert not mp._log
+
+    def test_replayed_rejoin_not_counted_twice(self):
+        """A replayed rejoin announce that already applied must not
+        re-increment peer_joins (no epoch move ⇒ no transition)."""
+        rep = _FakeRep()
+        mp = MembershipPlane(rep)
+        rep.slots.add_member(C)
+        _, ts = rep.slots.remove_member(C)
+        pkt = wire.encode_member_packet(
+            2, 9, wire.MemberEvent(wire.MEMBER_REJOIN, 2, ts, D)
+        )
+        joins0 = profiling.COUNTERS.get("peer_joins")
+        assert mp.on_packet(pkt, ("127.0.0.1", 9005))
+        assert profiling.COUNTERS.get("peer_joins") == joins0 + 1
+        assert mp.on_packet(pkt, ("127.0.0.1", 9005))  # loss-repair replay
+        assert profiling.COUNTERS.get("peer_joins") == joins0 + 1
+        assert mp.rejected == 0
+
+    def test_announce_rejoin_adopts_transition_epoch(self):
+        """The rejoiner's own epoch converges to tombstone_epoch + 1 —
+        the exact value every accepting receiver lands on."""
+        rep = _FakeRep()
+        mp = MembershipPlane(rep)
+        mp.announce_rejoin(0, 5)
+        assert rep.slots.epoch == 6
+
+
+class TestPeerHealthSuspect:
+    def test_suspect_after_failures_and_never_gates(self):
+        h = PeerHealth()
+        addr = ("127.0.0.1", 9001)
+        h.add_peer(B, addr, resolved=True)
+        assert not h.is_suspect(addr)
+        with h._mu:
+            h.peers[addr].failures = h.suspect_after
+        assert h.is_suspect(addr)
+        assert h.stats()["peer_suspect"] == 1
+        # Suspect is observability-only: the peer stays in the table and
+        # nothing on the data path consults is_suspect.
+        assert addr in h.peers
+        h.remove_peer(addr)
+        assert addr not in h.peers
+        assert not h.is_suspect(addr)
+
+
+# ---------------------------------------------------------------------------
+# chaos: rolling restart + slow joiner (frozen clocks)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Loop:
+    """A background asyncio loop for Replicator.create and friends."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(
+            target=lambda: (
+                asyncio.set_event_loop(self.loop),
+                self.loop.run_forever(),
+            ),
+            daemon=True,
+        )
+        self.thread.start()
+
+    def run(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(15)
+
+    def close(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+def _make_node(loopbox, addr, roster, *, self_slot=None, max_slots=4):
+    from patrol_tpu.net.replication import Replicator
+    from patrol_tpu.runtime.repo import TPURepo
+
+    slots = SlotTable(addr, roster, max_slots=max_slots, self_slot=self_slot)
+    rep = loopbox.run(Replicator.create(addr, roster, slots))
+    rep.health.configure(
+        probe_interval_s=0.15, alive_ttl_s=0.5, backoff_cap_s=0.4
+    )
+    rep.antientropy.min_interval_s = 0.2
+    eng = DeviceEngine(CFG, node_slot=slots.self_slot, clock=lambda: NANO)
+    eng.configure_lifecycle(window_ms=0)  # manual, deterministic
+    repo = TPURepo(eng, send_incast=rep.send_incast_request)
+    rep.repo = repo
+    eng.on_broadcast = rep.broadcast_states
+    return rep, eng, repo
+
+
+def _stop_node(loopbox, rep, eng):
+    loopbox.loop.call_soon_threadsafe(rep.close)
+    eng.stop()
+
+
+def _converge_rows(nodes, name, deadline_s=15.0):
+    """Poll until every node's lane plane for ``name`` is identical;
+    force AE rounds while waiting. Returns (pn_list, elapsed)."""
+    deadline = time.time() + deadline_s
+    next_trigger = 0.0
+    views = []
+    while time.time() < deadline:
+        if time.time() >= next_trigger:
+            next_trigger = time.time() + 0.5
+            for rep, _, _ in nodes:
+                for peer in rep.peers:
+                    rep.antientropy.trigger(peer, force=True)
+        views = []
+        for _, eng, _ in nodes:
+            eng.flush()
+            row = eng.directory.lookup(name)
+            if row is None:
+                views.append(None)
+                continue
+            pn, el = eng.row_view(row)
+            views.append((pn.tolist(), int(el)))
+        if None not in views and all(v == views[0] for v in views):
+            return views[0]
+        time.sleep(0.05)
+    raise AssertionError(f"no convergence: {views}")
+
+
+@pytest.mark.chaos
+class TestRollingRestartChaos:
+    """The tentpole scenario: node B checkpoints, is retired (lane
+    tombstoned), and rejoins under a NEW address on its ORIGINAL lane via
+    the tombstone-epoch handshake — zero admitted-token loss, bit-exact
+    lane continuity, overshoot within the AP bound (one side throughout:
+    admitted never exceeds the limit)."""
+
+    def test_rolling_restart_zero_token_loss(self, tmp_path):
+        loopbox = _Loop()
+        addr_a = f"127.0.0.1:{_free_port()}"
+        addr_b = f"127.0.0.1:{_free_port()}"
+        roster = [addr_a, addr_b]
+        node_a = _make_node(loopbox, addr_a, roster)
+        node_b = _make_node(loopbox, addr_b, roster)
+        rep_a, eng_a, repo_a = node_a
+        rep_b, eng_b, repo_b = node_b
+        b_lane = rep_b.slots.self_slot
+        nodes = [node_a, node_b]
+        try:
+            # Phase 1: spend on both, converge.
+            admitted = 0
+            for _ in range(3):
+                _, ok, _ = eng_a.take("rr", RATE_SLOW, 1)
+                assert ok
+                admitted += 1
+            for _ in range(4):
+                _, ok, _ = eng_b.take("rr", RATE_SLOW, 1)
+                assert ok
+                admitted += 1
+            _converge_rows(nodes, "rr")
+
+            # Phase 2: checkpoint B (membership meta included), retire it
+            # through the admin plane on A, stop the process.
+            ckpt.save(str(tmp_path), eng_b, rep_b.membership.view())
+            receipt = rep_a.membership.local_leave(addr_b)
+            assert receipt["lane"] == b_lane
+            ts_epoch = receipt["tombstone_epoch"]
+            assert rep_a.slots.is_tombstoned(b_lane)
+            _stop_node(loopbox, rep_b, eng_b)
+            nodes = [node_a]
+
+            # Phase 3: B returns under a NEW address, pinned to its
+            # original lane by the checkpoint's membership meta.
+            mem = ckpt.load_membership(str(tmp_path))
+            assert mem is not None and mem["self_slot"] == b_lane
+            addr_b2 = f"127.0.0.1:{_free_port()}"
+            node_b2 = _make_node(
+                loopbox, addr_b2, [addr_a, addr_b2],
+                self_slot=mem["self_slot"],
+            )
+            rep_b2, eng_b2, repo_b2 = node_b2
+            assert rep_b2.slots.self_slot == b_lane
+            assert ckpt.restore(str(tmp_path), eng_b2) >= 1
+
+            # Handshake: a wrong epoch is rejected (the lane stays
+            # retired — structural impossibility of silent reuse) …
+            rejected0 = rep_a.membership.rejected
+            rep_b2.membership.announce_rejoin(b_lane, ts_epoch + 7)
+            deadline = time.time() + 5
+            while (
+                rep_a.membership.rejected == rejected0
+                and time.time() < deadline
+            ):
+                time.sleep(0.02)
+            assert rep_a.membership.rejected > rejected0
+            assert rep_a.slots.is_tombstoned(b_lane)
+            # … the exact epoch re-activates the lane for the new addr.
+            rep_b2.membership.announce_rejoin(b_lane, ts_epoch)
+            deadline = time.time() + 5
+            while rep_a.slots.is_tombstoned(b_lane) and time.time() < deadline:
+                time.sleep(0.02)
+            assert not rep_a.slots.is_tombstoned(b_lane)
+            assert rep_a.slots.view()["members"][str(b_lane)] == addr_b2
+            nodes = [node_a, node_b2]
+
+            # Phase 4: post-restart spend on BOTH; converge bit-exactly.
+            for _ in range(5):
+                _, ok, _ = eng_b2.take("rr", RATE_SLOW, 1)
+                assert ok
+                admitted += 1
+            for _ in range(2):
+                _, ok, _ = eng_a.take("rr", RATE_SLOW, 1)
+                assert ok
+                admitted += 1
+            pn, elapsed = _converge_rows(nodes, "rr")
+            # Zero admitted-token loss: the converged taken lanes carry
+            # EVERY admitted take, across the restart.
+            assert sum(lane[1] for lane in pn) == admitted * NANO
+            # Lane continuity: B's original lane resumed AT its
+            # checkpointed watermark (4 pre + 5 post takes).
+            assert pn[b_lane][1] == 9 * NANO
+            assert pn[rep_a.slots.self_slot][1] == 5 * NANO
+            # AP bound, one side throughout: overshoot factor ≤ 1 side.
+            assert admitted <= 100
+            # Membership bookkeeping settled: two live lanes, no
+            # tombstones, epoch strictly advanced by the churn.
+            view = rep_a.slots.view()
+            assert len(view["members"]) == 2
+            assert view["tombstones"] == {}
+            assert view["epoch"] >= 2
+        finally:
+            for rep, eng, _ in nodes:
+                _stop_node(loopbox, rep, eng)
+            time.sleep(0.2)
+            loopbox.close()
+
+
+@pytest.mark.chaos
+class TestSlowJoinerChaos:
+    """Satellite: a node admitted mid-partition (the joiner can reach
+    only the admitting side) whose heal lands late still converges
+    bit-exactly — and the heal exchange stays inside the ≤250-packet AE
+    budget."""
+
+    def test_mid_partition_join_heals_bit_exact_within_budget(self):
+        loopbox = _Loop()
+        addr_a = f"127.0.0.1:{_free_port()}"
+        addr_b = f"127.0.0.1:{_free_port()}"
+        roster = [addr_a, addr_b]
+        node_a = _make_node(loopbox, addr_a, roster)
+        node_b = _make_node(loopbox, addr_b, roster)
+        rep_a, eng_a, repo_a = node_a
+        rep_b, eng_b, repo_b = node_b
+        nodes = [node_a, node_b]
+        extra = []
+        try:
+            # Prime + converge fault-free.
+            admitted = 0
+            for eng in (eng_a, eng_b):
+                _, ok, _ = eng.take("sj", RATE_SLOW, 2)
+                assert ok
+                admitted += 2
+            _converge_rows(nodes, "sj")
+
+            # Partition {A, C-to-be} | {B}: the joiner's address is
+            # carved out ahead of time so B hears NOTHING from either.
+            addr_c = f"127.0.0.1:{_free_port()}"
+            fns = []
+            for (rep, _, _), seed in ((node_a, 1), (node_b, 2)):
+                fn = FaultNet(seed=seed, self_addr=rep.node_addr)
+                fn.partition([addr_a, addr_c], [addr_b])
+                rep.faultnet = fn
+                fns.append(fn)
+            time.sleep(0.7)  # > alive_ttl: cross-side peers go dead
+
+            # Admit the joiner on A's side; B cannot hear the announce.
+            receipt = rep_a.membership.local_join(addr_c)
+            assert receipt is not None
+            c_lane = receipt["lane"]
+            node_c = _make_node(
+                loopbox, addr_c, [addr_a, addr_b, addr_c],
+                self_slot=c_lane,
+            )
+            rep_c, eng_c, repo_c = node_c
+            extra.append(node_c)
+            assert rep_c.slots.self_slot == c_lane
+            assert str(c_lane) not in rep_b.slots.view()["members"]
+
+            # Divergent spend: the joiner and both sides take.
+            for eng, n in ((eng_a, 2), (eng_b, 3), (eng_c, 4)):
+                for _ in range(n):
+                    _, ok, _ = eng.take("sj", RATE_SLOW, 1)
+                    assert ok
+                    admitted += 1
+            time.sleep(0.3)
+
+            # Late heal: measure the AE exchange's packet cost.
+            def tx_total():
+                reps = [rep_a, rep_b, rep_c]
+                return sum(
+                    r.stats()["replication_tx_packets"]
+                    - r.stats().get("fleet_packets_tx", 0)
+                    for r in reps
+                )
+
+            tx_before = tx_total()
+            for fn in fns:
+                fn.heal()
+            for rep, _, _ in (node_a, node_b):
+                rep.faultnet = None
+            # The admin's re-announce repairs the membership event the
+            # partition dropped: B learns the joiner exists.
+            rep_a.membership.local_join(addr_c)
+            deadline = time.time() + 5
+            while (
+                str(c_lane) not in rep_b.slots.view()["members"]
+                and time.time() < deadline
+            ):
+                time.sleep(0.02)
+            assert rep_b.slots.view()["members"][str(c_lane)] == addr_c
+
+            all_nodes = [node_a, node_b, node_c]
+            pn, elapsed = _converge_rows(all_nodes, "sj")
+            heal_cost = tx_total() - tx_before
+            # Bit-exact conservation: every admitted take survived the
+            # churn, including the joiner's unsynced spend.
+            assert sum(lane[1] for lane in pn) == admitted * NANO
+            assert pn[c_lane][1] == 4 * NANO
+            assert heal_cost <= 250, f"heal cost {heal_cost} packets"
+        finally:
+            for rep, eng, _ in nodes + extra:
+                _stop_node(loopbox, rep, eng)
+            time.sleep(0.2)
+            loopbox.close()

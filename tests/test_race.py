@@ -849,3 +849,89 @@ class TestGcGuardCoverage:
             }
         }
         assert _static(src, guards=guards) == []
+
+
+class TestMembershipGuardCoverage:
+    """Elastic-membership satellite: the SlotTable's runtime membership
+    state (active-member map, monotone epoch, lane tombstones) is
+    registered in GUARDS — admin calls and membership datagrams mutate
+    it from different threads — and the discipline demonstrably has
+    teeth (a seeded unlocked tombstone write → PTR003)."""
+
+    MEMBER_ATTRS = ("_members", "_epoch", "_tombstones")
+
+    def test_membership_state_registered(self):
+        assert "patrol_tpu/net/replication.py" in race.RACE_FILES
+        g = race.GUARDS["patrol_tpu/net/replication.py"]["SlotTable"]
+        for attr in self.MEMBER_ATTRS:
+            assert g[attr].lock == "_mu", attr
+            assert g[attr].mode == "rw", attr
+        # The resize quiesce flag rides the engine's work condvar in
+        # BOTH files that touch it (feeder predicate + resize swap).
+        eg = race.GUARDS["patrol_tpu/runtime/engine.py"]["DeviceEngine"]
+        assert eg["_tick_paused"].lock == "_cond"
+        mg = race.GUARDS["patrol_tpu/runtime/mesh_engine.py"]["MeshEngine"]
+        assert mg["_tick_paused"].lock == "_cond"
+
+    def test_shipped_membership_accesses_are_nonvacuous(self):
+        # The shipped tree really touches every declared attr from more
+        # than one method (join/leave/rejoin + the view reader) — a
+        # rename would otherwise leave the guard checking nothing.
+        src = race.race_sources(REPO_ROOT)["patrol_tpu/net/replication.py"]
+        for attr in self.MEMBER_ATTRS:
+            assert src.count(f"self.{attr}") >= 3, attr
+        assert race.race_sources(REPO_ROOT)[
+            "patrol_tpu/runtime/mesh_engine.py"
+        ].count("_tick_paused") >= 2
+
+    def test_seeded_unlocked_tombstone_mutation_flagged(self):
+        """A table-shaped remove path that writes the tombstone map
+        outside _mu — the exact slip a future membership refactor could
+        make — must fire PTR003."""
+        src = (
+            "import threading\n"
+            "class SlotTable:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._epoch = 0\n"
+            "        self._tombstones = {}\n"
+            "    def remove_member(self, slot):\n"
+            "        with self._mu:\n"
+            "            self._epoch += 1\n"
+            "        self._tombstones[slot] = self._epoch\n"
+        )
+        f = race.race_static(
+            {"patrol_tpu/net/replication.py": src},
+            guards=race.GUARDS,
+            holders={},
+            aliases={},
+            retained={},
+            effects={},
+        )
+        assert codes(f) == ["PTR003"]
+        assert "_tombstones" in f[0].message
+
+    def test_locked_membership_mutation_clean(self):
+        src = (
+            "import threading\n"
+            "class SlotTable:\n"
+            "    def __init__(self):\n"
+            "        self._mu = threading.Lock()\n"
+            "        self._epoch = 0\n"
+            "        self._tombstones = {}\n"
+            "        self._members = {}\n"
+            "    def remove_member(self, slot):\n"
+            "        with self._mu:\n"
+            "            self._epoch += 1\n"
+            "            self._tombstones[slot] = self._epoch\n"
+            "            self._members.pop(slot, None)\n"
+        )
+        f = race.race_static(
+            {"patrol_tpu/net/replication.py": src},
+            guards=race.GUARDS,
+            holders={},
+            aliases={},
+            retained={},
+            effects={},
+        )
+        assert f == []
