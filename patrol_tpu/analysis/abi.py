@@ -1617,5 +1617,6 @@ def abi_all(only: Optional[Sequence[str]] = None) -> List[Finding]:
 
 
 def abi_repo(repo_root: str) -> List[Finding]:
-    """abi_all with the shared inline-suppression filter applied."""
-    return apply_suppressions(abi_all(), repo_root)
+    """abi_all with the shared inline-suppression filter applied (stale
+    PTA suppressions come back as PTL006)."""
+    return apply_suppressions(abi_all(), repo_root, stale_family="PTA")

@@ -1,7 +1,7 @@
 """patrol-check AST lint: repo-specific invariants as checks over the
 Python sources.
 
-Four checks, each encoding a discipline the runtime depends on but no
+Six checks, each encoding a discipline the runtime depends on but no
 generic tool can express:
 
 * **PTL001 wall-clock** — the limiter is driven by an *injected* clock
@@ -52,14 +52,25 @@ Suppressions (documented in README.md) are inline comments:
 ``clock-seam`` suppresses PTL001 only; ``wire-f64`` suppresses PTL004
 only; ``disable=`` names codes explicitly. Every suppression is a
 *declaration* — greppable, reviewed like code.
+
+* **PTL006 stale-suppression** — a directive that suppresses nothing is
+  itself a finding: the hazard it declared was fixed (or never existed)
+  and the comment now grants a silent pardon to whatever lands on that
+  line next. The lint stage sweeps its own family (PTL codes plus the
+  ``clock-seam``/``wire-f64`` markers) after all checks run; the other
+  stages inherit the same sweep for their code families through
+  :func:`apply_suppressions`. A stale ``disable=PTL006`` on the same
+  line self-suppresses (the one deliberate escape hatch).
 """
 
 from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import os
 import re
+import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 # ---------------------------------------------------------------------------
@@ -107,6 +118,47 @@ SYNC_NP_FUNCS = {"asarray", "array", "ascontiguousarray"}
 SYNC_JAX_FUNCS = {"block_until_ready", "device_get"}
 
 _DIRECTIVE_RE = re.compile(r"#\s*patrol-lint:\s*([A-Za-z0-9=,_\- ]+)")
+
+# Marker tokens the lint stage owns (each aliases one PTL code).
+LINT_MARKERS = ("clock-seam", "wire-f64")
+
+
+def _parse_directive(comment: str) -> Set[str]:
+    """Directive tokens out of one comment string (empty set: none)."""
+    m = _DIRECTIVE_RE.search(comment)
+    if not m:
+        return set()
+    toks: Set[str] = set()
+    for raw in re.split(r"[,\s]+", m.group(1).strip()):
+        if not raw:
+            continue
+        if raw.startswith("disable="):
+            toks.update(t for t in raw[8:].split(",") if t)
+        else:
+            toks.add(raw)
+    return toks
+
+
+def directive_map(source: str) -> Dict[int, Set[str]]:
+    """line → directive tokens, from real COMMENT tokens only. A
+    ``# patrol-lint:`` spelled inside a string literal is prose about the
+    machinery, not an instance of it — the tokenizer is the cheapest
+    oracle that tells the two apart. Falls back to a raw line scan if
+    tokenization fails (the caller already ast-parsed, so it shouldn't)."""
+    out: Dict[int, Set[str]] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            parsed = _parse_directive(tok.string)
+            if parsed:
+                out.setdefault(tok.start[0], set()).update(parsed)
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            parsed = _parse_directive(line)
+            if parsed:
+                out.setdefault(lineno, set()).update(parsed)
+    return out
 
 # ---------------------------------------------------------------------------
 # Cross-boundary effects: the declared per-symbol contract of the native
@@ -167,24 +219,21 @@ class Module:
         self.source = source
         self.tree = ast.parse(source, filename=self.relpath)
         # line → directive tokens ("clock-seam", "wire-f64", "PTL001", ...)
-        self.directives: Dict[int, Set[str]] = {}
-        for lineno, line in enumerate(source.splitlines(), start=1):
-            m = _DIRECTIVE_RE.search(line)
-            if not m:
-                continue
-            toks: Set[str] = set()
-            for raw in re.split(r"[,\s]+", m.group(1).strip()):
-                if not raw:
-                    continue
-                if raw.startswith("disable="):
-                    toks.update(t for t in raw[8:].split(",") if t)
-                else:
-                    toks.add(raw)
-            self.directives[lineno] = toks
+        self.directives: Dict[int, Set[str]] = directive_map(source)
+        # (line, token) pairs that actually suppressed a finding — the
+        # PTL006 stale sweep flags any directive token never seen here.
+        self.used: Set[Tuple[int, str]] = set()
 
     def suppressed(self, check: str, line: int, marker: Optional[str] = None) -> bool:
         toks = self.directives.get(line, ())
-        return check in toks or (marker is not None and marker in toks)
+        hit = False
+        if check in toks:
+            self.used.add((line, check))
+            hit = True
+        if marker is not None and marker in toks:
+            self.used.add((line, marker))
+            hit = True
+        return hit
 
 
 def _time_aliases(tree: ast.AST) -> Tuple[Set[str], Set[str], Set[str]]:
@@ -803,7 +852,40 @@ PER_MODULE_CHECKS = (
     check_dtype_discipline,
     check_counter_registry,
 )
-ALL_CODES = ("PTL001", "PTL002", "PTL003", "PTL004", "PTL005")
+ALL_CODES = ("PTL001", "PTL002", "PTL003", "PTL004", "PTL005", "PTL006")
+
+
+def _stale_finding(relpath: str, line: int, tok: str) -> Finding:
+    return Finding(
+        "PTL006",
+        relpath,
+        line,
+        f"stale suppression `{tok}`: nothing on this line needs it — "
+        "remove the directive (a suppression that pardons nothing today "
+        "silently pardons whatever lands here tomorrow)",
+    )
+
+
+def stale_suppression_findings(
+    mods: Sequence[Module],
+    family: str = "PTL",
+    markers: Sequence[str] = LINT_MARKERS,
+) -> List[Finding]:
+    """PTL006 sweep: directive tokens of ``family`` (code prefix) or in
+    ``markers`` that suppressed nothing. Must run AFTER the checks whose
+    suppressions it audits — usage is recorded by Module.suppressed. A
+    ``PTL006`` token on the line self-suppresses the sweep there."""
+    out: List[Finding] = []
+    for m in mods:
+        for line, toks in sorted(m.directives.items()):
+            if "PTL006" in toks:
+                continue
+            for tok in sorted(toks):
+                if not (tok.startswith(family) or tok in markers):
+                    continue
+                if (line, tok) not in m.used:
+                    out.append(_stale_finding(m.relpath, line, tok))
+    return out
 
 
 def lint_modules(mods: Sequence[Module]) -> List[Finding]:
@@ -812,6 +894,7 @@ def lint_modules(mods: Sequence[Module]) -> List[Finding]:
         for chk in PER_MODULE_CHECKS:
             out.extend(chk(m))
     out.extend(check_jit_sync(mods))
+    out.extend(stale_suppression_findings(mods))
     return sorted(out, key=lambda f: (f.path, f.line, f.check))
 
 
@@ -840,14 +923,27 @@ def lint_repo(root: str) -> List[Finding]:
 
 
 def apply_suppressions(
-    findings: Sequence[Finding], repo_root: str
+    findings: Sequence[Finding],
+    repo_root: str,
+    stale_family: Optional[str] = None,
+    inline_used: Optional[Set[Tuple[str, int, str]]] = None,
 ) -> List[Finding]:
     """Filter findings through the flagged files' inline ``# patrol-lint:``
     directives — the shared back half of every repo driver (lint runs the
     directives during the checks themselves; prove and abi produce
     findings first and filter here). Files that cannot be read or parsed
     (e.g. a finding anchored in a .cpp source) keep their findings: a
-    suppression that cannot be located must not silently win."""
+    suppression that cannot be located must not silently win.
+
+    ``stale_family`` (a code prefix: "PTP", "PTA", "PTR", "PTN") turns on
+    the PTL006 stale sweep for that family: every directive token with
+    the prefix anywhere under ``<repo_root>/patrol_tpu`` that suppressed
+    nothing in this run is appended as a PTL006 finding — so prove, abi,
+    race, and lin each audit their own suppressions for free.
+
+    ``inline_used`` covers checkers (race) that honor directives DURING
+    the checks, on their own Module instances: (path, line, token)
+    triples recorded there count as used here."""
     mods: Dict[str, Optional[Module]] = {}
     kept: List[Finding] = []
     for f in findings:
@@ -862,4 +958,20 @@ def apply_suppressions(
         if mod is not None and mod.suppressed(f.check, f.line):
             continue
         kept.append(f)
+    if stale_family is not None:
+        for rel, src in sorted(repo_sources(repo_root).items()):
+            mod = mods.get(rel)
+            used = mod.used if mod is not None else set()
+            dirs = mod.directives if mod is not None else directive_map(src)
+            for line, toks in sorted(dirs.items()):
+                if "PTL006" in toks:
+                    continue
+                for tok in sorted(toks):
+                    if not tok.startswith(stale_family):
+                        continue
+                    if (line, tok) in used:
+                        continue
+                    if inline_used and (rel, line, tok) in inline_used:
+                        continue
+                    kept.append(_stale_finding(rel, line, tok))
     return kept

@@ -99,6 +99,13 @@ checker that passes a mutant is itself a finding (PTC005).
 Pure python, no jax; exhaustive within its bounds (several thousand
 schedules in well under a second), deterministic by construction — no
 randomness anywhere, so CI failures replay exactly.
+
+The schedule space itself is exposed as a reusable generator —
+:func:`enumerate_schedules` over :class:`ScheduleBounds` — so downstream
+checkers (patrol-lin, stage 8, `analysis/linearizability.py`) consume
+the SAME DFS + memoization instead of growing a second schedule space
+that drifts. ``Cluster`` subclasses ride along via the
+``snapshot``/``restore``/``memo_key``/``_resync`` hooks.
 """
 
 from __future__ import annotations
@@ -582,6 +589,91 @@ class Cluster:
                 if self.crosses_partition(i, j):
                     q.clear()
 
+    # -- snapshot/restore/memoization (subclass hooks) -----------------------
+    #
+    # The schedule enumerator branches by snapshot → apply-move → restore;
+    # subclasses (patrol-lin's LinCluster) carry extra per-node state (the
+    # visibility ledger) through `_snapshot_extra`/`_restore_extra` and
+    # extend the memoization key through `_memo_extra` — WITHOUT the
+    # enumerator knowing anything about them.
+
+    def _clone_empty(self) -> "Cluster":
+        """A fresh same-shaped cluster for `restore` to fill. Subclasses
+        with extra constructor arguments override this."""
+        return Cluster(len(self.nodes), self.nodes[0].limit, self.sem)
+
+    def _snapshot_extra(self):
+        """Deep-copied subclass state riding along in every snapshot."""
+        return None
+
+    def _restore_extra(self, extra) -> None:
+        pass
+
+    def snapshot(self):
+        return (
+            [
+                (
+                    list(n.added), list(n.taken), n.admitted,
+                    n.dirty, n.sent_a, n.sent_t,
+                    {j: dict(d) for j, d in n.unacked.items()},
+                    dict(n.next_seq),
+                    n.granted, n.deaf,
+                )
+                for n in self.nodes
+            ],
+            {k: list(v) for k, v in self.links.items()},
+            None if self.partition is None else dict(self.partition),
+            self._snapshot_extra(),
+        )
+
+    def restore(self, snap) -> "Cluster":
+        nodes, links, part, extra = snap
+        c = self._clone_empty()
+        for node, (a, t, adm, dirty, sa, st_, unacked, seqs, granted, deaf) in zip(
+            c.nodes, nodes
+        ):
+            node.added = list(a)
+            node.taken = list(t)
+            node.admitted = adm
+            node.dirty = dirty
+            node.sent_a = sa
+            node.sent_t = st_
+            node.unacked = {j: dict(d) for j, d in unacked.items()}
+            node.next_seq = dict(seqs)
+            node.granted = granted
+            node.deaf = deaf
+        c.links = {k: list(v) for k, v in links.items()}
+        c.partition = None if part is None else dict(part)
+        c._restore_extra(extra)
+        return c
+
+    def _memo_extra(self):
+        """Subclass contribution to the memoization key. patrol-lin's
+        ledger must appear here: two lane-identical states with different
+        visible histories are NOT the same verification state."""
+        return None
+
+    def memo_key(self, budget: tuple = ()) -> tuple:
+        return (
+            tuple(
+                n.state()
+                + (n.admitted, n.dirty, n.sent_a, n.sent_t, n.granted, n.deaf)
+                + tuple(
+                    (j, tuple(sorted(d.items())), n.next_seq[j])
+                    for j, d in sorted(n.unacked.items())
+                )
+                for n in self.nodes
+            ),
+            tuple(
+                (lk, tuple(map(tuple, q))) for lk, q in sorted(self.links.items())
+            ),
+            None
+            if self.partition is None
+            else tuple(sorted(self.partition.items())),
+            tuple(budget),
+            self._memo_extra(),
+        )
+
     def _converge_delta(self) -> None:
         """The delta plane's own repair loop: flush dirty lanes and
         retransmit every unacked interval (with current absolute values —
@@ -642,15 +734,7 @@ class Cluster:
         # 'gc-treats-collected-as-unknown' mutation breaks).
         if self.sem.wire != "delta" or self.sem.gc != "off":
             for a, b in itertools.permutations(range(len(self.nodes)), 2):
-                node = self.nodes[b]
-                prev = node.state()
-                node.resync_from(self.nodes[a], self.sem)
-                if not _ge(node.state(), prev):
-                    raise _Violation(
-                        "PTC002",
-                        f"anti-entropy resync shrank node {b}'s state "
-                        f"{prev} -> {node.state()}",
-                    )
+                self._resync(b, a)
         expect = _join(before)
         states = [n.state() for n in self.nodes]
         if any(s != states[0] for s in states):
@@ -661,6 +745,21 @@ class Cluster:
             raise _Violation(
                 "PTC001",
                 f"converged state {states[0]} != join of replicas {expect}",
+            )
+
+    def _resync(self, b: int, a: int) -> None:
+        """One heal-time anti-entropy exchange: node ``b`` resyncs from
+        node ``a`` (digest+fetch modelled as its effect). A hook so
+        subclasses observe the shipped state (patrol-lin learns
+        visibility from the AE payload exactly like from a datagram)."""
+        node = self.nodes[b]
+        prev = node.state()
+        node.resync_from(self.nodes[a], self.sem)
+        if not _ge(node.state(), prev):
+            raise _Violation(
+                "PTC002",
+                f"anti-entropy resync shrank node {b}'s state "
+                f"{prev} -> {node.state()}",
             )
 
 
@@ -681,6 +780,160 @@ def _partition_layouts(n: int) -> List[Optional[Dict[int, int]]]:
             {0: 0, 1: 1, 2: 2},
         ]
     return layouts
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleBounds:
+    """Event budgets for one bounded schedule space. ``takes`` is the
+    required take count (every terminal schedule spent them all);
+    ``disruptions`` bounds duplicate-deliver/drop events; ``refills``,
+    ``gcs`` and ``partitions`` enable the bucket-lifecycle and
+    partition/heal move families when non-zero (all OPTIONAL budgets —
+    schedules that use fewer are still terminal). ``depth`` caps the DFS
+    (None = derived from the budgets, matching the historical cap)."""
+
+    n_nodes: int = 2
+    limit: int = 2
+    takes: int = 3
+    disruptions: int = 2
+    refills: int = 0
+    gcs: int = 0
+    partitions: int = 0
+    depth: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Terminal:
+    """One enumerated schedule endpoint. ``cluster`` is safe to mutate
+    (the DFS is done with it — consumers typically heal/converge it).
+    ``violation`` carries a :class:`_Violation` raised while APPLYING a
+    move (e.g. a shrinking merge); ``depth_capped`` marks schedules cut
+    by the DFS depth bound (still valid prefixes worth converging);
+    ``events`` is the exact move sequence — every failure replays."""
+
+    cluster: Cluster
+    violation: Optional[_Violation] = None
+    depth_capped: bool = False
+    events: Tuple[tuple, ...] = ()
+
+
+def enumerate_schedules(
+    sem: Semantics = CLEAN,
+    bounds: Optional[ScheduleBounds] = None,
+    cluster_factory=None,
+) -> Iterable[Terminal]:
+    """THE schedule enumerator (stage 6 AND stage 8 consume this one
+    generator — no second schedule space to drift): DFS over every
+    interleaving of {take, flush, deliver-any, duplicate-deliver, drop}
+    plus — when the bounds enable them — {refill, gc, partition, heal},
+    with state memoization over ``Cluster.memo_key``. Yields a
+    :class:`Terminal` per distinct endpoint; a move that raises
+    :class:`_Violation` terminates that branch with the violation
+    attached. ``cluster_factory(n_nodes, limit, sem)`` lets subclasses
+    (patrol-lin's LinCluster) ride the same enumeration."""
+    b = bounds if bounds is not None else ScheduleBounds()
+    factory = cluster_factory if cluster_factory is not None else Cluster
+    root = factory(b.n_nodes, b.limit, sem)
+    # Delta mode needs one flush event per take to put data on the wire.
+    extra = b.takes + 2 if any(root.caps) else 0
+    depth0 = (
+        b.depth
+        if b.depth is not None
+        else b.takes * 3
+        + b.disruptions
+        + 4
+        + extra
+        + 2 * (b.refills + b.gcs)
+        + 3 * b.partitions
+    )
+    layouts = [lay for lay in _partition_layouts(b.n_nodes) if lay is not None]
+    seen: set = set()
+
+    def walk(c: Cluster, budget: tuple, depth: int, trail: tuple):
+        takes_left, disrupt_left, refill_left, gc_left, part_left = budget
+        k = c.memo_key(budget)
+        if k in seen:
+            return  # schedule prefix reaches an already-checked state
+        seen.add(k)
+        inflight = [
+            (i, j, idx)
+            for (i, j), q in c.links.items()
+            for idx in range(len(q))
+        ]
+        if takes_left == 0 and not inflight:
+            if refill_left == 0 and gc_left == 0:
+                yield Terminal(c, events=trail)
+                return
+            # Trailing refill/gc events after the last take still change
+            # terminal state — yield a COPY (consumers mutate terminals
+            # by healing them) and keep exploring those branches below.
+            yield Terminal(c.restore(c.snapshot()), events=trail)
+        if depth == 0:
+            # Depth cap: converge what we have (still a valid schedule).
+            yield Terminal(c, depth_capped=True, events=trail)
+            return
+        moves: List[tuple] = []
+        if takes_left:
+            moves += [("take", i) for i in range(len(c.nodes))]
+        if refill_left:
+            moves += [("refill", i) for i in range(len(c.nodes))]
+        if gc_left:
+            moves += [("gc", i) for i in range(len(c.nodes))]
+        if part_left and c.partition is None:
+            moves += [("partition", lay) for lay in layouts]
+        if c.partition is not None:
+            moves.append(("heal",))
+        # Delta plane: the paced flusher is its own schedulable event.
+        for i, node in enumerate(c.nodes):
+            if c.caps[i] and node.dirty:
+                moves.append(("flush", i))
+        # Deliver the HEAD of each link (plus the tail when reordering is
+        # possible) — delivering only head/tail spans the reorder space
+        # for the 2-deep links these bounds produce.
+        for (i, j), q in c.links.items():
+            if q:
+                moves.append(("deliver", i, j, 0))
+                if len(q) > 1:
+                    moves.append(("deliver", i, j, len(q) - 1))
+                if disrupt_left:
+                    moves.append(("dup", i, j, 0))
+                    moves.append(("drop", i, j, 0))
+        for mv in moves:
+            c2 = c.restore(c.snapshot())
+            nxt = budget
+            try:
+                if mv[0] == "take":
+                    c2.take(mv[1])
+                    nxt = (takes_left - 1,) + budget[1:]
+                elif mv[0] == "refill":
+                    c2.refill(mv[1])
+                    nxt = budget[:2] + (refill_left - 1,) + budget[3:]
+                elif mv[0] == "gc":
+                    c2.gc(mv[1])
+                    nxt = budget[:3] + (gc_left - 1,) + budget[4:]
+                elif mv[0] == "partition":
+                    c2.set_partition(dict(mv[1]))
+                    nxt = budget[:4] + (part_left - 1,)
+                elif mv[0] == "heal":
+                    c2.set_partition(None)
+                elif mv[0] == "flush":
+                    c2.flush(mv[1])
+                elif mv[0] == "deliver":
+                    c2.deliver(mv[1], mv[2], mv[3])
+                elif mv[0] == "dup":
+                    c2.deliver(mv[1], mv[2], mv[3], dup=True)
+                    nxt = (takes_left, disrupt_left - 1) + budget[2:]
+                else:  # drop
+                    c2.drop(mv[1], mv[2], mv[3])
+                    nxt = (takes_left, disrupt_left - 1) + budget[2:]
+            except _Violation as v:
+                yield Terminal(c2, violation=v, events=trail + (mv,))
+                return  # one witness per state is enough
+            yield from walk(c2, nxt, depth - 1, trail + (mv,))
+
+    yield from walk(
+        root, (b.takes, b.disruptions, b.refills, b.gcs, b.partitions), depth0, ()
+    )
 
 
 def check_ap_bound(
@@ -729,148 +982,39 @@ def check_async_schedules(
     max_disruptions: int = 2,
     sem: Semantics = CLEAN,
 ) -> Tuple[int, List[Finding]]:
-    """PTC001/PTC002 under fully-adversarial delivery: DFS over every
-    interleaving of {take, deliver-any, duplicate-deliver, drop} within
-    the event bounds, converging each terminal schedule. Monotonicity is
-    checked at every merge; convergence-to-join at every terminal.
+    """PTC001/PTC002 under fully-adversarial delivery: every terminal of
+    :func:`enumerate_schedules` (the {take, deliver-any,
+    duplicate-deliver, drop} interleavings within the event bounds) is
+    healed and converged. Monotonicity is checked at every merge;
+    convergence-to-join at every terminal.
     Returns (schedules explored, findings)."""
     findings: List[Finding] = []
     explored = 0
-    seen: set = set()
-
-    def _key(c: Cluster, takes_left: int, disrupt_left: int):
-        return (
-            tuple(
-                n.state()
-                + (n.admitted, n.dirty, n.sent_a, n.sent_t)
-                + tuple(
-                    (j, tuple(sorted(d.items())), n.next_seq[j])
-                    for j, d in sorted(n.unacked.items())
-                )
-                for n in c.nodes
-            ),
-            tuple(
-                (lk, tuple(map(tuple, q))) for lk, q in sorted(c.links.items())
-            ),
-            takes_left,
-            disrupt_left,
-        )
-
-    def dfs(c: Cluster, takes_left: int, disrupt_left: int, depth: int):
-        nonlocal explored
-        if findings:
-            return  # one witness is enough
-        k = _key(c, takes_left, disrupt_left)
-        if k in seen:
-            return  # schedule prefix reaches an already-checked state
-        seen.add(k)
-        inflight = [
-            (i, j, idx)
-            for (i, j), q in c.links.items()
-            for idx in range(len(q))
-        ]
-        if takes_left == 0 and not inflight:
-            explored += 1
-            final = _snapshot(c)
+    bounds = ScheduleBounds(
+        n_nodes=n_nodes, limit=limit, takes=takes, disruptions=max_disruptions
+    )
+    for term in enumerate_schedules(sem, bounds):
+        explored += 1
+        if term.violation is None:
             try:
-                c2 = _restore(c, final)
-                c2.heal_and_converge()
+                term.cluster.heal_and_converge()
+                continue
             except _Violation as v:
                 findings.append(Finding(v.check, _SELF, 0, v.message))
-            return
-        if depth == 0:
-            # Depth cap: converge what we have (still a valid schedule).
-            explored += 1
-            try:
-                c2 = _restore(c, _snapshot(c))
-                c2.heal_and_converge()
-            except _Violation as v:
-                findings.append(Finding(v.check, _SELF, 0, v.message))
-            return
-        moves = []
-        if takes_left:
-            moves += [("take", i) for i in range(len(c.nodes))]
-        # Delta plane: the paced flusher is its own schedulable event.
-        for i, node in enumerate(c.nodes):
-            if c.caps[i] and node.dirty:
-                moves.append(("flush", i))
-        # Deliver the HEAD of each link (plus the tail when reordering is
-        # possible) — delivering only head/tail spans the reorder space
-        # for the 2-deep links these bounds produce.
-        for (i, j), q in c.links.items():
-            if q:
-                moves.append(("deliver", i, j, 0))
-                if len(q) > 1:
-                    moves.append(("deliver", i, j, len(q) - 1))
-                if disrupt_left:
-                    moves.append(("dup", i, j, 0))
-                    moves.append(("drop", i, j, 0))
-        for mv in moves:
-            snap = _snapshot(c)
-            c2 = _restore(c, snap)
-            try:
-                if mv[0] == "take":
-                    c2.take(mv[1])
-                    dfs(c2, takes_left - 1, disrupt_left, depth - 1)
-                elif mv[0] == "flush":
-                    c2.flush(mv[1])
-                    dfs(c2, takes_left, disrupt_left, depth - 1)
-                elif mv[0] == "deliver":
-                    c2.deliver(mv[1], mv[2], mv[3])
-                    dfs(c2, takes_left, disrupt_left, depth - 1)
-                elif mv[0] == "dup":
-                    c2.deliver(mv[1], mv[2], mv[3], dup=True)
-                    dfs(c2, takes_left, disrupt_left - 1, depth - 1)
-                else:  # drop
-                    c2.drop(mv[1], mv[2], mv[3])
-                    dfs(c2, takes_left, disrupt_left - 1, depth - 1)
-            except _Violation as v:
-                findings.append(Finding(v.check, _SELF, 0, v.message))
-                return
-
-    root = Cluster(n_nodes, limit, sem)
-    # Delta mode needs one flush event per take to put data on the wire.
-    extra = takes + 2 if any(root.caps) else 0
-    dfs(root, takes, max_disruptions, depth=takes * 3 + max_disruptions + 4 + extra)
+        else:
+            findings.append(
+                Finding(term.violation.check, _SELF, 0, term.violation.message)
+            )
+        break  # one witness is enough
     return explored, findings
 
 
 def _snapshot(c: Cluster):
-    return (
-        [
-            (
-                list(n.added), list(n.taken), n.admitted,
-                n.dirty, n.sent_a, n.sent_t,
-                {j: dict(d) for j, d in n.unacked.items()},
-                dict(n.next_seq),
-                n.granted, n.deaf,
-            )
-            for n in c.nodes
-        ],
-        {k: list(v) for k, v in c.links.items()},
-        None if c.partition is None else dict(c.partition),
-    )
+    return c.snapshot()
 
 
 def _restore(template: Cluster, snap) -> Cluster:
-    nodes, links, part = snap
-    c = Cluster(len(template.nodes), template.nodes[0].limit, template.sem)
-    for node, (a, t, adm, dirty, sa, st_, unacked, seqs, granted, deaf) in zip(
-        c.nodes, nodes
-    ):
-        node.added = list(a)
-        node.taken = list(t)
-        node.admitted = adm
-        node.dirty = dirty
-        node.sent_a = sa
-        node.sent_t = st_
-        node.unacked = {j: dict(d) for j, d in unacked.items()}
-        node.next_seq = dict(seqs)
-        node.granted = granted
-        node.deaf = deaf
-    c.links = {k: list(v) for k, v in links.items()}
-    c.partition = None if part is None else dict(part)
-    return c
+    return template.restore(snap)
 
 
 def check_idempotence(

@@ -44,6 +44,8 @@ PTP002 commutativity over the small lattice domain
 PTP003 idempotence under duplication / round-trip stability
 PTP004 monotonicity (join and take never shrink a plane)
 PTP005 dtype- and shape-stability of the state planes under jit
+PTP006 registration completeness: every jit-dispatched engine
+       kernel is in PROVE_ROOTS or PROVE_EXEMPT (static sweep)
 ====== =======================================================
 
 Findings reuse :class:`patrol_tpu.analysis.lint.Finding` and the same
@@ -55,12 +57,13 @@ and the ``pytest -m prove`` fixture self-tests in ``tests/test_prove.py``.
 
 from __future__ import annotations
 
+import ast
 import dataclasses
 import importlib
 import inspect
 import itertools
 import os
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -75,6 +78,9 @@ __all__ = [
     "ALL_CODES",
 ]
 
+# Per-root obligation codes. PTP006 (registration completeness) is a
+# repo-level sweep over the engine dispatch graph, not a declarable
+# per-root obligation, so it lives outside this tuple.
 ALL_CODES = ("PTP001", "PTP002", "PTP003", "PTP004", "PTP005")
 
 # ---------------------------------------------------------------------------
@@ -1624,10 +1630,209 @@ def prove_all(roots: Optional[Sequence[ProveRoot]] = None) -> List[Finding]:
     return sorted(out, key=lambda f: (f.path, f.line, f.check))
 
 
-def prove_repo(repo_root: str) -> List[Finding]:
-    """Prove every registered root, honoring the lint suppression
-    directives in the flagged source files (``# patrol-lint:
-    disable=PTP001`` — same machinery, same greppability)."""
-    from patrol_tpu.analysis.lint import apply_suppressions
+# ---------------------------------------------------------------------------
+# PTP006 — registration completeness over the engine dispatch graph. Every
+# kernel the runtime engines push through jax.jit must appear in PROVE_ROOTS
+# (full obligations) or PROVE_EXEMPT (reason on record, in obligations.py) —
+# a new kernel cannot land without declared obligations.
 
-    return apply_suppressions(prove_all(), repo_root)
+ENGINE_DISPATCH_FILES: Tuple[str, ...] = (
+    "patrol_tpu/runtime/engine.py",
+    "patrol_tpu/runtime/mesh_engine.py",
+    "patrol_tpu/parallel/topology.py",
+)
+
+_KERNEL_PKG = "patrol_tpu.ops."
+
+
+def registration_findings(
+    sources: Dict[str, str],
+    registered: Optional[Set[Tuple[str, str]]] = None,
+    engine_files: Sequence[str] = ENGINE_DISPATCH_FILES,
+) -> List[Finding]:
+    """PTP006: sweep the engine files for jit-dispatched kernels and flag
+    any (module, func) in neither PROVE_ROOTS nor PROVE_EXEMPT.
+
+    Two dispatch idioms are recognized, matching the engines' shapes:
+
+    * a ``jax.jit(...)`` call — the whole enclosing function (the
+      ``@lru_cache`` factory with its local ``step`` closure, or the
+      mesh builder assembling ``shard_map(partial(cluster_step, ...))``)
+      is treated as the dispatch unit, and every reference out of it
+      into a ``patrol_tpu.ops.*`` module-level function counts,
+      recursing through same-module helper defs (``cluster_step``);
+    * a pre-jitted ``*_jit``-suffixed name resolving into an ops module
+      (``zero_rows_jit``, ``delta_ops.delta_fold_jit``) — the kernel is
+      the name minus the suffix.
+
+    Batch/request constructors are excluded by construction: only names
+    that are module-level ``def``\\ s in the target ops module count (a
+    target module absent from ``sources`` keeps its candidates — an
+    unresolvable dispatch must not silently pass)."""
+    if registered is None:
+        from patrol_tpu.ops.obligations import PROVE_EXEMPT, PROVE_ROOTS
+
+        registered = {(r.module, r.attr) for r in PROVE_ROOTS} | set(
+            PROVE_EXEMPT
+        )
+
+    defs_cache: Dict[str, Optional[Set[str]]] = {}
+
+    def kernel_defs(module: str) -> Optional[Set[str]]:
+        if module not in defs_cache:
+            src = sources.get(module.replace(".", "/") + ".py")
+            try:
+                defs_cache[module] = (
+                    None
+                    if src is None
+                    else {
+                        n.name
+                        for n in ast.parse(src).body
+                        if isinstance(
+                            n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        )
+                    }
+                )
+            except SyntaxError:  # pragma: no cover - repo sources parse
+                defs_cache[module] = None
+        return defs_cache[module]
+
+    out: List[Finding] = []
+    for rel in engine_files:
+        src = sources.get(rel)
+        if src is None:
+            continue
+        tree = ast.parse(src, filename=rel)
+
+        func_imports: Dict[str, Tuple[str, str]] = {}
+        mod_aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith(_KERNEL_PKG):
+                        mod_aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    sub = f"{node.module}.{a.name}"
+                    if (
+                        sub.startswith(_KERNEL_PKG)
+                        and sub.replace(".", "/") + ".py" in sources
+                    ):
+                        mod_aliases[a.asname or a.name] = sub
+                    elif node.module.startswith(_KERNEL_PKG):
+                        func_imports[a.asname or a.name] = (
+                            node.module,
+                            a.name,
+                        )
+        module_defs = {
+            n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
+        }
+
+        candidates: Dict[Tuple[str, str], int] = {}
+
+        def note(module: str, name: str, line: int) -> None:
+            if name.endswith("_jit"):
+                name = name[: -len("_jit")]
+            defs = kernel_defs(module)
+            if defs is not None and name not in defs:
+                return  # a batch/request constructor, not a kernel
+            key = (module, name)
+            if key not in candidates or line < candidates[key]:
+                candidates[key] = line
+
+        def collect(root: ast.AST, visited: Set[ast.AST]) -> None:
+            for node in ast.walk(root):
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load
+                ):
+                    tgt = module_defs.get(node.id)
+                    if tgt is not None and tgt not in visited:
+                        visited.add(tgt)
+                        collect(tgt, visited)
+                    elif node.id in func_imports:
+                        mod, attr = func_imports[node.id]
+                        note(mod, attr, node.lineno)
+                elif (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in mod_aliases
+                ):
+                    note(mod_aliases[node.value.id], node.attr, node.lineno)
+
+        def find_jit_scopes(
+            node: ast.AST, enclosing: Optional[ast.AST], acc: List[ast.AST]
+        ) -> None:
+            for child in ast.iter_child_nodes(node):
+                if (
+                    isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Attribute)
+                    and child.func.attr == "jit"
+                    and isinstance(child.func.value, ast.Name)
+                    and child.func.value.id == "jax"
+                ):
+                    acc.append(enclosing if enclosing is not None else child)
+                find_jit_scopes(
+                    child,
+                    child
+                    if isinstance(child, ast.FunctionDef)
+                    else enclosing,
+                    acc,
+                )
+
+        scopes: List[ast.AST] = []
+        find_jit_scopes(tree, None, scopes)
+        seen_scopes: Set[ast.AST] = set()
+        for scope in scopes:
+            if scope in seen_scopes:
+                continue
+            seen_scopes.add(scope)
+            collect(scope, {scope})
+
+        # Pre-jitted kernels: *_jit names are dispatches wherever they
+        # appear, jit scope or not.
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id.endswith("_jit")
+                and node.id in func_imports
+            ):
+                mod, attr = func_imports[node.id]
+                note(mod, attr, node.lineno)
+            elif (
+                isinstance(node, ast.Attribute)
+                and node.attr.endswith("_jit")
+                and isinstance(node.value, ast.Name)
+                and node.value.id in mod_aliases
+            ):
+                note(mod_aliases[node.value.id], node.attr, node.lineno)
+
+        for (module, name), line in sorted(
+            candidates.items(), key=lambda kv: (kv[1], kv[0])
+        ):
+            if (module, name) not in registered:
+                out.append(
+                    Finding(
+                        "PTP006",
+                        rel,
+                        line,
+                        f"jitted kernel {module}.{name} is dispatched here "
+                        "but registered in neither PROVE_ROOTS nor "
+                        "PROVE_EXEMPT — declare its obligations (or its "
+                        "exemption, with the reason) in "
+                        "patrol_tpu/ops/obligations.py",
+                    )
+                )
+    return sorted(out, key=lambda f: (f.path, f.line, f.check))
+
+
+def prove_repo(repo_root: str) -> List[Finding]:
+    """Prove every registered root + the PTP006 registration-completeness
+    sweep, honoring the lint suppression directives in the flagged source
+    files (``# patrol-lint: disable=PTP001`` — same machinery, same
+    greppability) and sweeping stale PTP suppressions as PTL006."""
+    from patrol_tpu.analysis.lint import apply_suppressions, repo_sources
+
+    findings = prove_all() + registration_findings(repo_sources(repo_root))
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return apply_suppressions(findings, repo_root, stale_family="PTP")

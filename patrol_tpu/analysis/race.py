@@ -1538,10 +1538,13 @@ def race_static(
     retained: Optional[Dict[str, Dict[str, Dict[str, str]]]] = None,
     effects: Optional[Dict[str, object]] = None,
     declared_order: Sequence[str] = DECLARED_ORDER,
+    used_out: Optional[Set[Tuple[str, int, str]]] = None,
 ) -> List[Finding]:
     """The whole static half over in-memory sources ({relpath: source})
     — the self-test entry point. Registry arguments default to the
-    shipped ones; fixtures override them."""
+    shipped ones; fixtures override them. ``used_out`` collects the
+    (path, line, token) suppressions the checks honored inline, for the
+    PTL006 stale sweep downstream."""
     mods = [Module(rp, src) for rp, src in sorted(sources.items())]
     out: List[Finding] = []
     for m in mods:
@@ -1549,12 +1552,19 @@ def race_static(
         out.extend(check_condvar_loops(m))
     out.extend(check_lock_graph(mods, aliases, declared_order, holders))
     out.extend(check_ownership(mods, retained, effects))
+    if used_out is not None:
+        for m in mods:
+            used_out.update((m.relpath, ln, tok) for ln, tok in m.used)
     return sorted(out, key=lambda f: (f.path, f.line, f.check))
 
 
 def race_repo(repo_root: str) -> List[Finding]:
     """Stage 7: static half over the analyzed repo files + the dynamic
-    epoll-seam gate, with the shared inline-suppression filter."""
-    findings = race_static(race_sources(repo_root))
+    epoll-seam gate, with the shared inline-suppression filter (stale
+    PTR suppressions come back as PTL006)."""
+    used: Set[Tuple[str, int, str]] = set()
+    findings = race_static(race_sources(repo_root), used_out=used)
     findings += check_seam_repo()
-    return apply_suppressions(findings, repo_root)
+    return apply_suppressions(
+        findings, repo_root, stale_family="PTR", inline_used=used
+    )

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from patrol_tpu.analysis.abi import AbiObligation
+from patrol_tpu.analysis.linearizability import LinSpecFamily
 from patrol_tpu.analysis.prove import JOIN_BATCH_ADAPTERS, ProveRoot, Trace
 from patrol_tpu.models.limiter import LimiterState
 from patrol_tpu.ops.commit import CommitBlocks
@@ -390,6 +391,54 @@ PROVE_ROOTS: Tuple[ProveRoot, ...] = (
         "ops.pallas_merge.merge_batch_pallas", "patrol_tpu.ops.pallas_merge",
         "merge_batch_pallas", ("PTP002", "PTP003"),
         model="pallas_interpret",
+    ),
+)
+
+
+# --- PTP006 (registration completeness): kernels the runtime engines
+# dispatch through jit that are deliberately NOT in PROVE_ROOTS, each
+# with the reason on record. analysis/prove.py sweeps the engine
+# dispatch graph and flags any jitted kernel found in neither registry —
+# a new kernel can no longer land without declared obligations.
+PROVE_EXEMPT: frozenset = frozenset(
+    {
+        # zero_rows writes constant zeros into selected rows — a pure
+        # scatter of the lattice bottom with no algebra of its own. Its
+        # lattice-facing laws are certified where they matter: the
+        # lifecycle_iszero model proves reclaim-then-recreate (which IS
+        # zero_rows + re-seed) take-observation-equivalent and join-
+        # re-entry exact (PTP002/PTP003 on ops.lifecycle.lifecycle_probe).
+        ("patrol_tpu.ops.merge", "zero_rows"),
+    }
+)
+
+
+# --- patrol-lin (stage 8): replication-aware linearizability specs, one
+# per take-capable kernel family (analysis/linearizability.py,
+# scripts/lin_repo.py, PTN001-005). Registered HERE for the same reason
+# PROVE_ROOTS is: a new kernel family without a sequential-spec
+# registration — or a weakened one — is a diff on this file. Each entry
+# names the real kernel the spec is pinned to by tests/test_lin.py's
+# differentials, the wire plane its replication model rides, and whether
+# lifecycle (refill + GC re-creation) events are in its alphabet.
+LIN_SPECS: Tuple[LinSpecFamily, ...] = (
+    LinSpecFamily(
+        "ops.take.take_batch", "patrol_tpu.ops.take", "take_batch",
+        wire="full",
+        note="classic take: v1 full-state broadcast, admission from the "
+        "full local view with the over-capacity forfeit clamp",
+    ),
+    LinSpecFamily(
+        "ops.delta.delta_fold", "patrol_tpu.ops.delta", "delta_fold",
+        wire="delta",
+        note="delta-fold ingest: wire-v2 absolute own-lane intervals, "
+        "visibility carried by the folded watermarks",
+    ),
+    LinSpecFamily(
+        "ops.lifecycle.lifecycle_probe", "patrol_tpu.ops.lifecycle",
+        "lifecycle_probe", wire="full", lifecycle=True,
+        note="lifecycle GC re-creation: IsZero reclaim with the "
+        "tombstoned own lane, refills in the schedule alphabet",
     ),
 )
 

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # patrol-check: the repo-wide static-analysis + sanitizer + prover gate.
 #
-# One command, one pass/fail exit code, seven stages (plus one opt-in):
+# One command, one pass/fail exit code, eight stages (plus one opt-in):
 #
 #   lint    — repo-specific AST checks over patrol_tpu/ (clock seams,
 #             jit-reachable sync primitives, lock order, nanotoken dtype
@@ -52,6 +52,19 @@
 #             buffer-ownership AST passes over the engine/net thread
 #             ensemble (PTR003-005); and the pytest -m race self-tests.
 #             Pure python, never skips.
+#   lin     — patrol-lin: replication-aware linearizability checking
+#             against a sequential token-bucket spec
+#             (patrol_tpu/analysis/linearizability.py,
+#             scripts/lin_repo.py): every kernel family registered in
+#             ops/obligations.py::LIN_SPECS is run through the SHARED
+#             stage-6 schedule enumerator plus a sync-delivery suite,
+#             with every take checked for justification under explicit
+#             per-node visibility (PTN001-004: per-node soundness,
+#             visibility-respecting linearization, sync-schedule
+#             exactness, no manufactured grants) and seeded lin
+#             mutations demonstrably rejected with their exact codes
+#             (PTN005); plus the pytest -m lin self-tests.
+#             Pure python, never skips.
 #   asan-py — OPT-IN (never in the default set; select explicitly with
 #             --stage): the ctypes-facing pytest subset under
 #             LD_PRELOAD=libasan with an ASan-instrumented
@@ -64,23 +77,23 @@
 #                    check.sh --stage asan-py        # the opt-in seam check
 # The final line is machine-readable so an outer CI can assert that no
 # stage silently skipped (scripts/ci_gate.sh does exactly that):
-#                    PATROL_CHECK stages=7 pass=6 skip=1 fail=0 skipped=tidy failed=-
+#                    PATROL_CHECK stages=8 pass=7 skip=1 fail=0 skipped=tidy failed=-
 #
 # Prereqs and the lint/prove suppression format are documented in
 # README.md ("patrol-check").
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-DEFAULT_STAGES="lint,tidy,san,prove,abi,protocol,race"
+DEFAULT_STAGES="lint,tidy,san,prove,abi,protocol,race,lin"
 STAGES="$DEFAULT_STAGES"
 while [[ $# -gt 0 ]]; do
   case "$1" in
     --stage|--stages) STAGES="$2"; shift 2 ;;
     --stage=*|--stages=*) STAGES="${1#*=}"; shift ;;
     -h|--help)
-      sed -n '2,72p' "$0" | sed 's/^# \{0,1\}//'
+      sed -n '2,83p' "$0" | sed 's/^# \{0,1\}//'
       exit 0 ;;
-    *) echo "unknown argument: $1 (try --stage lint,tidy,san,prove,abi,protocol,race,asan-py)" >&2
+    *) echo "unknown argument: $1 (try --stage lint,tidy,san,prove,abi,protocol,race,lin,asan-py)" >&2
        exit 2 ;;
   esac
 done
@@ -224,6 +237,18 @@ stage_race() (
   fi
 )
 
+stage_lin() (
+  set -euo pipefail
+  echo "== patrol-check [lin] replication-aware linearizability checker =="
+  python scripts/lin_repo.py
+  if have_pytest; then
+    env JAX_PLATFORMS=cpu python -m pytest tests/test_lin.py -q -m lin \
+      -p no:cacheprovider
+  else
+    echo "pytest unavailable: lin self-tests skipped (checker itself ran)"
+  fi
+)
+
 stage_asan_py() (
   set -euo pipefail
   echo "== patrol-check [asan-py] ctypes seam under LD_PRELOAD=libasan =="
@@ -287,11 +312,11 @@ run_stage() {
 IFS=',' read -r -a SELECTED <<<"$STAGES"
 for s in "${SELECTED[@]}"; do
   case "$s" in
-    lint|tidy|san|prove|abi|protocol|race|asan-py) ;;
-    *) echo "unknown stage: '$s' (valid: lint tidy san prove abi protocol race asan-py)" >&2; exit 2 ;;
+    lint|tidy|san|prove|abi|protocol|race|lin|asan-py) ;;
+    *) echo "unknown stage: '$s' (valid: lint tidy san prove abi protocol race lin asan-py)" >&2; exit 2 ;;
   esac
 done
-for s in lint tidy san prove abi protocol race asan-py; do
+for s in lint tidy san prove abi protocol race lin asan-py; do
   for sel in "${SELECTED[@]}"; do
     if [[ "$sel" == "$s" ]]; then
       case "$s" in
@@ -302,6 +327,7 @@ for s in lint tidy san prove abi protocol race asan-py; do
         abi)     run_stage abi     stage_abi ;;
         protocol) run_stage protocol stage_protocol ;;
         race)    run_stage race    stage_race ;;
+        lin)     run_stage lin     stage_lin ;;
         asan-py) run_stage asan-py stage_asan_py ;;
       esac
     fi

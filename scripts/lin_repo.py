@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Run patrol-lin — replication-aware linearizability checking against a
+sequential token-bucket spec (arXiv:2502.19967).
+
+Stage 8 of the `scripts/check.sh` gate, runnable standalone. For every
+kernel family registered in patrol_tpu/ops/obligations.py::LIN_SPECS it
+enumerates bounded schedules through the SHARED stage-6 enumerator
+(patrol_tpu/analysis/protocol.py::enumerate_schedules — takes, delivery,
+dup/drop, partition, heal, refill, GC) plus a sync-delivery suite, and
+checks every outcome against the sequential spec under explicit per-node
+visibility relations:
+
+  PTN001  per-node sequential soundness (each take justified by a
+          linearization of the ops visible to it)
+  PTN002  global visibility-respecting linearization once converged
+          (partition schedules: linearizable up to visibility)
+  PTN003  sync-delivery schedules grant EXACTLY what the sequential
+          spec grants — full linearizability, no replication slack
+  PTN004  refills/GC/cap adoption never manufacture a grant the spec
+          refuses under ANY visibility extension
+  PTN005  meta: every seeded lin mutation rejected with its exact code,
+          every mutation knob exercised (the trust story)
+
+Exit code 0 = every family clean AND every seeded mutation caught;
+1 = findings printed one per line as `path:line: CODE message`.
+
+Pure python model (no accelerator); deterministic — a CI failure
+replays exactly, and each finding carries its witness schedule.
+"""
+
+import argparse
+import os
+import sys
+
+# The model itself is pure python; obligations.py (the spec registry)
+# imports jax, so pin the platform like the other static stages.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--mutation",
+        default=None,
+        help="run ONE named mutation and print what catches it (debug aid)",
+    )
+    ap.add_argument(
+        "--list",
+        action="store_true",
+        help="list registered spec families and mutations, then exit",
+    )
+    args = ap.parse_args()
+
+    from patrol_tpu.analysis import linearizability as lin
+    from patrol_tpu.ops.obligations import LIN_SPECS
+
+    if args.list:
+        for spec in LIN_SPECS:
+            flags = f"wire={spec.wire}" + (
+                " lifecycle" if spec.lifecycle else ""
+            )
+            print(f"family   {spec.name}  [{flags}]")
+        for name, mut in lin.LIN_MUTATIONS.items():
+            print(f"mutation {name}  → {mut.expect} on {mut.family}")
+        return 0
+
+    if args.mutation:
+        mut = lin.LIN_MUTATIONS.get(args.mutation)
+        if mut is None:
+            print(f"unknown mutation: {args.mutation}", file=sys.stderr)
+            return 2
+        spec = next((s for s in LIN_SPECS if s.name == mut.family), None)
+        if spec is None:
+            print(f"family not registered: {mut.family}", file=sys.stderr)
+            return 2
+        explored, findings = lin.check_family(
+            spec, mut.laws, stop_at_first=False
+        )
+        for f in findings:
+            print(f)
+        hit = any(f.check == mut.expect for f in findings)
+        print(
+            f"patrol-lin: mutation '{args.mutation}' "
+            + (
+                f"REJECTED by {mut.expect} (good)"
+                if hit
+                else f"NOT caught by {mut.expect} (bad)"
+            )
+            + f" — {explored} schedules"
+        )
+        return 0 if hit else 1
+
+    from patrol_tpu.analysis.lint import apply_suppressions
+
+    explored, findings = lin.check_repo(LIN_SPECS)
+    findings = apply_suppressions(findings, REPO_ROOT, stale_family="PTN")
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"patrol-lin: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print(
+        "patrol-lin: clean "
+        f"(schedules explored={explored} across {len(LIN_SPECS)} kernel "
+        f"families, {len(lin.LIN_MUTATIONS)} seeded mutations all "
+        "rejected with their exact codes)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

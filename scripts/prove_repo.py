@@ -73,7 +73,10 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
-    print(f"patrol-prove: clean ({len(roots)} roots, all obligations hold)")
+    print(
+        f"patrol-prove: clean ({len(roots)} roots, all obligations hold; "
+        "engine dispatch graph fully registered)"
+    )
     return 0
 
 

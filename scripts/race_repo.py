@@ -86,8 +86,12 @@ def main() -> int:
     if args.static_only:
         from patrol_tpu.analysis.lint import apply_suppressions
 
+        used = set()
         findings = apply_suppressions(
-            race.race_static(race.race_sources(REPO_ROOT)), REPO_ROOT
+            race.race_static(race.race_sources(REPO_ROOT), used_out=used),
+            REPO_ROOT,
+            stale_family="PTR",
+            inline_used=used,
         )
     else:
         findings = race.race_repo(REPO_ROOT)

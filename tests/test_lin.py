@@ -1,0 +1,428 @@
+"""patrol-lin self-tests (PTN001-PTN005) — the `pytest -m lin` slice of
+the scripts/check.sh stage-8 gate.
+
+Three layers, mirroring the other analysis suites:
+
+* **differential tests** pin the sequential spec and the model's laws to
+  the REAL kernels: the take law is HostLanes.take / take_batch's
+  admission (including the over-capacity forfeit clamp), the delta
+  visibility is the wire-v2 fold (ops/delta.delta_fold), the GC law is
+  the lifecycle IsZero reclaim with the tombstoned own lane;
+* **fixture self-tests** prove every PTN code BOTH ways — it fires on
+  its seeded mutation with the exact expected code and stays silent on
+  the clean laws;
+* **the repo gate** runs the full stage-8 sweep (clean families + all
+  seeded mutations rejected + PTN005 domain completeness).
+"""
+
+import numpy as np
+import pytest
+
+from patrol_tpu.analysis import linearizability as L
+from patrol_tpu.analysis import protocol as P
+
+pytestmark = pytest.mark.lin
+
+NANO = 1_000_000_000
+
+
+def specs():
+    from patrol_tpu.ops.obligations import LIN_SPECS
+
+    return LIN_SPECS
+
+
+def spec_by_name(name):
+    return next(s for s in specs() if s.name == name)
+
+
+def codes(findings):
+    return sorted({f.check for f in findings})
+
+
+class TestSequentialSpec:
+    def test_take_grants_down_to_zero_then_refuses(self):
+        s = L.SequentialSpec(2)
+        assert s.take() and s.take() and not s.take()
+        assert s.tokens == 0
+
+    def test_refill_caps_at_capacity(self):
+        s = L.SequentialSpec(2)
+        s.take()
+        s.refill(5)
+        assert s.tokens == 2
+
+    def test_debit_replays_partition_overshoot_negative(self):
+        s = L.SequentialSpec(1)
+        s.debit()
+        s.debit()
+        assert s.tokens == -1  # the priced AP overshoot, not a grant
+
+    def test_gc_is_permitted_only_at_full(self):
+        s = L.SequentialSpec(2)
+        assert s.gc()
+        s.take()
+        assert not s.gc()
+        s.refill()
+        assert s.gc()
+
+
+class TestDifferentialTakeKernel:
+    """The model's take law IS the kernel's admission — grant-for-grant
+    against HostLanes.take (docstring-pinned step-for-step twin of
+    ops/take.py::take_batch) on a frozen clock."""
+
+    def _lanes(self, nodes=2):
+        from patrol_tpu.runtime.engine import HostLanes
+
+        return HostLanes(nodes=nodes)
+
+    def _rate(self):
+        from patrol_tpu.ops.rate import Rate
+
+        return Rate(freq=3, per_ns=3600 * NANO)
+
+    def test_spec_is_the_kernel_admission_sequence(self):
+        # Frozen clock ⇒ zero refill grant: admission is exactly the
+        # sequential balance walk.
+        lanes, rate = self._lanes(), self._rate()
+        spec = L.SequentialSpec(3)
+        for _ in range(5):
+            _, ok = lanes.take(
+                cap_base_nt=3 * NANO, created_ns=0, now_ns=0,
+                rate=rate, count=1, node_slot=0,
+            )
+            assert ok == spec.take()
+
+    def test_model_take_is_the_kernel_admission_sequence(self):
+        lanes, rate = self._lanes(), self._rate()
+        c = L.LinCluster(2, 3)
+        for k in range(5):
+            _, ok = lanes.take(
+                cap_base_nt=3 * NANO, created_ns=0, now_ns=0,
+                rate=rate, count=1, node_slot=0,
+            )
+            c.take(0)
+            assert c.ledger.ops[k].granted == ok
+        assert [int(t) // NANO for t in lanes.taken] == c.nodes[0].taken
+
+    def test_forfeit_clamp_matches_the_kernel(self):
+        """Over-capacity view (a GC'd peer-lane copy re-merged): the
+        kernel books the excess into the own taken lane before the
+        grant; the model must book the SAME watermark."""
+        lanes, rate = self._lanes(), self._rate()
+        lanes.added[1] = 5 * NANO  # merged remote refills push past cap
+        _, ok = lanes.take(
+            cap_base_nt=3 * NANO, created_ns=0, now_ns=0,
+            rate=rate, count=1, node_slot=0,
+        )
+        assert ok
+        c = L.LinCluster(2, 3)
+        c.nodes[0].added[1] = 5
+        c.take(0)
+        assert c.ledger.ops[0].granted
+        assert [int(t) // NANO for t in lanes.taken] == c.nodes[0].taken
+        assert [int(a) // NANO for a in lanes.added] == c.nodes[0].added
+        # The op's lane identity carries the clamp: watermark 6, not 1.
+        assert c.ledger.ops[0].lane == ("taken", 6)
+
+
+class TestDifferentialDeltaVisibility:
+    """The delta-plane visibility is the wire-v2 fold: the model's lane
+    state after ingesting an interval must equal ops/delta.delta_fold
+    over the same interval, and the fold's watermarks are exactly what
+    the receiver is credited with having seen."""
+
+    def test_model_fold_is_the_delta_fold_kernel(self):
+        import jax.numpy as jnp
+
+        from patrol_tpu.models.limiter import LimiterConfig, init_state
+        from patrol_tpu.ops.delta import DeltaBatch, delta_fold
+
+        c = L.LinCluster(2, 2, wire="delta")
+        c.take(0)
+        c.take(0)
+        c.flush(0)
+        c.deliver_all()
+        out = delta_fold(
+            init_state(LimiterConfig(buckets=4, nodes=2)),
+            DeltaBatch(
+                rows=jnp.zeros(1, jnp.int32),
+                slots=jnp.zeros(1, jnp.int32),
+                added_nt=jnp.asarray([c.nodes[0].added[0]]),
+                taken_nt=jnp.asarray([c.nodes[0].taken[0]]),
+                elapsed_ns=jnp.zeros(1, jnp.int64),
+            ),
+        )
+        pn = np.asarray(out.pn[0])
+        assert list(pn[:, 0]) == c.nodes[1].added
+        assert list(pn[:, 1]) == c.nodes[1].taken
+
+    def test_fold_watermarks_carry_visibility(self):
+        c = L.LinCluster(2, 2, wire="delta")
+        c.take(0)
+        c.take(0)
+        assert c.seen[1] == set()  # nothing delivered yet
+        c.flush(0)
+        c.deliver_all()
+        # One folded interval at watermark 2 proves BOTH takes delivered.
+        assert c.seen[1] == {0, 1}
+
+    def test_undelivered_ops_stay_invisible(self):
+        c = L.LinCluster(2, 2)
+        c.take(0)
+        # The full-state datagram is in flight, not delivered: node 1
+        # has learned nothing yet.
+        assert c.seen[1] == set()
+
+
+class TestDifferentialLifecycle:
+    """The model's GC law is the lifecycle IsZero reclaim: the collect
+    is gated on the kernel's fullness verdict and keeps the tombstoned
+    own lane — the re-creation path's conservation design."""
+
+    def _full(self, sum_added_nt, sum_taken_nt, cap_nt):
+        from patrol_tpu.ops.lifecycle import host_lifecycle_full
+
+        # Frozen clock, zero elapsed: the verdict is the standing-balance
+        # comparison, the exact algebra the model's tokens>=limit uses.
+        return bool(
+            host_lifecycle_full(
+                np.asarray([sum_added_nt], np.int64),
+                np.asarray([sum_taken_nt], np.int64),
+                np.asarray([0], np.int64),
+                np.asarray([cap_nt], np.int64),
+                np.asarray([0], np.int64),
+                np.asarray([0], np.int64),
+                np.asarray([3600 * NANO], np.int64),
+            )[0]
+        )
+
+    def test_gc_gate_is_the_iszero_verdict(self):
+        c = L.LinCluster(2, 2, lifecycle=True)
+        c.take(0)
+        node = c.nodes[0]
+        assert not self._full(
+            NANO * sum(node.added), NANO * sum(node.taken), 2 * NANO
+        )
+        assert not node.gc(c.sem)
+        c.refill(0)
+        assert self._full(
+            NANO * sum(node.added), NANO * sum(node.taken), 2 * NANO
+        )
+        assert node.gc(c.sem)
+
+    def test_clean_collect_keeps_the_tombstoned_own_lane(self):
+        c = L.LinCluster(2, 1, lifecycle=True)
+        c.take(0)
+        c.refill(0)
+        c.gc(0)
+        # The own lane survives the collect (engine re-seeds it at
+        # re-creation) — the ledger's watermarks stay reachable.
+        assert c.nodes[0].added[0] == 1
+        assert c.nodes[0].taken[0] == 1
+
+    def test_forget_admits_collect_drops_the_own_lane(self):
+        c = L.LinCluster(
+            2, 1, laws=L.LinLaws(gc="forget-admits"), lifecycle=True
+        )
+        c.take(0)
+        c.refill(0)
+        c.gc(0)
+        assert c.nodes[0].added[0] == 0
+        assert c.nodes[0].taken[0] == 0
+
+
+class TestFindingFixtures:
+    """Every PTN code both ways: fires on its seeded law, silent on the
+    clean law, with the EXACT expected code."""
+
+    def test_clean_take_family_is_silent(self):
+        explored, findings = L.check_family(
+            spec_by_name("ops.take.take_batch"), L.CLEAN_LAWS
+        )
+        assert findings == []
+        assert explored > 100
+
+    def test_clean_delta_family_is_silent(self):
+        _, findings = L.check_family(
+            spec_by_name("ops.delta.delta_fold"), L.CLEAN_LAWS
+        )
+        assert findings == []
+
+    def test_clean_lifecycle_family_is_silent(self):
+        _, findings = L.check_family(
+            spec_by_name("ops.lifecycle.lifecycle_probe"), L.CLEAN_LAWS
+        )
+        assert findings == []
+
+    @pytest.mark.parametrize("name", sorted(L.LIN_MUTATIONS))
+    def test_each_seeded_mutation_rejected_with_its_exact_code(self, name):
+        mut = L.LIN_MUTATIONS[name]
+        _, findings = L.check_family(
+            spec_by_name(mut.family), mut.laws, stop_at_first=False
+        )
+        assert mut.expect in codes(findings), (name, codes(findings))
+
+    def test_ptn001_message_names_the_ignored_knowledge(self):
+        mut = L.LIN_MUTATIONS["take-ignores-visible-remote-spend"]
+        _, findings = L.check_family(
+            spec_by_name(mut.family), mut.laws, stop_at_first=False
+        )
+        f = next(x for x in findings if x.check == "PTN001")
+        assert "delivered knowledge was ignored" in f.message
+        assert "schedule:" in f.message or "events:" in f.message
+
+    def test_ptn003_sync_schedules_prove_full_linearizability(self):
+        """The acceptance claim, stated positively: on sync-delivery
+        schedules with no partition the clean model is outcome-for-
+        outcome the sequential spec (zero PTN003 findings over the
+        whole sync suite)."""
+        for name in (
+            "ops.take.take_batch",
+            "ops.lifecycle.lifecycle_probe",
+        ):
+            explored, findings = L.check_sync_lin(
+                spec_by_name(name), L.CLEAN_LAWS
+            )
+            assert findings == []
+            assert explored >= 32  # ≥ (no-partition + split) × |alphabet|^4
+
+    def test_ptn002_partition_schedules_linearizable_up_to_visibility(self):
+        """Partition layouts run inside the same sync suite with
+        sync=False: each side's outcomes must be justified by side-
+        visible history — clean laws produce no PTN002 anywhere."""
+        c = L.LinCluster(2, 2)
+        c.set_partition({0: 0, 1: 1})
+        # Both sides spend their full view independently: the AP
+        # overshoot is priced (debit may go negative) but every grant
+        # is visible-justified.
+        for i in (0, 1):
+            c.take(i)
+            c.take(i)
+            c.take(i)
+        c.heal_and_converge()
+        c.check_terminal()
+        assert sum(n.admitted for n in c.nodes) == 4  # limit × sides
+
+    def test_ptn004_fires_only_with_lifecycle_in_the_alphabet(self):
+        """The manufactured-grant class needs a reclaim/refill to do the
+        manufacturing: the non-lifecycle families must report the
+        ignore-remote bug as PTN001, never PTN004."""
+        _, findings = L.check_family(
+            spec_by_name("ops.take.take_batch"),
+            L.LinLaws(take="ignore-remote"),
+            stop_at_first=False,
+        )
+        assert "PTN004" not in codes(findings)
+
+    def test_findings_carry_replayable_witness_schedules(self):
+        mut = L.LIN_MUTATIONS["grant-exceeds-spec-on-sync-schedule"]
+        _, findings = L.check_family(
+            spec_by_name(mut.family), mut.laws, stop_at_first=False
+        )
+        f = next(x for x in findings if x.check == mut.expect)
+        assert "(" in f.message and "take" in f.message
+
+
+class TestTrustStory:
+    """PTN005 both ways: the meta-check must flag a checker that lost
+    its teeth, an unregistered family, and an unexercised mutation knob
+    — and stay silent on the shipped registry."""
+
+    def test_toothless_mutation_is_flagged(self, monkeypatch):
+        monkeypatch.setitem(
+            L.LIN_MUTATIONS,
+            "does-nothing",
+            L.LinMutation(
+                L.CLEAN_LAWS, family="ops.take.take_batch", expect="PTN001"
+            ),
+        )
+        _, findings = L.check_repo(specs())
+        assert any(
+            f.check == "PTN005" and "does-nothing" in f.message
+            for f in findings
+        )
+
+    def test_unregistered_family_is_flagged(self, monkeypatch):
+        monkeypatch.setitem(
+            L.LIN_MUTATIONS,
+            "orphan",
+            L.LinMutation(
+                L.LinLaws(take="off-by-one"),
+                family="ops.nonexistent.kernel",
+                expect="PTN003",
+            ),
+        )
+        _, findings = L.check_repo(specs())
+        assert any(
+            f.check == "PTN005" and "unregistered family" in f.message
+            for f in findings
+        )
+
+    def test_unexercised_law_knob_is_flagged(self, monkeypatch):
+        pruned = {
+            k: v
+            for k, v in L.LIN_MUTATIONS.items()
+            if v.laws.take != "clairvoyant"
+        }
+        monkeypatch.setattr(L, "LIN_MUTATIONS", pruned)
+        _, findings = L.check_repo(specs())
+        assert any(
+            f.check == "PTN005" and "clairvoyant" in f.message
+            for f in findings
+        )
+
+    def test_every_law_knob_has_a_registered_mutation(self):
+        for field, values in L.LAW_DOMAINS.items():
+            default = getattr(L.CLEAN_LAWS, field)
+            for value in values:
+                if value == default:
+                    continue
+                assert any(
+                    getattr(m.laws, field) == value
+                    for m in L.LIN_MUTATIONS.values()
+                ), (field, value)
+
+    def test_every_mutation_expects_a_distinct_code(self):
+        expected = {m.expect for m in L.LIN_MUTATIONS.values()}
+        assert expected == {"PTN001", "PTN002", "PTN003", "PTN004"}
+
+
+class TestRepoGate:
+    def test_stage8_repo_gate_is_clean(self):
+        """The stage-8 contract: clean families, all seeded mutations
+        rejected with their exact codes, all knobs exercised."""
+        explored, findings = L.check_repo(specs())
+        assert findings == [], "\n".join(str(f) for f in findings)
+        assert explored > 10_000  # the sweep is not vacuous
+
+    def test_registered_families_cover_the_take_capable_kernels(self):
+        names = {s.name for s in specs()}
+        assert names == {
+            "ops.take.take_batch",
+            "ops.delta.delta_fold",
+            "ops.lifecycle.lifecycle_probe",
+        }
+
+    def test_shared_enumerator_is_stage6s(self):
+        """patrol-lin consumes protocol.enumerate_schedules — one
+        schedule space, no drift. The LinCluster must ride the SAME
+        generator the stage-6 checker uses."""
+        bounds = P.ScheduleBounds(takes=2, disruptions=1)
+        base = {
+            t.events
+            for t in P.enumerate_schedules(P.CLEAN, bounds)
+        }
+        lin = {
+            t.events
+            for t in P.enumerate_schedules(
+                P.CLEAN,
+                bounds,
+                lambda n, limit, sem: L.LinCluster(n, limit),
+            )
+        }
+        # The lin memo key refines the base key (visible histories
+        # distinguish lane-identical states), so the lin run reaches a
+        # SUPERSET of the base terminals — never a different space.
+        assert base and base <= lin

@@ -510,11 +510,99 @@ class TestGenericSuppression:
         assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
 
     def test_disable_of_other_code_does_not_mask(self):
+        # The PTL004 token masks nothing here, so it is ALSO stale.
         src = (
             "import time\n\ndef f():\n"
             "    return time.time()  # patrol-lint: disable=PTL004\n"
         )
-        assert codes(lint.lint_sources({"patrol_tpu/x.py": src})) == ["PTL001"]
+        assert codes(lint.lint_sources({"patrol_tpu/x.py": src})) == [
+            "PTL001",
+            "PTL006",
+        ]
+
+
+class TestStaleSuppression:
+    """PTL006: a directive that suppresses nothing is itself a finding —
+    proven both ways, plus the shared family sweep other stages inherit
+    through apply_suppressions."""
+
+    def test_fires_on_directive_that_masks_nothing(self):
+        src = "def f(x):\n    return x + 1  # patrol-lint: disable=PTL001\n"
+        f = lint.lint_sources({"patrol_tpu/x.py": src})
+        assert codes(f) == ["PTL006"]
+        assert "PTL001" in f[0].message
+
+    def test_fires_on_unused_marker(self):
+        src = "def f(x):\n    return x  # patrol-lint: clock-seam\n"
+        assert codes(lint.lint_sources({"patrol_tpu/x.py": src})) == ["PTL006"]
+
+    def test_silent_when_directive_suppresses_a_finding(self):
+        src = (
+            "import time\n\ndef f():\n"
+            "    return time.time()  # patrol-lint: disable=PTL001\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def test_self_suppression_escape_hatch(self):
+        # disable=PTL006 on the line tolerates the stale token there.
+        src = (
+            "def f(x):\n"
+            "    return x  # patrol-lint: disable=PTL001,PTL006\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def test_directive_inside_string_literal_is_prose(self):
+        # Docs ABOUT the machinery must not register as directives (the
+        # tokenizer separates comments from strings).
+        src = 'DOC = "use `# patrol-lint: clock-seam` to declare seams"\n'
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def test_other_family_tokens_are_not_linted_here(self):
+        # A PTP directive is prove's to audit (via apply_suppressions),
+        # not the lint stage's.
+        src = "def f(x):\n    return x  # patrol-lint: disable=PTP001\n"
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def _tmp_repo(self, tmp_path, src):
+        pkg = tmp_path / "patrol_tpu"
+        pkg.mkdir()
+        (pkg / "x.py").write_text(src)
+        return str(tmp_path)
+
+    def test_family_sweep_fires_on_stale_prove_directive(self, tmp_path):
+        root = self._tmp_repo(
+            tmp_path, "def f(x):\n    return x  # patrol-lint: disable=PTP001\n"
+        )
+        f = lint.apply_suppressions([], root, stale_family="PTP")
+        assert codes(f) == ["PTL006"]
+        assert f[0].path == "patrol_tpu/x.py"
+
+    def test_family_sweep_silent_when_directive_is_used(self, tmp_path):
+        root = self._tmp_repo(
+            tmp_path, "def f(x):\n    return x  # patrol-lint: disable=PTP001\n"
+        )
+        finding = lint.Finding("PTP001", "patrol_tpu/x.py", 2, "seeded")
+        assert lint.apply_suppressions([finding], root, stale_family="PTP") == []
+
+    def test_family_sweep_honors_inline_used(self, tmp_path):
+        # Checkers (race) that consume directives during the checks report
+        # usage out-of-band; the sweep must trust it.
+        root = self._tmp_repo(
+            tmp_path, "def f(x):\n    return x  # patrol-lint: disable=PTR003\n"
+        )
+        used = {("patrol_tpu/x.py", 2, "PTR003")}
+        assert (
+            lint.apply_suppressions(
+                [], root, stale_family="PTR", inline_used=used
+            )
+            == []
+        )
+
+    def test_family_sweep_ignores_other_families(self, tmp_path):
+        root = self._tmp_repo(
+            tmp_path, "def f(x):\n    return x  # patrol-lint: disable=PTA001\n"
+        )
+        assert lint.apply_suppressions([], root, stale_family="PTP") == []
 
 
 class TestRepoIsClean:

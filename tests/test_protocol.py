@@ -272,3 +272,73 @@ class TestGcConservation:
             c.heal_and_converge()
             states = {n.state() for n in c.nodes}
             assert len(states) == 1, sem
+
+
+class TestScheduleEnumerator:
+    """The reusable enumerate_schedules generator (the ONE schedule
+    space stages 6 and 8 both consume): terminals carry replayable
+    event trails, the budget-derived depth cap is honored and marked,
+    and a cluster_factory subclass rides the same enumeration."""
+
+    def _replay(self, events, sem, bounds):
+        c = P.Cluster(bounds.n_nodes, bounds.limit, sem)
+        for mv in events:
+            if mv[0] == "take":
+                c.take(mv[1])
+            elif mv[0] == "refill":
+                c.refill(mv[1])
+            elif mv[0] == "gc":
+                c.gc(mv[1])
+            elif mv[0] == "partition":
+                c.set_partition(dict(mv[1]))
+            elif mv[0] == "heal":
+                c.set_partition(None)
+            elif mv[0] == "flush":
+                c.flush(mv[1])
+            elif mv[0] == "deliver":
+                c.deliver(mv[1], mv[2], mv[3])
+            elif mv[0] == "dup":
+                c.deliver(mv[1], mv[2], mv[3], dup=True)
+            else:  # drop
+                c.drop(mv[1], mv[2], mv[3])
+        return c
+
+    def test_every_terminal_trail_replays_to_its_state(self):
+        bounds = P.ScheduleBounds(takes=2, disruptions=1)
+        for term in P.enumerate_schedules(P.CLEAN, bounds):
+            replayed = self._replay(term.events, P.CLEAN, bounds)
+            assert [n.state() for n in replayed.nodes] == [
+                n.state() for n in term.cluster.nodes
+            ], term.events
+
+    def test_explored_count_matches_the_stage6_consumer(self):
+        """check_async_schedules is a thin consumer: on the clean
+        protocol (no early break) its explored count IS the generator's
+        terminal count for the same bounds."""
+        explored, findings = P.check_async_schedules()
+        assert findings == []
+        terminals = sum(1 for _ in P.enumerate_schedules(P.CLEAN))
+        assert terminals == explored
+
+    def test_depth_cap_is_marked_not_silent(self):
+        bounds = P.ScheduleBounds(takes=2, disruptions=0, depth=1)
+        terms = list(P.enumerate_schedules(P.CLEAN, bounds))
+        assert terms
+        assert all(t.depth_capped for t in terms)
+        assert all(len(t.events) <= 1 for t in terms)
+
+    def test_cluster_factory_rides_the_enumeration(self):
+        class Tagged(P.Cluster):
+            def _clone_empty(self):
+                return Tagged(len(self.nodes), self.nodes[0].limit, self.sem)
+
+        made = []
+
+        def factory(n, limit, sem):
+            made.append((n, limit))
+            return Tagged(n, limit, sem)
+
+        bounds = P.ScheduleBounds(takes=1, disruptions=0)
+        terms = list(P.enumerate_schedules(P.CLEAN, bounds, factory))
+        assert made == [(bounds.n_nodes, bounds.limit)]
+        assert terms and all(isinstance(t.cluster, Tagged) for t in terms)

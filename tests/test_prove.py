@@ -387,6 +387,113 @@ class TestRepoIsProven:
         assert "PTP004" in obl
 
 
+_UNREGISTERED_DISPATCH = {
+    "patrol_tpu/runtime/engine.py": (
+        "from functools import lru_cache\n"
+        "import jax\n"
+        "from patrol_tpu.ops.frob import frob_batch, FrobRequest\n"
+        "\n"
+        "@lru_cache(maxsize=8)\n"
+        "def _jit_frob():\n"
+        "    def step(state, packed):\n"
+        "        req = FrobRequest(packed)\n"
+        "        return frob_batch(state, req)\n"
+        "    return jax.jit(step, donate_argnums=0)\n"
+    ),
+    "patrol_tpu/ops/frob.py": (
+        "class FrobRequest:\n"
+        "    def __init__(self, packed):\n"
+        "        self.packed = packed\n"
+        "\n"
+        "def frob_batch(state, req):\n"
+        "    return state\n"
+    ),
+}
+
+
+class TestRegistrationCompleteness:
+    """PTP006: the engine dispatch graph may only reach registered (or
+    explicitly exempted) kernels — proven both ways on fixtures, plus the
+    non-vacuous discovery guard on the real tree."""
+
+    def test_seeded_unregistered_dispatch_is_rejected(self):
+        f = prove.registration_findings(_UNREGISTERED_DISPATCH, registered=set())
+        assert codes(f) == ["PTP006"]
+        assert "patrol_tpu.ops.frob.frob_batch" in f[0].message
+        # The request constructor is NOT mistaken for a kernel.
+        assert "FrobRequest" not in f[0].message
+
+    def test_registered_dispatch_is_clean(self):
+        reg = {("patrol_tpu.ops.frob", "frob_batch")}
+        assert prove.registration_findings(_UNREGISTERED_DISPATCH, registered=reg) == []
+
+    def test_exempt_set_counts_as_registered(self):
+        from patrol_tpu.ops.obligations import PROVE_EXEMPT
+
+        assert ("patrol_tpu.ops.merge", "zero_rows") in PROVE_EXEMPT
+
+    def test_prejitted_suffix_names_are_dispatches(self):
+        srcs = {
+            "patrol_tpu/runtime/engine.py": (
+                "from patrol_tpu.ops.frob import frob_batch_jit\n"
+                "def tick(self, state, rows):\n"
+                "    return frob_batch_jit(state, rows)\n"
+            ),
+            "patrol_tpu/ops/frob.py": (
+                "def frob_batch(state, rows):\n    return state\n"
+                "frob_batch_jit = frob_batch\n"
+            ),
+        }
+        f = prove.registration_findings(srcs, registered=set())
+        assert codes(f) == ["PTP006"]
+        assert "patrol_tpu.ops.frob.frob_batch " in f[0].message
+
+    def test_module_alias_dispatch_through_builder_chain(self):
+        # The topology idiom: jit(wrapper(partial(module_level_step))).
+        srcs = {
+            "patrol_tpu/parallel/topology.py": (
+                "from functools import partial\n"
+                "import jax\n"
+                "from patrol_tpu.ops import frob as frob_mod\n"
+                "\n"
+                "def cluster_step(state, reqs):\n"
+                "    return frob_mod.frob_batch(state, reqs)\n"
+                "\n"
+                "def build(mesh):\n"
+                "    fn = partial(cluster_step)\n"
+                "    return jax.jit(fn, donate_argnums=0)\n"
+            ),
+            "patrol_tpu/ops/frob.py": "def frob_batch(state, reqs):\n    return state\n",
+        }
+        f = prove.registration_findings(srcs, registered=set())
+        assert codes(f) == ["PTP006"]
+
+    def test_real_dispatch_graph_is_discovered(self):
+        """Guard against a vacuously-clean PTP006: the engines' actual
+        kernels must be visible to the sweep."""
+        from patrol_tpu.analysis.lint import repo_sources
+
+        f = prove.registration_findings(repo_sources(REPO_ROOT), registered=set())
+        found = {m.split(" is dispatched")[0].split()[-1] for m in (x.message for x in f)}
+        for kernel in (
+            "patrol_tpu.ops.take.take_batch",
+            "patrol_tpu.ops.merge.merge_batch",
+            "patrol_tpu.ops.merge.merge_batch_folded",
+            "patrol_tpu.ops.commit.commit_blocks",
+            "patrol_tpu.ops.delta.delta_fold",
+            "patrol_tpu.ops.ingest.decode_fold_raw",
+            "patrol_tpu.ops.lifecycle.lifecycle_probe",
+            "patrol_tpu.ops.merge.zero_rows",
+        ):
+            assert kernel in found, kernel
+
+    def test_real_dispatch_graph_is_registered(self):
+        from patrol_tpu.analysis.lint import repo_sources
+
+        f = prove.registration_findings(repo_sources(REPO_ROOT))
+        assert f == [], "\n".join(str(x) for x in f)
+
+
 def add_delta_fold(state, batch):
     """Seeded wire-v2 rx-fold bug: accumulating an interval instead of
     joining it — duplicated/retransmitted intervals would inflate state."""
