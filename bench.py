@@ -1526,6 +1526,87 @@ def smoke_main() -> int:
             "raw ingest never dispatched"
         )
 
+        # -- cert-kit kernel families (check.sh stage 9 cross-check) ------
+        # Drive each certified lattice kernel end-to-end through the
+        # engine's device dispatch and gate the admitted counts against a
+        # literal python replay of the registered sequential semantics —
+        # the same reference shape the prove models check bit-exactly.
+        e_cert = DeviceEngine(cfg, node_slot=0)
+        try:
+            # GCRA: two ticks on three fresh rows; TAT advances by k*T.
+            def gcra_ref(tat, now, t, tol, nreq):
+                if tat > now + tol:
+                    return 0, tat
+                base = max(tat, now)
+                k = min(1 + (now + tol - base) // t, nreq)
+                return k, base + k * t
+
+            rows3 = [0, 1, 2]
+            tats = [0, 0, 0]
+            want_gcra = 0
+            got_gcra = 0
+            for now in (1_000, 1_100):
+                res = e_cert.gcra_take(
+                    rows3, [now] * 3, [100] * 3, [300] * 3, [5] * 3
+                )
+                got_gcra += int(np.asarray(res.admitted).sum())
+                for i in range(3):
+                    k, tats[i] = gcra_ref(tats[i], now, 100, 300, 5)
+                    want_gcra += k
+                assert np.asarray(res.own_tat_ns).tolist() == tats, (
+                    "gcra device TAT diverged from the sequential replay"
+                )
+            assert got_gcra == want_gcra, (
+                f"gcra admitted {got_gcra} != sequential {want_gcra}"
+            )
+            OUT["cert_gcra_admitted"] = got_gcra
+
+            # Concurrency: acquire to the limit, release two, re-acquire.
+            rows3 = [3, 4, 5]
+            got_conc = 0
+            res = e_cert.conc_acquire(
+                rows3, [5] * 3, [1] * 3, [8] * 3, [0] * 3
+            )
+            got_conc += int(np.asarray(res.admitted).sum())
+            assert np.asarray(res.admitted).tolist() == [5] * 3
+            res = e_cert.conc_acquire(
+                rows3, [5] * 3, [1] * 3, [4] * 3, [2] * 3
+            )
+            got_conc += int(np.asarray(res.admitted).sum())
+            assert np.asarray(res.released_nt).tolist() == [2] * 3
+            assert np.asarray(res.admitted).tolist() == [2] * 3, (
+                "conc re-acquire after release diverged from the "
+                "held-lease replay"
+            )
+            assert np.asarray(res.inflight_nt).tolist() == [5] * 3
+            OUT["cert_conc_admitted"] = got_conc
+
+            # Hierarchical quota: distinct 3-level paths, global pool
+            # tighter than the leaf allowance; second tick must starve.
+            paths = dict(
+                rows_global=[6, 7],
+                rows_tenant=[8, 9],
+                rows_user=[10, 11],
+                limit_global_nt=[10] * 2,
+                limit_tenant_nt=[6] * 2,
+                limit_user_nt=[4] * 2,
+                count_nt=[1] * 2,
+            )
+            res = e_cert.quota_take(nreq=[5] * 2, **paths)
+            got_quota = int(np.asarray(res.admitted).sum())
+            assert np.asarray(res.admitted).tolist() == [4] * 2, (
+                "quota path-minimum admission diverged (leaf limit 4)"
+            )
+            res = e_cert.quota_take(nreq=[5] * 2, **paths)
+            assert np.asarray(res.admitted).tolist() == [0] * 2, (
+                "quota second tick must starve: the leaf pool is spent"
+            )
+            got_quota += int(np.asarray(res.admitted).sum())
+            OUT["cert_quota_admitted"] = got_quota
+            OUT["cert_kernels"] = "bit-exact"
+        finally:
+            e_cert.stop()
+
         # -- patrol-scope gates -------------------------------------------
         # (1) rx-decode stage samples: drive real wire packets through the
         # instrumented replication rx path (no sockets — Replicator._ingest

@@ -119,6 +119,72 @@ class SequentialSpec:
         return self.tokens == self.limit
 
 
+class SequentialGcra:
+    """THE sequential GCRA: one theoretical-arrival-time register,
+    emission interval 1, tolerance ``limit - 1`` (burst = ``limit``) —
+    the unreplicated object whose per-request loop ops/gcra.py's closed
+    form compresses. ``take`` conforms iff TAT is within tolerance of
+    now, then advances TAT one emission interval past ``max(TAT, now)``.
+    """
+
+    __slots__ = ("tol", "tat")
+
+    def __init__(self, limit: int):
+        self.tol = limit - 1
+        self.tat = 0
+
+    def take(self, now: int) -> bool:
+        if self.tat <= now + self.tol:
+            self.tat = max(self.tat, now) + 1
+            return True
+        return False
+
+
+class SequentialConc:
+    """THE sequential concurrency limiter with client-owned leases:
+    acquire grants while total held < ``limit``; a client may release
+    only its OWN holds. The kernel's own-lane release clamp
+    (ops/concurrency.py) is exactly this ownership rule, sequentially —
+    a release of someone else's lease is refused, not absorbed."""
+
+    __slots__ = ("limit", "held")
+
+    def __init__(self, limit: int, clients: int):
+        self.limit = limit
+        self.held = [0] * clients
+
+    def acquire(self, client: int) -> bool:
+        if sum(self.held) < self.limit:
+            self.held[client] += 1
+            return True
+        return False
+
+    def release(self, client: int) -> bool:
+        if self.held[client] > 0:
+            self.held[client] -= 1
+            return True
+        return False
+
+
+class SequentialQuota:
+    """THE sequential hierarchical quota for one path: a single spend
+    counter checked against EVERY level's budget — a take debits all
+    levels together (ops/hierquota.py's all-or-nothing packed debit),
+    so one counter serves global, tenant and user alike."""
+
+    __slots__ = ("limits", "spent")
+
+    def __init__(self, limits: Tuple[int, int, int]):
+        self.limits = limits
+        self.spent = 0
+
+    def take(self) -> bool:
+        if all(self.spent < lim for lim in self.limits):
+            self.spent += 1
+            return True
+        return False
+
+
 # ---------------------------------------------------------------------------
 # laws + seeded mutations
 
@@ -155,16 +221,25 @@ class LinSpecFamily:
     """One kernel family's registration (``ops/obligations.py``'s
     ``LIN_SPECS``): which real kernel the spec is pinned to (by the
     differential tests), which wire plane its replication model rides
-    (``"full"`` v1 datagrams / ``"delta"`` wire-v2 intervals), and
-    whether lifecycle events (refill + GC re-creation) are in its
-    schedule alphabet."""
+    (``"full"`` v1 datagrams / ``"delta"`` wire-v2 intervals), whether
+    lifecycle events (refill + GC re-creation) are in its schedule
+    alphabet, and which sequential ALGEBRA the checker replays against:
+    ``"bucket"`` rides the LinCluster/visibility-ledger suites below;
+    the cert-kit algebras (``"gcra"``, ``"conc"``, ``"quota"``) ride
+    :func:`check_sync_algebra` over the shared protocol-model clusters.
+    """
 
     name: str
     module: str
     func: str
     wire: str = "full"
     lifecycle: bool = False
+    algebra: str = "bucket"
     note: str = ""
+
+
+# Dispatchable sequential algebras (PTK001 checks registrations here).
+ALGEBRAS: Tuple[str, ...] = ("bucket", "gcra", "conc", "quota")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -706,12 +781,120 @@ def check_sync_lin(
     return explored, findings
 
 
+# Path budgets for the quota algebra's replay: global pool tighter
+# than the leaf allowance (the oversubscription shape — must match the
+# protocol model's default so stage 8 and stage 6 witness the same
+# object).
+_QUOTA_LIMITS: Tuple[int, int, int] = (2, 3, 4)
+
+
+def check_sync_algebra(
+    spec: LinSpecFamily, stop_at_first: bool = True
+) -> Tuple[int, List[Finding]]:
+    """Linearizability for the non-bucket cert-kit algebras, on the
+    SHARED protocol-model clusters: on every sync-delivered schedule,
+    each partition side's outcomes must equal a per-side sequential
+    replay — full linearizability when there is no partition (one side
+    = the whole cluster, PTN003 on divergence), visibility-priced
+    outcomes across every layout (PTN001 on divergence) — and every
+    terminal must heal to the exact join. ``LinLaws`` does not apply to
+    these algebras: their seeded law mutations live in the protocol
+    model (``GcraLaws``/``ConcLaws``/``QuotaLaws``) and are executed by
+    stage 9 against ``obligations.KERNEL_FAMILIES``."""
+    findings: List[Finding] = []
+    explored = 0
+    seen_codes: set = set()
+    n_nodes, limit, events = 2, 2, 4
+    take_moves = [("take", i) for i in range(n_nodes)]
+    if spec.algebra == "gcra":
+        alphabet = take_moves + [("advance", None)]
+    elif spec.algebra == "conc":
+        alphabet = take_moves + [("release", i) for i in range(n_nodes)]
+    else:  # quota
+        alphabet = take_moves
+    for layout in proto._partition_layouts(n_nodes):
+        side_of = {
+            i: (0 if layout is None else layout[i]) for i in range(n_nodes)
+        }
+        sides = sorted(set(side_of.values()))
+        for seq in itertools.product(alphabet, repeat=events):
+            explored += 1
+            if spec.algebra == "gcra":
+                c = proto.GcraCluster(n_nodes, limit, proto.CLEAN)
+                replays = {s: SequentialGcra(limit) for s in sides}
+            elif spec.algebra == "conc":
+                c = proto.ConcCluster(n_nodes, limit, proto.CLEAN)
+                replays = {s: SequentialConc(limit, n_nodes) for s in sides}
+            else:
+                c = proto.QuotaCluster(
+                    n_nodes, _QUOTA_LIMITS[2], proto.CLEAN,
+                    limits=_QUOTA_LIMITS,
+                )
+                replays = {s: SequentialQuota(_QUOTA_LIMITS) for s in sides}
+            c.set_partition(layout)
+            try:
+                for kind, i in seq:
+                    replay = None if i is None else replays[side_of[i]]
+                    if kind == "advance":
+                        c.apply_extra(("advance",))
+                    elif kind == "release":
+                        before = c.releases
+                        c.apply_extra(("release", i))
+                        got = c.releases > before
+                        want = replay.release(i)
+                        if got != want:
+                            raise proto._Violation(
+                                "PTN003" if layout is None else "PTN001",
+                                f"release on node {i} "
+                                f"{'took effect' if got else 'was refused'}"
+                                f" but the side's sequential replay says "
+                                f"{want}",
+                            )
+                    else:
+                        before = c.nodes[i].admitted
+                        c.take(i)
+                        got = c.nodes[i].admitted > before
+                        if spec.algebra == "gcra":
+                            want = replay.take(c.now)
+                        elif spec.algebra == "conc":
+                            want = replay.acquire(i)
+                        else:
+                            want = replay.take()
+                        if got != want:
+                            raise proto._Violation(
+                                "PTN003" if layout is None else "PTN001",
+                                f"take on node {i} "
+                                f"{'granted' if got else 'denied'} but the "
+                                f"side's sequential replay says {want}",
+                            )
+                    c.deliver_all(within_side_only=True)
+                c.heal_and_converge()
+            except proto._Violation as v:
+                if v.check not in seen_codes:
+                    seen_codes.add(v.check)
+                    findings.append(
+                        Finding(
+                            v.check,
+                            _SELF,
+                            0,
+                            f"[{spec.name}] {v.message} (events: "
+                            f"{list(seq)}, layout={layout})",
+                        )
+                    )
+                if stop_at_first:
+                    return explored, findings  # one witness is enough
+    return explored, findings
+
+
 def check_family(
     spec: LinSpecFamily,
     laws: LinLaws = CLEAN_LAWS,
     stop_at_first: bool = True,
 ) -> Tuple[int, List[Finding]]:
-    """Both suites for one registered kernel family."""
+    """Both suites for one registered kernel family (the non-bucket
+    algebras dispatch to their sequential-replay suite)."""
+    if spec.algebra != "bucket":
+        return check_sync_algebra(spec, stop_at_first)
     explored, findings = check_async_lin(spec, laws, stop_at_first)
     sync_explored, sync_findings = check_sync_lin(spec, laws, stop_at_first)
     return explored + sync_explored, findings + sync_findings

@@ -1,7 +1,7 @@
 """patrol-check AST lint: repo-specific invariants as checks over the
 Python sources.
 
-Six checks, each encoding a discipline the runtime depends on but no
+Seven checks, each encoding a discipline the runtime depends on but no
 generic tool can express:
 
 * **PTL001 wall-clock** — the limiter is driven by an *injected* clock
@@ -42,6 +42,14 @@ generic tool can express:
   only once it first fires — dashboards and bench field assertions
   silently miss it. Dynamic (non-literal) names are flagged too: they
   cannot be verified against the declaration.
+
+* **PTL007 env-knob registry** — every ``os.environ`` / ``os.getenv``
+  access of a ``PATROL_*`` name must use a string literal declared in
+  ``utils/config.py::KNOBS`` (default + one-line operator doc), so the
+  README knob table — generated from that registry — can never drift
+  from the code. Reads through a *computed* name are unverifiable and
+  flagged everywhere except inside ``utils/config.py`` itself, the one
+  declared seam (its typed accessors are the sanctioned indirection).
 
 Suppressions (documented in README.md) are inline comments:
 
@@ -843,6 +851,140 @@ def check_counter_registry(mod: Module) -> List[Finding]:
     return out
 
 
+# PTL007 — PATROL_* environment reads must use names declared in the
+# utils/config.py knob registry
+
+_knob_names_cache: Optional[Set[str]] = None
+
+# The one module allowed to read the environment through a computed
+# name: the registry's own typed accessors.
+_CONFIG_SEAM = "patrol_tpu/utils/config.py"
+
+
+def known_knob_names() -> Set[str]:
+    """``KNOBS`` from utils/config.py, loaded by file path (like
+    :func:`native_effects`) so scripts/lint_repo.py stays jax-free.
+    Empty on load failure — the check then degrades to silence."""
+    global _knob_names_cache
+    if _knob_names_cache is not None:
+        return _knob_names_cache
+    try:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "utils",
+            "config.py",
+        )
+        spec = importlib.util.spec_from_file_location("_patrol_knob_names", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _knob_names_cache = set(mod.KNOBS)
+    except Exception:  # pragma: no cover - stdlib-only module; belt&braces
+        _knob_names_cache = set()
+    return _knob_names_cache
+
+
+def _os_aliases(tree: ast.AST) -> Tuple[Set[str], Set[str], Set[str]]:
+    """Names bound to the os module / os.environ / os.getenv in this
+    module (``import os as _os``, ``from os import environ`` …)."""
+    os_names: Set[str] = set()
+    environ_names: Set[str] = set()
+    getenv_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "os":
+                    os_names.add(a.asname or "os")
+        elif isinstance(node, ast.ImportFrom) and node.module == "os":
+            for a in node.names:
+                if a.name == "environ":
+                    environ_names.add(a.asname or "environ")
+                elif a.name == "getenv":
+                    getenv_names.add(a.asname or "getenv")
+    return os_names, environ_names, getenv_names
+
+
+def check_env_registry(mod: Module) -> List[Finding]:
+    known = known_knob_names()
+    if not known or mod.relpath == _CONFIG_SEAM:
+        return []
+    os_names, environ_names, getenv_names = _os_aliases(mod.tree)
+    out: List[Finding] = []
+
+    def is_environ(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name) and expr.id in environ_names:
+            return True
+        return (
+            isinstance(expr, ast.Attribute)
+            and expr.attr == "environ"
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in os_names
+        )
+
+    def flag(node: ast.AST, name_arg: Optional[ast.AST], how: str) -> None:
+        if mod.suppressed("PTL007", node.lineno):
+            return
+        if isinstance(name_arg, ast.Constant) and isinstance(
+            name_arg.value, str
+        ):
+            name = name_arg.value
+            if name.startswith("PATROL_") and name not in known:
+                out.append(
+                    Finding(
+                        "PTL007",
+                        mod.relpath,
+                        node.lineno,
+                        f"{how} of undeclared knob {name!r}: every PATROL_* "
+                        "environment name must be registered in "
+                        "utils/config.py::KNOBS (default + doc) so the "
+                        "README knob table cannot drift from the code",
+                    )
+                )
+        else:
+            out.append(
+                Finding(
+                    "PTL007",
+                    mod.relpath,
+                    node.lineno,
+                    f"{how} with a computed environment name: it cannot be "
+                    "verified against utils/config.py::KNOBS — use a string "
+                    "literal, or go through the utils/config.py accessors "
+                    "(the one declared seam for dynamic reads)",
+                )
+            )
+
+    class V(_ScopedVisitor):
+        def visit_Call(self, node):  # noqa: N802
+            f = node.func
+            if (isinstance(f, ast.Name) and f.id in getenv_names) or (
+                isinstance(f, ast.Attribute)
+                and f.attr == "getenv"
+                and isinstance(f.value, ast.Name)
+                and f.value.id in os_names
+            ):
+                flag(node, node.args[0] if node.args else None, "os.getenv()")
+            elif (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("get", "pop", "setdefault")
+                and is_environ(f.value)
+            ):
+                flag(
+                    node,
+                    node.args[0] if node.args else None,
+                    f"os.environ.{f.attr}()",
+                )
+            self.generic_visit(node)
+
+        def visit_Subscript(self, node):  # noqa: N802
+            if is_environ(node.value):
+                flag(node, node.slice, "os.environ[...]")
+            self.generic_visit(node)
+
+    V().visit(mod.tree)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Drivers
 
@@ -851,8 +993,17 @@ PER_MODULE_CHECKS = (
     check_lock_order,
     check_dtype_discipline,
     check_counter_registry,
+    check_env_registry,
 )
-ALL_CODES = ("PTL001", "PTL002", "PTL003", "PTL004", "PTL005", "PTL006")
+ALL_CODES = (
+    "PTL001",
+    "PTL002",
+    "PTL003",
+    "PTL004",
+    "PTL005",
+    "PTL006",
+    "PTL007",
+)
 
 
 def _stale_finding(relpath: str, line: int, tok: str) -> Finding:

@@ -405,9 +405,16 @@ class Cluster:
     (delivery to a capable node acks it — the sender GCs the record;
     loss leaves it unacked for the convergence procedure's retransmit)."""
 
+    # Subclass hook (cert-kit family models): the replica class this
+    # cluster builds. Swapping it — not copying __init__ — is how a
+    # family model changes per-node state shape (QuotaNode's 3-level
+    # lanes) while riding every generic path (packet/merge/snapshot/
+    # memo/heal) unchanged.
+    node_cls = Node
+
     def __init__(self, n: int, limit: int, sem: Semantics):
         self.sem = sem
-        self.nodes = [Node(i, n, limit) for i in range(n)]
+        self.nodes = [type(self).node_cls(i, n, limit) for i in range(n)]
         self.caps = _caps(sem, n)
         # links[(src, dst)] = list of in-flight payloads, FIFO by append
         # but deliverable in any order (the reorder model).
@@ -588,6 +595,24 @@ class Cluster:
             for (i, j), q in self.links.items():
                 if self.crosses_partition(i, j):
                     q.clear()
+
+    # -- extended alphabets (subclass hooks) ---------------------------------
+    #
+    # Kernel-family models add their own schedulable transitions (the
+    # GCRA clock advance, the concurrency release) WITHOUT forking the
+    # enumerator: `extra_moves` contributes to the move list whenever
+    # `ScheduleBounds.extras` has budget left, `apply_extra` replays one
+    # such move. Tags must not collide with the core alphabet
+    # (take/refill/gc/partition/heal/flush/deliver/dup/drop) — the
+    # enumerator dispatches extras by exclusion.
+
+    def extra_moves(self) -> List[tuple]:
+        """Family-specific moves currently available (budgeted by
+        ``ScheduleBounds.extras``; empty for the base bucket model)."""
+        return []
+
+    def apply_extra(self, mv: tuple) -> None:
+        raise NotImplementedError(f"unknown extra move {mv!r}")
 
     # -- snapshot/restore/memoization (subclass hooks) -----------------------
     #
@@ -789,8 +814,11 @@ class ScheduleBounds:
     ``disruptions`` bounds duplicate-deliver/drop events; ``refills``,
     ``gcs`` and ``partitions`` enable the bucket-lifecycle and
     partition/heal move families when non-zero (all OPTIONAL budgets —
-    schedules that use fewer are still terminal). ``depth`` caps the DFS
-    (None = derived from the budgets, matching the historical cap)."""
+    schedules that use fewer are still terminal). ``extras`` budgets the
+    cluster's OWN move family (:meth:`Cluster.extra_moves` — e.g. the
+    GCRA model's clock ``advance``); zero keeps the core alphabet.
+    ``depth`` caps the DFS (None = derived from the budgets, matching
+    the historical cap)."""
 
     n_nodes: int = 2
     limit: int = 2
@@ -799,6 +827,7 @@ class ScheduleBounds:
     refills: int = 0
     gcs: int = 0
     partitions: int = 0
+    extras: int = 0
     depth: Optional[int] = None
 
 
@@ -845,12 +874,20 @@ def enumerate_schedules(
         + extra
         + 2 * (b.refills + b.gcs)
         + 3 * b.partitions
+        + 2 * b.extras
     )
     layouts = [lay for lay in _partition_layouts(b.n_nodes) if lay is not None]
     seen: set = set()
 
     def walk(c: Cluster, budget: tuple, depth: int, trail: tuple):
-        takes_left, disrupt_left, refill_left, gc_left, part_left = budget
+        (
+            takes_left,
+            disrupt_left,
+            refill_left,
+            gc_left,
+            part_left,
+            extra_left,
+        ) = budget
         k = c.memo_key(budget)
         if k in seen:
             return  # schedule prefix reaches an already-checked state
@@ -861,7 +898,7 @@ def enumerate_schedules(
             for idx in range(len(q))
         ]
         if takes_left == 0 and not inflight:
-            if refill_left == 0 and gc_left == 0:
+            if refill_left == 0 and gc_left == 0 and extra_left == 0:
                 yield Terminal(c, events=trail)
                 return
             # Trailing refill/gc events after the last take still change
@@ -881,6 +918,8 @@ def enumerate_schedules(
             moves += [("gc", i) for i in range(len(c.nodes))]
         if part_left and c.partition is None:
             moves += [("partition", lay) for lay in layouts]
+        if extra_left:
+            moves += c.extra_moves()
         if c.partition is not None:
             moves.append(("heal",))
         # Delta plane: the paced flusher is its own schedulable event.
@@ -913,7 +952,7 @@ def enumerate_schedules(
                     nxt = budget[:3] + (gc_left - 1,) + budget[4:]
                 elif mv[0] == "partition":
                     c2.set_partition(dict(mv[1]))
-                    nxt = budget[:4] + (part_left - 1,)
+                    nxt = budget[:4] + (part_left - 1,) + budget[5:]
                 elif mv[0] == "heal":
                     c2.set_partition(None)
                 elif mv[0] == "flush":
@@ -923,16 +962,24 @@ def enumerate_schedules(
                 elif mv[0] == "dup":
                     c2.deliver(mv[1], mv[2], mv[3], dup=True)
                     nxt = (takes_left, disrupt_left - 1) + budget[2:]
-                else:  # drop
+                elif mv[0] == "drop":
                     c2.drop(mv[1], mv[2], mv[3])
                     nxt = (takes_left, disrupt_left - 1) + budget[2:]
+                else:
+                    # Family-specific move (Cluster.extra_moves) — the
+                    # subclass replays it; the budget keeps the DFS finite.
+                    c2.apply_extra(mv)
+                    nxt = budget[:5] + (extra_left - 1,)
             except _Violation as v:
                 yield Terminal(c2, violation=v, events=trail + (mv,))
                 return  # one witness per state is enough
             yield from walk(c2, nxt, depth - 1, trail + (mv,))
 
     yield from walk(
-        root, (b.takes, b.disruptions, b.refills, b.gcs, b.partitions), depth0, ()
+        root,
+        (b.takes, b.disruptions, b.refills, b.gcs, b.partitions, b.extras),
+        depth0,
+        (),
     )
 
 
@@ -1338,6 +1385,372 @@ def check_protocol(sem: Semantics = CLEAN) -> List[Finding]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Cert-kit kernel-family models (stage 9 targets, stage 6 clean runs).
+#
+# The GCRA, concurrency and hierarchical-quota kernels (ops/gcra.py,
+# ops/concurrency.py, ops/hierquota.py) ride the SAME PN lanes and the
+# SAME join as the bucket, so their protocol models subclass Cluster
+# and reuse every generic path — packet/merge/snapshot/memo/heal —
+# changing only the admission rule (``take``) and, where the family
+# needs one, an extra schedulable move (``extra_moves``). Each family
+# carries a small laws dataclass whose non-clean values are the
+# family's SEEDED MUTATIONS, registered in ops/obligations.py and
+# executed by the stage-9 cert checker (PTK002); the clean laws run in
+# stage 6's check_repo like every other clean preset.
+
+
+@dataclasses.dataclass(frozen=True)
+class GcraLaws:
+    """``view="own"`` is the seeded mutation: conformance tested against
+    the node's OWN TAT lane only, ignoring merged remote watermarks —
+    every replica re-admits the full burst even when fully synced."""
+
+    view: str = "global"  # "global" | "own"
+
+
+@dataclasses.dataclass(frozen=True)
+class ConcLaws:
+    """``release="uncapped"`` is the seeded mutation: releases skip the
+    own-lane clamp, so a release-without-acquire drives ADDED past TAKEN
+    and the cluster invents capacity that was never held."""
+
+    release: str = "clamped"  # "clamped" | "uncapped"
+
+
+@dataclasses.dataclass(frozen=True)
+class QuotaLaws:
+    """``debit="leaf-only"`` is the seeded mutation: admission and debit
+    against the leaf (user) level only — tenants collectively overspend
+    the global pool the moment path limits differ, and the monotone
+    lanes can never unwind it."""
+
+    debit: str = "path"  # "path" | "leaf-only"
+
+
+class GcraCluster(Cluster):
+    """GCRA/sliding-window protocol model (ops/gcra.py). Own TAKEN lane
+    = this node's theoretical-arrival-time watermark (a max register;
+    assignment only grows it, ADDED stays zero), effective TAT = max
+    over visible lanes, emission interval 1, tolerance ``limit - 1`` —
+    so the burst equals ``limit`` and the conservation bound reads like
+    the bucket's. The ``advance`` extra move ticks the shared clock one
+    emission interval (one more conforming request per side)."""
+
+    def __init__(
+        self, n: int, limit: int, sem: Semantics, laws: GcraLaws = GcraLaws()
+    ):
+        super().__init__(n, limit, sem)
+        self.laws = laws
+        self.now = 0
+        self.advances = 0
+
+    def take(self, i: int) -> None:
+        node = self.nodes[i]
+        tol = node.limit - 1
+        tat = node.taken[i] if self.laws.view == "own" else max(node.taken)
+        if tat <= self.now + tol:
+            new = max(tat, self.now) + 1
+            if new > node.taken[i]:
+                node.taken[i] = new
+            node.admitted += 1
+            self._emit(i)
+
+    def extra_moves(self) -> List[tuple]:
+        return [("advance",)]
+
+    def apply_extra(self, mv: tuple) -> None:
+        if mv[0] != "advance":
+            raise NotImplementedError(f"unknown extra move {mv!r}")
+        self.now += 1
+        self.advances += 1
+
+    def _clone_empty(self) -> "GcraCluster":
+        return GcraCluster(
+            len(self.nodes), self.nodes[0].limit, self.sem, self.laws
+        )
+
+    def _snapshot_extra(self):
+        return (self.now, self.advances)
+
+    def _restore_extra(self, extra) -> None:
+        self.now, self.advances = extra
+
+    def _memo_extra(self):
+        return (self.now, self.advances)
+
+
+class ConcCluster(Cluster):
+    """Concurrency-limit protocol model (ops/concurrency.py). Own TAKEN
+    lane counts this node's acquires, own ADDED lane its releases (both
+    monotone G-counters); in-flight = Σtaken − Σadded. ``take`` is an
+    acquire; the ``release`` extra move returns one held unit, clamped
+    to the node's OWN lane pair under the clean law."""
+
+    def __init__(
+        self, n: int, limit: int, sem: Semantics, laws: ConcLaws = ConcLaws()
+    ):
+        super().__init__(n, limit, sem)
+        self.laws = laws
+        self.releases = 0
+
+    def take(self, i: int) -> None:  # acquire
+        node = self.nodes[i]
+        inflight = sum(node.taken) - sum(node.added)
+        if inflight < node.limit:
+            node.taken[i] += 1
+            node.admitted += 1
+            self._emit(i)
+
+    def extra_moves(self) -> List[tuple]:
+        return [("release", i) for i in range(len(self.nodes))]
+
+    def apply_extra(self, mv: tuple) -> None:
+        if mv[0] != "release":
+            raise NotImplementedError(f"unknown extra move {mv!r}")
+        i = mv[1]
+        node = self.nodes[i]
+        if self.laws.release != "uncapped" and (
+            node.taken[i] - node.added[i] < 1
+        ):
+            return  # own-lane clamp: nothing of ours is held
+        node.added[i] += 1
+        self.releases += 1
+        self._emit(i)
+
+    def _clone_empty(self) -> "ConcCluster":
+        return ConcCluster(
+            len(self.nodes), self.nodes[0].limit, self.sem, self.laws
+        )
+
+    def _snapshot_extra(self):
+        return self.releases
+
+    def _restore_extra(self, extra) -> None:
+        self.releases = extra
+
+    def _memo_extra(self):
+        return self.releases
+
+
+class QuotaNode(Node):
+    """Hierarchical-quota replica (ops/hierquota.py): 3 path levels ×
+    ``n`` writer lanes on ONE node — lane ``level * n + slot``. Only
+    TAKEN lanes are used (budgets are configuration, not lattice
+    state). Resizing ``self.n`` to 3n is all it takes for the generic
+    packet/merge/snapshot/memo machinery to span the whole path."""
+
+    __slots__ = ("peers",)
+
+    def __init__(self, slot: int, n: int, limit: int):
+        super().__init__(slot, n, limit)
+        self.peers = n
+        self.n = 3 * n
+        self.added = [0] * self.n
+        self.taken = [0] * self.n
+
+
+class QuotaCluster(Cluster):
+    """Hierarchical-quota protocol model: one path (global → tenant →
+    user) shared by all nodes, per-level budgets ``limits``; spend at a
+    level is the sum of its TAKEN lanes. The default budgets put the
+    global pool BELOW the leaf allowance — the oversubscription shape
+    that makes partial (leaf-only) debits dangerous."""
+
+    node_cls = QuotaNode
+
+    def __init__(
+        self,
+        n: int,
+        limit: int,
+        sem: Semantics,
+        laws: QuotaLaws = QuotaLaws(),
+        limits: Tuple[int, int, int] = (2, 3, 4),
+    ):
+        super().__init__(n, limit, sem)
+        self.laws = laws
+        self.limits = limits
+
+    def _spend(self, node: QuotaNode, level: int) -> int:
+        n = node.peers
+        return sum(node.taken[level * n : (level + 1) * n])
+
+    def take(self, i: int) -> None:
+        node = self.nodes[i]
+        heads = [
+            self.limits[lvl] - self._spend(node, lvl) for lvl in range(3)
+        ]
+        leaf_only = self.laws.debit == "leaf-only"
+        if (heads[2] if leaf_only else min(heads)) < 1:
+            return
+        n = node.peers
+        for lvl in (2,) if leaf_only else (0, 1, 2):
+            node.taken[lvl * n + i] += 1
+        node.admitted += 1
+        self._emit(i)
+
+    def _clone_empty(self) -> "QuotaCluster":
+        return QuotaCluster(
+            len(self.nodes),
+            self.nodes[0].limit,
+            self.sem,
+            self.laws,
+            self.limits,
+        )
+
+
+def check_gcra_protocol(
+    laws: GcraLaws = GcraLaws(),
+    n_nodes: int = 2,
+    limit: int = 2,
+    events: int = 4,
+) -> List[Finding]:
+    """GCRA conservation (PTC006 family) + PTC001/002 at heal: under
+    sync-within-side delivery, total conforming grants never exceed
+    ``(burst + clock-advances) × sides`` — the family's AP bound — and
+    every terminal heals to the exact join (TAT lanes are max
+    registers, so the standard join IS the merge)."""
+    findings: List[Finding] = []
+    alphabet = [("take", i) for i in range(n_nodes)] + [("advance", None)]
+    for layout in _partition_layouts(n_nodes):
+        sides = 1 if layout is None else len(set(layout.values()))
+        for seq in itertools.product(alphabet, repeat=events):
+            c = GcraCluster(n_nodes, limit, CLEAN, laws=laws)
+            c.set_partition(layout)
+            try:
+                for kind, i in seq:
+                    if kind == "advance":
+                        c.apply_extra(("advance",))
+                    else:
+                        c.take(i)
+                    c.deliver_all(within_side_only=True)
+                    admitted = sum(n.admitted for n in c.nodes)
+                    budget = (limit + c.advances) * sides
+                    if admitted > budget:
+                        raise _Violation(
+                            "PTC006",
+                            f"GCRA over-admitted: {admitted} conforming "
+                            f"grants > (burst {limit} + {c.advances} "
+                            f"advances) × {sides} side(s) "
+                            f"(layout={layout}, schedule={list(seq)})",
+                        )
+                c.heal_and_converge()
+            except _Violation as v:
+                findings.append(Finding(v.check, _SELF, 0, v.message))
+                break  # one witness per layout is enough
+    return findings
+
+
+def check_conc_protocol(
+    laws: ConcLaws = ConcLaws(),
+    n_nodes: int = 2,
+    limit: int = 2,
+    events: int = 4,
+) -> List[Finding]:
+    """Concurrency-limit conservation (PTC006 family) + PTC001/002 at
+    heal: held units (acquires − releases) never exceed ``limit ×
+    sides`` under sync-within-side delivery, and no converged lane pair
+    has ADDED > TAKEN — a phantom release would invent capacity the
+    monotone lanes can never reclaim."""
+    findings: List[Finding] = []
+    alphabet = [("take", i) for i in range(n_nodes)] + [
+        ("release", i) for i in range(n_nodes)
+    ]
+    for layout in _partition_layouts(n_nodes):
+        sides = 1 if layout is None else len(set(layout.values()))
+        for seq in itertools.product(alphabet, repeat=events):
+            c = ConcCluster(n_nodes, limit, CLEAN, laws=laws)
+            c.set_partition(layout)
+            try:
+                for kind, i in seq:
+                    if kind == "release":
+                        c.apply_extra(("release", i))
+                    else:
+                        c.take(i)
+                    c.deliver_all(within_side_only=True)
+                    held = sum(n.admitted for n in c.nodes) - c.releases
+                    if held > limit * sides:
+                        raise _Violation(
+                            "PTC006",
+                            f"concurrency over-held: {held} in-flight "
+                            f"units > limit {limit} × {sides} side(s) "
+                            f"(layout={layout}, schedule={list(seq)})",
+                        )
+                c.heal_and_converge()
+                converged = c.nodes[0]
+                for s in range(n_nodes):
+                    if converged.added[s] > converged.taken[s]:
+                        raise _Violation(
+                            "PTC006",
+                            f"phantom release: lane {s} released "
+                            f"{converged.added[s]} > acquired "
+                            f"{converged.taken[s]} after convergence — "
+                            f"capacity invented (layout={layout}, "
+                            f"schedule={list(seq)})",
+                        )
+            except _Violation as v:
+                findings.append(Finding(v.check, _SELF, 0, v.message))
+                break  # one witness per layout is enough
+    return findings
+
+
+def check_quota_protocol(
+    laws: QuotaLaws = QuotaLaws(),
+    n_nodes: int = 2,
+    events: int = 5,
+    limits: Tuple[int, int, int] = (2, 3, 4),
+) -> List[Finding]:
+    """Hierarchical-quota per-level conservation (PTC006 family) +
+    PTC001/002 at heal: under sync-within-side delivery, admitted takes
+    never exceed ``level-limit × sides`` for ANY path level — a partial
+    (leaf-only) debit lets the leaf allowance overspend the tighter
+    global pool."""
+    findings: List[Finding] = []
+    level_names = ("global", "tenant", "user")
+    for layout in _partition_layouts(n_nodes):
+        sides = 1 if layout is None else len(set(layout.values()))
+        for seq in itertools.product(range(n_nodes), repeat=events):
+            c = QuotaCluster(
+                n_nodes, limits[2], CLEAN, laws=laws, limits=limits
+            )
+            c.set_partition(layout)
+            try:
+                for i in seq:
+                    c.take(i)
+                    c.deliver_all(within_side_only=True)
+                    admitted = sum(n.admitted for n in c.nodes)
+                    for lvl, name in enumerate(level_names):
+                        if admitted > limits[lvl] * sides:
+                            raise _Violation(
+                                "PTC006",
+                                f"quota {name} level overspent: "
+                                f"{admitted} admitted > limit "
+                                f"{limits[lvl]} × {sides} side(s) — a "
+                                f"partial path debit (layout={layout}, "
+                                f"schedule={list(seq)})",
+                            )
+                c.heal_and_converge()
+            except _Violation as v:
+                findings.append(Finding(v.check, _SELF, 0, v.message))
+                break  # one witness per layout is enough
+    return findings
+
+
+# Stage-9 (patrol-cert) reachability registry: every KernelFamily's
+# ``protocol`` key must resolve here (PTK001), and law-mutation
+# CertMutations are executed through these entries (PTK002). The
+# ``laws=None`` wrappers adapt the preset suites to the same signature.
+FAMILY_CHECKS: Dict[str, object] = {
+    "bucket-full": lambda laws=None: check_protocol(CLEAN),
+    "bucket-delta": lambda laws=None: check_protocol(CLEAN_DELTA),
+    "lifecycle-gc": lambda laws=None: check_protocol(CLEAN_GC),
+    "membership": lambda laws=None: check_protocol(CLEAN_MEMBER),
+    "gcra": check_gcra_protocol,
+    "concurrency": check_conc_protocol,
+    "hierquota": check_quota_protocol,
+}
+
+
 def check_repo() -> List[Finding]:
     """The stage-6 gate: the clean protocol — on the v1 full-state plane,
     the wire-v2 delta plane, a mixed v1/v2 cluster, AND both planes with
@@ -1351,6 +1764,12 @@ def check_repo() -> List[Finding]:
     findings += check_protocol(CLEAN_GC_DELTA)
     findings += check_protocol(CLEAN_MEMBER)
     findings += check_protocol(CLEAN_MEMBER_DELTA)
+    # Cert-kit kernel families under their clean laws (the seeded law
+    # mutations are executed by stage 9 against ops/obligations.py's
+    # KERNEL_FAMILIES registry — one registry, two consumers).
+    findings += check_gcra_protocol()
+    findings += check_conc_protocol()
+    findings += check_quota_protocol()
     for name, sem in MUTATIONS.items():
         caught = check_protocol(sem)
         if not caught:

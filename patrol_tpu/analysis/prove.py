@@ -1571,6 +1571,416 @@ def _model_raw_ingest(
     return findings
 
 
+# ---------------------------------------------------------------------------
+# Cert-kit kernel families (ops/gcra.py, ops/concurrency.py,
+# ops/hierquota.py). Each model replays the kernel's *sequential
+# contract* literally in python — request-by-request, no closed forms —
+# and bit-compares the whole (state, admitted) outcome. The replay
+# subsumes own-lane locality and elapsed-freeze (the expected state is
+# built from the reference and compared whole), so PTP002 here is the
+# strong obligation the cert stage's seeded mutations must trip.
+
+
+def _model_gcra_laws(
+    root: ProveRoot, fn: Callable, site: Tuple[str, int]
+) -> List[Finding]:
+    """GCRA laws: PTP002 bit-agreement with a literal request-by-request
+    replay of the algorithm (conformance against the advancing virtual
+    TAT), PTP004 monotonicity — the TAT lane is a max register and may
+    never move down the lattice."""
+    import jax
+    import jax.numpy as jnp
+
+    from patrol_tpu.models.limiter import TAKEN, LimiterState
+    from patrol_tpu.ops.gcra import GcraRequest
+
+    findings: List[Finding] = []
+    node_slot = 0
+    dom = JoinDomain(B=2, N=2, vals=(0, 2, 5))
+    pn0, el0 = dom.states(dom.vals)
+
+    reqs = np.array(
+        [
+            (row, now, t, tol, nreq)
+            for row in (0, 1)
+            for now in (0, 2, 5)
+            for t in (0, 1, 2)
+            for tol in (0, 1, 3)
+            for nreq in (0, 1, 3)
+        ],
+        np.int64,
+    )
+
+    def one(pn, el, r):
+        req = GcraRequest(
+            rows=r[0].astype(jnp.int32)[None],
+            now_ns=r[1][None],
+            emission_ns=r[2][None],
+            tol_ns=r[3][None],
+            nreq=r[4][None],
+        )
+        out, res = fn(LimiterState(pn=pn, elapsed=el), req, node_slot)
+        return out.pn, out.elapsed, res.admitted[0]
+
+    app = jax.jit(jax.vmap(one))
+    S_pn, S_el, R = _grid((pn0, el0), (reqs,))
+    out_pn, out_el, admitted = _chunked(app, [S_pn, S_el, R])
+
+    if "PTP002" in root.obligations:
+        n = len(S_pn)
+        exp_pn = S_pn.copy()
+        exp_adm = np.zeros(n, np.int64)
+        for i in range(n):
+            row, now, t, tol, nreq = (int(v) for v in R[i])
+            tat = int(S_pn[i, row, :, TAKEN].max())
+            k = 0
+            while k < nreq and t > 0 and tat <= now + tol:
+                tat = max(tat, now) + t
+                k += 1
+            exp_adm[i] = k
+            if k:
+                lane = exp_pn[i, row, node_slot, TAKEN]
+                exp_pn[i, row, node_slot, TAKEN] = max(int(lane), tat)
+        i = _first_bad(
+            (admitted == exp_adm)
+            & _states_eq((out_pn, out_el), (exp_pn, S_el))
+        )
+        if i is not None:
+            findings.append(
+                Finding(
+                    "PTP002",
+                    *site,
+                    f"[{root.name}] GCRA diverged from the sequential "
+                    f"replay at request {R[i].tolist()}: admitted="
+                    f"{int(admitted[i])} expected {int(exp_adm[i])} (or a "
+                    "lane other than the own TAT register moved)",
+                )
+            )
+
+    if "PTP004" in root.obligations:
+        i = _first_bad(_states_ge((out_pn, out_el), (S_pn, S_el)))
+        if i is not None:
+            findings.append(
+                Finding(
+                    "PTP004",
+                    *site,
+                    f"[{root.name}] GCRA shrank a state plane at request "
+                    f"{R[i].tolist()}: the TAT lane is a max register and "
+                    "must stay monotone or joins resurrect spent windows",
+                )
+            )
+    return findings
+
+
+def _model_conc_laws(
+    root: ProveRoot, fn: Callable, site: Tuple[str, int]
+) -> List[Finding]:
+    """Concurrency-limit laws: PTP002 bit-agreement with a literal
+    release-then-acquire replay (release clamped to the OWN lane pair —
+    the phantom-release guard), PTP004 monotonicity of the paired
+    G-counter lanes."""
+    import jax
+    import jax.numpy as jnp
+
+    from patrol_tpu.models.limiter import ADDED, TAKEN, LimiterState
+    from patrol_tpu.ops.concurrency import ConcRequest
+
+    findings: List[Finding] = []
+    node_slot = 0
+    dom = JoinDomain(B=2, N=2, vals=(0, 1, 3))
+    pn0, el0 = dom.states(dom.vals)
+
+    reqs = np.array(
+        [
+            (row, limit, count, nreq, rel)
+            for row in (0, 1)
+            for limit in (0, 2, 5)
+            for count in (0, 1, 2)
+            for nreq in (0, 1, 3)
+            for rel in (0, 1, 4)
+        ],
+        np.int64,
+    )
+
+    def one(pn, el, r):
+        req = ConcRequest(
+            rows=r[0].astype(jnp.int32)[None],
+            limit_nt=r[1][None],
+            count_nt=r[2][None],
+            nreq=r[3][None],
+            releases=r[4][None],
+        )
+        out, res = fn(LimiterState(pn=pn, elapsed=el), req, node_slot)
+        return out.pn, out.elapsed, res.admitted[0], res.released_nt[0]
+
+    app = jax.jit(jax.vmap(one))
+    S_pn, S_el, R = _grid((pn0, el0), (reqs,))
+    out_pn, out_el, admitted, released = _chunked(app, [S_pn, S_el, R])
+
+    if "PTP002" in root.obligations:
+        n = len(S_pn)
+        exp_pn = S_pn.copy()
+        exp_adm = np.zeros(n, np.int64)
+        exp_rel = np.zeros(n, np.int64)
+        for i in range(n):
+            row, limit, count, nreq, rel = (int(v) for v in R[i])
+            own_a = int(S_pn[i, row, node_slot, ADDED])
+            own_t = int(S_pn[i, row, node_slot, TAKEN])
+            want = max(rel, 0) * max(count, 0)
+            d_rel = min(want, max(own_t - own_a, 0))
+            inflight = int(S_pn[i, row, :, TAKEN].sum()) - (
+                int(S_pn[i, row, :, ADDED].sum()) + d_rel
+            )
+            k = 0
+            while k < nreq and count > 0 and inflight + count <= limit:
+                inflight += count
+                k += 1
+            exp_adm[i] = k
+            exp_rel[i] = d_rel
+            exp_pn[i, row, node_slot, ADDED] += d_rel
+            exp_pn[i, row, node_slot, TAKEN] += k * count
+        i = _first_bad(
+            (admitted == exp_adm)
+            & (released == exp_rel)
+            & _states_eq((out_pn, out_el), (exp_pn, S_el))
+        )
+        if i is not None:
+            findings.append(
+                Finding(
+                    "PTP002",
+                    *site,
+                    f"[{root.name}] concurrency kernel diverged from the "
+                    f"sequential replay at request {R[i].tolist()}: "
+                    f"admitted={int(admitted[i])}/released="
+                    f"{int(released[i])} expected {int(exp_adm[i])}/"
+                    f"{int(exp_rel[i])} — an uncapped release is a phantom "
+                    "release: converged replicas would over-admit forever",
+                )
+            )
+
+    if "PTP004" in root.obligations:
+        i = _first_bad(_states_ge((out_pn, out_el), (S_pn, S_el)))
+        if i is not None:
+            findings.append(
+                Finding(
+                    "PTP004",
+                    *site,
+                    f"[{root.name}] concurrency kernel shrank a state "
+                    f"plane at request {R[i].tolist()}: acquire/release "
+                    "lanes are monotone G-counters",
+                )
+            )
+    return findings
+
+
+def _model_quota_laws(
+    root: ProveRoot, fn: Callable, site: Tuple[str, int]
+) -> List[Finding]:
+    """Hierarchical-quota laws: PTP002 bit-agreement with a literal
+    per-request replay admitting against EVERY level's headroom and
+    debiting the whole path (including shared global/tenant rows, where
+    the packed scatter accumulates), PTP004 monotonicity. The leaf-only
+    admission/debit mutations — the family's CRDT hazard — trip the
+    PTP002 comparison."""
+    import jax
+    import jax.numpy as jnp
+
+    from patrol_tpu.models.limiter import TAKEN, LimiterState
+    from patrol_tpu.ops.hierquota import QuotaRequest
+
+    findings: List[Finding] = []
+    node_slot = 0
+    dom = JoinDomain(B=3, N=2, vals=(0, 1, 3))
+    pn0, el0 = dom.states(dom.vals)
+
+    reqs = np.array(
+        [
+            (rg, rt, 2, lg, lt, lu, count, nreq)
+            for rg, rt in ((0, 1), (0, 0))  # distinct path + shared row
+            for lg in (0, 2, 6)
+            for lt in (0, 2, 6)
+            for lu in (0, 2, 6)
+            for count in (1, 2)
+            for nreq in (0, 1, 3)
+        ],
+        np.int64,
+    )
+
+    def one(pn, el, r):
+        req = QuotaRequest(
+            rows_global=r[0].astype(jnp.int32)[None],
+            rows_tenant=r[1].astype(jnp.int32)[None],
+            rows_user=r[2].astype(jnp.int32)[None],
+            limit_global_nt=r[3][None],
+            limit_tenant_nt=r[4][None],
+            limit_user_nt=r[5][None],
+            count_nt=r[6][None],
+            nreq=r[7][None],
+        )
+        out, res = fn(LimiterState(pn=pn, elapsed=el), req, node_slot)
+        return out.pn, out.elapsed, res.admitted[0]
+
+    app = jax.jit(jax.vmap(one))
+    S_pn, S_el, R = _grid((pn0, el0), (reqs,))
+    out_pn, out_el, admitted = _chunked(app, [S_pn, S_el, R])
+
+    if "PTP002" in root.obligations:
+        n = len(S_pn)
+        exp_pn = S_pn.copy()
+        exp_adm = np.zeros(n, np.int64)
+        for i in range(n):
+            rg, rt, ru, lg, lt, lu, count, nreq = (int(v) for v in R[i])
+            spend = [int(S_pn[i, r, :, TAKEN].sum()) for r in (rg, rt, ru)]
+            heads = [lg - spend[0], lt - spend[1], lu - spend[2]]
+            k = 0
+            while k < nreq and count > 0 and min(heads) >= count:
+                heads = [h - count for h in heads]
+                k += 1
+            exp_adm[i] = k
+            d = k * count
+            for r in (rg, rt, ru):  # shared rows accumulate, like scatter
+                exp_pn[i, r, node_slot, TAKEN] += d
+        i = _first_bad(
+            (admitted == exp_adm)
+            & _states_eq((out_pn, out_el), (exp_pn, S_el))
+        )
+        if i is not None:
+            findings.append(
+                Finding(
+                    "PTP002",
+                    *site,
+                    f"[{root.name}] quota kernel diverged from the "
+                    f"per-level replay at request {R[i].tolist()}: "
+                    f"admitted={int(admitted[i])} expected "
+                    f"{int(exp_adm[i])} — a partial (leaf-only) check or "
+                    "debit lets tenants overspend irreversibly",
+                )
+            )
+
+    if "PTP004" in root.obligations:
+        i = _first_bad(_states_ge((out_pn, out_el), (S_pn, S_el)))
+        if i is not None:
+            findings.append(
+                Finding(
+                    "PTP004",
+                    *site,
+                    f"[{root.name}] quota kernel shrank a state plane at "
+                    f"request {R[i].tolist()}: quota debits are monotone "
+                    "G-counter spends",
+                )
+            )
+    return findings
+
+
+def _model_cert_trailer_roundtrip(
+    root: ProveRoot, fn: Callable, site: Tuple[str, int]
+) -> List[Finding]:
+    """PTP003 for the cert-kernel wire trailers (GCRA / concurrency /
+    quota, dispatched on ``root.attr``): decode∘encode identity over a
+    value grid, byte-stable re-encode, every single-bit corruption
+    rejected (the mod-256 checksum covers all bytes), and the family
+    invariant the decoder enforces (conc: released <= acquired)."""
+    from patrol_tpu.ops import wire
+
+    findings: List[Finding] = []
+    big = wire._INT64_MAX
+    kind = root.attr
+
+    if "gcra" in kind:
+        decode = wire.decode_gcra_trailer
+        vals = [
+            wire.GcraTrailer(own_slot=s, tat_ns=v)
+            for s in (0, 7, 65535)
+            for v in (0, 1, big)
+        ]
+    elif "conc" in kind:
+        decode = wire.decode_conc_trailer
+        vals = [
+            wire.ConcTrailer(own_slot=s, acquired_nt=a, released_nt=r)
+            for s in (0, 65535)
+            for a in (0, 5, big)
+            for r in (0, 5, big)
+            if r <= a
+        ]
+    else:
+        decode = wire.decode_quota_trailer
+        vals = [
+            wire.QuotaTrailer(
+                own_slot=s,
+                taken_global_nt=g,
+                taken_tenant_nt=t,
+                taken_user_nt=u,
+            )
+            for s in (0, 65535)
+            for g in (0, 3, big)
+            for t in (0, 3, big)
+            for u in (0, 3, big)
+        ]
+
+    for t in vals:
+        pkt = fn(t)
+        back = decode(pkt)
+        if back != t:
+            findings.append(
+                Finding(
+                    "PTP003",
+                    *site,
+                    f"[{root.name}] decode(encode(x)) != x for {t!r}: "
+                    "peers relaying the trailer would fork on the lattice "
+                    "coordinate it carries",
+                )
+            )
+            break
+        if fn(back) != pkt:
+            findings.append(
+                Finding(
+                    "PTP003",
+                    *site,
+                    f"[{root.name}] re-encode of a decoded trailer is not "
+                    f"byte-stable for {t!r}",
+                )
+            )
+            break
+
+    pkt = fn(vals[-1])
+    for i in range(len(pkt)):
+        for bit in (0x01, 0x80):
+            mutated = bytearray(pkt)
+            mutated[i] ^= bit
+            if decode(bytes(mutated)) is not None:
+                findings.append(
+                    Finding(
+                        "PTP003",
+                        *site,
+                        f"[{root.name}] single-bit corruption at byte {i} "
+                        "decoded as valid: the trailer checksum must "
+                        "reject damaged lattice coordinates",
+                    )
+                )
+                return findings
+    if decode(pkt[:-1]) is not None or decode(pkt + b"\x00") is not None:
+        findings.append(
+            Finding(
+                "PTP003",
+                *site,
+                f"[{root.name}] wrong-length trailer decoded as valid",
+            )
+        )
+
+    if "conc" in kind:
+        phantom = wire.ConcTrailer(own_slot=0, acquired_nt=1, released_nt=2)
+        if wire.decode_conc_trailer(wire.encode_conc_trailer(phantom)) is not None:
+            findings.append(
+                Finding(
+                    "PTP003",
+                    *site,
+                    f"[{root.name}] decoder accepted released > acquired: "
+                    "a phantom-release trailer must never merge",
+                )
+            )
+    return findings
+
+
 _MODELS: Dict[str, Callable] = {
     "dense_join": _model_dense_join,
     "tree_converge": _model_tree_converge,
@@ -1582,6 +1992,10 @@ _MODELS: Dict[str, Callable] = {
     "delta_roundtrip": _model_delta_roundtrip,
     "pallas_interpret": _model_pallas_interpret,
     "raw_ingest": _model_raw_ingest,
+    "gcra_laws": _model_gcra_laws,
+    "conc_laws": _model_conc_laws,
+    "quota_laws": _model_quota_laws,
+    "cert_trailer_roundtrip": _model_cert_trailer_roundtrip,
 }
 # "join_batch:<adapter>" tags dispatch through the adapter registry the
 # obligations module fills in (the batch constructors live with the

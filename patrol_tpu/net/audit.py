@@ -51,7 +51,6 @@ go through the owning replicator's thread-safe ``unicast``.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
@@ -63,15 +62,9 @@ from patrol_tpu.utils import histogram as hist
 from patrol_tpu.utils import profiling
 from patrol_tpu.utils import slo as slo_mod
 from patrol_tpu.utils import trace as trace_mod
+from patrol_tpu.utils import config
 
 Addr = Tuple[str, int]
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
 
 
 class _Win:
@@ -115,7 +108,7 @@ class AuditPlane:
         self.rep = rep
         self.node_slot = rep.slots.self_slot
         self.interval_s = (
-            _env_float("PATROL_AUDIT_MS", 1000.0) / 1000.0
+            config.env_float("PATROL_AUDIT_MS") / 1000.0
             if interval_s is None
             else interval_s
         )

@@ -60,6 +60,7 @@ from patrol_tpu.ops import wire
 from patrol_tpu.utils import histogram as hist
 from patrol_tpu.utils import profiling
 from patrol_tpu.utils import trace as trace_mod
+from patrol_tpu.utils import config
 from patrol_tpu.net.replication import CTRL_PREFIX
 
 Addr = Tuple[str, int]
@@ -84,13 +85,6 @@ MIN_DELTA_MTU = wire.PACKET_SIZE
 # with the engine's directory pass); entries never touch python. 0
 # restores the python decode path everywhere.
 RAW_INGEST = os.environ.get("PATROL_RAW_INGEST", "1") != "0"
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
 
 
 def _encode_ctrl(name_payload: bytes) -> bytes:
@@ -146,12 +140,12 @@ class DeltaPlane:
         self.tx_mtu = min(tx_mtu, wire.DELTA_PACKET_SIZE)
         self.rx_mtu = min(rx_mtu, wire.DELTA_PACKET_SIZE)
         self.flush_interval_s = (
-            _env_float("PATROL_DELTA_FLUSH_MS", 20.0) / 1000.0
+            config.env_float("PATROL_DELTA_FLUSH_MS") / 1000.0
             if flush_interval_s is None
             else flush_interval_s
         )
         self.retransmit_ticks = (
-            max(1, int(_env_float("PATROL_DELTA_RETX_TICKS", 8)))
+            max(1, int(config.env_float("PATROL_DELTA_RETX_TICKS")))
             if retransmit_ticks is None
             else retransmit_ticks
         )

@@ -41,13 +41,13 @@ across a send (no new lock-graph edges for patrol-race).
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
 from patrol_tpu.ops import wire
 from patrol_tpu.utils import histogram as hist
 from patrol_tpu.utils import profiling
+from patrol_tpu.utils import config
 from patrol_tpu.utils import slo as slo_mod
 
 Addr = Tuple[str, int]
@@ -223,13 +223,6 @@ class FleetStore:
         }
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
 class FleetPlane:
     """One per replicator (either backend): the paced metrics-gossip
     flusher plus the rx join path. Construction is cheap; the flusher
@@ -252,7 +245,7 @@ class FleetPlane:
         self.node_name = ""
         self.tx_mtu = min(tx_mtu, wire.DELTA_PACKET_SIZE)
         self.gossip_interval_s = (
-            _env_float("PATROL_FLEET_GOSSIP_MS", 1000.0) / 1000.0
+            config.env_float("PATROL_FLEET_GOSSIP_MS") / 1000.0
             if gossip_interval_s is None
             else gossip_interval_s
         )

@@ -1,0 +1,127 @@
+"""In-flight concurrency limits as paired PN-counter lanes.
+
+A concurrency limiter bounds how many requests are *simultaneously*
+held, not how fast they arrive: ``acquire`` takes a unit while
+``inflight < limit``, ``release`` returns it. On the shared
+``LimiterState`` planes the two operations are the bucket algebra read
+backwards: the ``TAKEN`` lane counts this node's acquires, the
+``ADDED`` lane counts its releases, both monotone G-counters, and
+
+    inflight = sum(TAKEN lanes) - sum(ADDED lanes)
+
+so the state joins with the existing per-lane max merge kernels and
+rides the v2 delta plane unchanged. (The bucket's ``node.refill()``
+at-capacity refusal is exactly this family's "never release more than
+was acquired" clamp under the add<->release renaming — the
+linearizability reduction ``analysis/linearizability.py`` documents.)
+
+The CRDT hazard specific to this family is the *phantom release*: a
+release applied to a replica that has not yet seen the matching acquire
+would drive its ADDED lane past its TAKEN lane, and after convergence
+the cluster would believe capacity was returned that was never held —
+``inflight`` goes negative and the limiter over-admits forever (the
+lanes are monotone; the error can never be unwound). The kernel
+therefore clamps releases **per own lane**: a node may only release
+what it has itself acquired (``ADDED[slot] <= TAKEN[slot]`` is a kernel
+invariant, checked by the protocol model's ``ConcLaws`` and seeded as a
+cert mutation).
+
+Under partition the AP bound mirrors the bucket's: each side can hold
+up to ``limit`` concurrently, so S sides hold at most ``S x limit`` —
+PTC003-shaped, checked by ``check_conc_protocol``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from patrol_tpu.models.limiter import ADDED, TAKEN, LimiterState
+
+# Packed-transfer layout, same staging contract as ops/take.py.
+CONC_PACK_ROWS = 5
+CONC_RESULT_ROWS = 6
+
+
+class ConcRequest(NamedTuple):
+    """A microbatch of K acquire/release ticks. Leading dim K; rows are
+    unique among live rows; padding rows have ``nreq == releases == 0``
+    and commit nothing. Releases apply BEFORE acquires (a tick that
+    returns a slot and claims a new one must not self-starve)."""
+
+    rows: jax.Array  # int32[K] bucket-slot indices
+    limit_nt: jax.Array  # int64[K] max in-flight units
+    count_nt: jax.Array  # int64[K] units per acquire (NANO-scaled)
+    nreq: jax.Array  # int64[K] acquires coalesced into this row
+    releases: jax.Array  # int64[K] releases (of count_nt units each)
+
+
+class ConcResult(NamedTuple):
+    """Per-row outcome; own lanes post-commit feed the wire trailer."""
+
+    admitted: jax.Array  # int64[K] acquires granted
+    released_nt: jax.Array  # int64[K] units actually released (post-clamp)
+    inflight_nt: jax.Array  # int64[K] cluster-visible in-flight post-commit
+    own_acquired_nt: jax.Array  # int64[K] own TAKEN lane post-commit
+    own_released_nt: jax.Array  # int64[K] own ADDED lane post-commit
+    clamped_nt: jax.Array  # int64[K] release units refused by the clamp
+
+
+def conc_acquire_batch(
+    state: LimiterState, req: ConcRequest, node_slot: int
+) -> tuple[LimiterState, ConcResult]:
+    """Pure function: apply a microbatch of release-then-acquire ticks,
+    return new state + results.
+
+    Releases clamp against the OWN lane pair — ``min(requested,
+    own_taken - own_added)`` — never against the cluster sums: a remote
+    node's acquires are not ours to return, and the clamp is what keeps
+    ``ADDED[slot] <= TAKEN[slot]`` a per-lane invariant every replica
+    can verify locally after any join. Acquires then admit greedily
+    against the post-release in-flight sum, same coalesced-row shape as
+    the bucket take (``k = clip(headroom // count, 0, nreq)``).
+    """
+    i64 = jnp.int64
+    rows = req.rows
+
+    pn_rows = state.pn[rows]  # [K, N, 2] gather
+    own_added = pn_rows[:, node_slot, ADDED]
+    own_taken = pn_rows[:, node_slot, TAKEN]
+    sum_added = pn_rows[:, :, ADDED].sum(axis=-1)
+    sum_taken = pn_rows[:, :, TAKEN].sum(axis=-1)
+
+    # Release-without-acquire clamp (the phantom-release guard).
+    want_rel = jnp.maximum(req.releases, i64(0)) * jnp.maximum(
+        req.count_nt, i64(0)
+    )
+    held_own = jnp.maximum(own_taken - own_added, i64(0))
+    d_rel = jnp.minimum(want_rel, held_own)
+
+    inflight = sum_taken - (sum_added + d_rel)
+    headroom = req.limit_nt - inflight
+    safe_count = jnp.where(req.count_nt <= 0, 1, req.count_nt)
+    k = jnp.clip(headroom // safe_count, 0, req.nreq)
+    k = jnp.where(req.count_nt > 0, k, 0)
+    d_acq = k * req.count_nt
+
+    # One scatter of (ADDED, TAKEN) pairs, like the bucket take commit.
+    pair = jnp.stack([d_rel, d_acq], axis=-1)
+    pn = state.pn.at[rows, node_slot].add(pair)
+
+    result = ConcResult(
+        admitted=k,
+        released_nt=d_rel,
+        inflight_nt=inflight + d_acq,
+        own_acquired_nt=own_taken + d_acq,
+        own_released_nt=own_added + d_rel,
+        clamped_nt=want_rel - d_rel,
+    )
+    return LimiterState(pn=pn, elapsed=state.elapsed), result
+
+
+conc_acquire_batch_jit = partial(
+    jax.jit, static_argnames=("node_slot",), donate_argnums=0
+)(conc_acquire_batch)

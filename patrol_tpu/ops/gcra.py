@@ -1,0 +1,122 @@
+"""GCRA / sliding-window rate limiting as a max-lattice register kernel.
+
+The Generic Cell Rate Algorithm keeps ONE scalar per limited flow — the
+Theoretical Arrival Time (TAT). A request arriving at ``now`` conforms
+iff ``TAT <= now + tol`` (``tol`` = the burst tolerance, canonically
+``(burst-1) * T`` for emission interval ``T``); on admission the TAT
+advances to ``max(TAT, now) + T``. Unlike the token bucket there is no
+refill arithmetic at all: the whole limiter is the monotone scalar.
+
+That scalar is a *max-register lattice*, which makes the distributed
+story free: each node stores its own TAT watermark in its own
+``TAKEN`` PN lane of the shared ``LimiterState`` (the ``ADDED`` lane
+stays zero), the effective TAT is the max over all lanes, and the join
+is the per-lane elementwise max the existing merge/delta kernels
+already compute. A GCRA row therefore replicates over the v2 delta
+plane, anti-entropy, and the mesh tree-converge **unchanged** —
+certification reuses PTP001's scatter-max allowlist as-is.
+
+Semantics under partition mirror the bucket's AP bound: each side
+admits against the TAT it can see, so a 2-side partition admits at most
+2x the conforming burst — the PTC003-shaped bound the protocol model
+(``analysis/protocol.py::GcraLaws``) checks for this family.
+
+Units: TAT and ``now`` are clock nanoseconds (the injected-clock seam),
+not nanotokens; the lanes stay int64 either way.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from patrol_tpu.models.limiter import TAKEN, LimiterState
+
+# Packed-transfer layout (engine extension dispatch): one
+# int64[GCRA_PACK_ROWS, K] request matrix in, one
+# int64[GCRA_RESULT_ROWS, K] result matrix out, fixed shapes per padded
+# K so staging buffers recycle across ticks (same contract as
+# ops/take.py's TAKE_PACK_ROWS).
+GCRA_PACK_ROWS = 5
+GCRA_RESULT_ROWS = 4
+
+
+class GcraRequest(NamedTuple):
+    """A microbatch of K GCRA conformance tests. Leading dim K; rows are
+    unique among rows with ``nreq > 0`` (identical requests coalesce
+    into ``nreq``); padding rows have ``nreq == 0`` and commit nothing."""
+
+    rows: jax.Array  # int32[K] bucket-slot indices
+    now_ns: jax.Array  # int64[K] request clock (injected-clock seam)
+    emission_ns: jax.Array  # int64[K] T: nanoseconds per admitted request
+    tol_ns: jax.Array  # int64[K] tau: burst tolerance window
+    nreq: jax.Array  # int64[K] identical requests coalesced into this row
+
+
+class GcraResult(NamedTuple):
+    """Per-row outcome. ``allow_at_ns`` is the earliest clock at which
+    the NEXT request conforms (TAT - tol) — the Retry-After seed."""
+
+    admitted: jax.Array  # int64[K] how many of nreq conformed
+    tat_ns: jax.Array  # int64[K] global TAT (max over lanes) post-commit
+    own_tat_ns: jax.Array  # int64[K] this node's lane post-commit (trailer)
+    allow_at_ns: jax.Array  # int64[K] earliest conforming arrival
+
+
+def gcra_take_batch(
+    state: LimiterState, req: GcraRequest, node_slot: int
+) -> tuple[LimiterState, GcraResult]:
+    """Pure function: admit a microbatch of GCRA requests, return new
+    state + results.
+
+    Sequential semantics per row (what the admitted count reproduces):
+    request 0 conforms iff ``tat <= now + tol``; each admission advances
+    a virtual TAT ``base = max(tat, now)`` by ``T``, and request j
+    (1-based extras) conforms iff ``base + j*T <= now + tol``. So
+    ``k = min(1 + (now + tol - base) // T, nreq)`` when request 0
+    conforms, else 0 — the greedy coalesced-row admission, same shape as
+    the bucket take's ``have // count``.
+
+    The commit is a scatter-**max** of the own lane to ``base + k*T``:
+    strictly monotone (k >= 1 implies the new watermark exceeds the old
+    own-lane value is NOT guaranteed when a remote lane carries the max,
+    so max-commit rather than assignment keeps the lane a G-register
+    even then), idempotent for padding rows, and exactly the join the
+    replication plane applies on the receive side.
+    """
+    i64 = jnp.int64
+    rows = req.rows
+
+    pn_rows = state.pn[rows]  # [K, N, 2] gather
+    own_tat = pn_rows[:, node_slot, TAKEN]
+    tat = pn_rows[:, :, TAKEN].max(axis=-1)  # global view: max over lanes
+
+    base = jnp.maximum(tat, req.now_ns)
+    deadline = req.now_ns + req.tol_ns
+    conforms = tat <= deadline
+
+    safe_t = jnp.where(req.emission_ns <= 0, 1, req.emission_ns)
+    extras = jnp.maximum(deadline - base, i64(0)) // safe_t
+    k = jnp.where(conforms, 1 + extras, 0)
+    k = jnp.where(req.emission_ns > 0, k, 0)
+    k = jnp.clip(k, 0, req.nreq)
+
+    new_own = jnp.where(k >= 1, base + k * req.emission_ns, own_tat)
+    pn = state.pn.at[rows, node_slot, TAKEN].max(new_own)
+
+    tat_out = jnp.maximum(tat, new_own)
+    result = GcraResult(
+        admitted=k,
+        tat_ns=tat_out,
+        own_tat_ns=jnp.maximum(own_tat, new_own),
+        allow_at_ns=tat_out - req.tol_ns,
+    )
+    return LimiterState(pn=pn, elapsed=state.elapsed), result
+
+
+gcra_take_batch_jit = partial(
+    jax.jit, static_argnames=("node_slot",), donate_argnums=0
+)(gcra_take_batch)

@@ -1,0 +1,131 @@
+"""Hierarchical quotas (global -> tenant -> user) as a lattice path debit.
+
+A hierarchical quota admits a request only if EVERY level of its path
+has budget: the user's own allowance, the tenant's aggregate, and the
+global pool. Each level is one ordinary ``LimiterState`` row whose own
+``TAKEN`` lane counts this node's debits (a monotone G-counter; the
+``ADDED`` lane stays zero — quota budgets are configuration, carried in
+the request, not lattice state). Spend at level L is the sum of TAKEN
+lanes of L's row, so rows join with the existing per-lane max merge
+kernels and replicate over the v2 delta plane unchanged.
+
+The kernel takes the whole path in ONE packed dispatch: gather the
+three levels' rows, admit ``k = clip(min_level(headroom) // count, 0,
+nreq)``, and debit all three own TAKEN lanes with a single [3K]-row
+scatter-add — one device call per microbatch, not one per level (TPU
+scatter cost is per update; fusing the path keeps the quota take the
+same dispatch count as the flat bucket take).
+
+The family-specific CRDT hazard is the *partial debit*: admitting
+against only the leaf (or debiting only the leaf) lets a tenant's users
+collectively exceed the tenant or global budget the moment the path
+limits are not all equal — and with monotone lanes the overspend can
+never be unwound. The protocol model's ``QuotaLaws`` checks per-level
+conservation (admitted <= level-limit x partition-sides for EVERY
+level); the leaf-only variants are the family's seeded cert mutations.
+
+AP bound under partition: same shape as the bucket, per level — S sides
+can each spend up to the path minimum, so any level's spend is at most
+``S x its limit``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from patrol_tpu.models.limiter import TAKEN, LimiterState
+
+# Path depth is fixed: global -> tenant -> user. Weighted/deeper trees
+# are a follow-up family, not a runtime knob — the packed layout and the
+# protocol model's lane shapes are sized by this constant.
+QUOTA_LEVELS = 3
+
+# Packed-transfer layout, same staging contract as ops/take.py.
+QUOTA_PACK_ROWS = 8
+QUOTA_RESULT_ROWS = 5
+
+
+class QuotaRequest(NamedTuple):
+    """A microbatch of K path takes. Leading dim K; the three row
+    vectors address the path's levels (rows of the SAME state planes);
+    ``rows_user`` are unique among live rows, and distinct paths sharing
+    a tenant/global row coalesce correctly under scatter-add. Padding
+    rows have ``nreq == 0`` and commit nothing."""
+
+    rows_global: jax.Array  # int32[K] global-pool row
+    rows_tenant: jax.Array  # int32[K] tenant row
+    rows_user: jax.Array  # int32[K] user (leaf) row
+    limit_global_nt: jax.Array  # int64[K] global budget
+    limit_tenant_nt: jax.Array  # int64[K] tenant budget
+    limit_user_nt: jax.Array  # int64[K] user budget
+    count_nt: jax.Array  # int64[K] units per request
+    nreq: jax.Array  # int64[K] identical requests coalesced
+
+
+class QuotaResult(NamedTuple):
+    """Per-row outcome; per-level headrooms are post-commit."""
+
+    admitted: jax.Array  # int64[K] requests granted
+    headroom_global_nt: jax.Array  # int64[K]
+    headroom_tenant_nt: jax.Array  # int64[K]
+    headroom_user_nt: jax.Array  # int64[K]
+    own_taken_user_nt: jax.Array  # int64[K] leaf own lane (wire trailer)
+
+
+def quota_take_batch(
+    state: LimiterState, req: QuotaRequest, node_slot: int
+) -> tuple[LimiterState, QuotaResult]:
+    """Pure function: admit a microbatch of hierarchical-quota takes,
+    return new state + results.
+
+    Admission is the path minimum — every level must afford ALL k
+    admitted requests — and the debit is all-or-nothing across levels:
+    the three own-lane deltas are identical (``k * count``) and commit
+    in one packed scatter, so no interleaving (and no partial failure
+    inside the kernel) can ever record a leaf debit without its
+    ancestors'.
+    """
+    rows = jnp.concatenate([req.rows_global, req.rows_tenant, req.rows_user])
+    pn_rows = state.pn[rows]  # [3K, N, 2] gather, one call for the path
+    spend = pn_rows[:, :, TAKEN].sum(axis=-1)  # [3K]
+    k_batch = req.rows_user.shape[0]
+    spend_g = spend[:k_batch]
+    spend_t = spend[k_batch : 2 * k_batch]
+    spend_u = spend[2 * k_batch :]
+
+    head_g = req.limit_global_nt - spend_g
+    head_t = req.limit_tenant_nt - spend_t
+    head_u = req.limit_user_nt - spend_u
+    head_min = jnp.minimum(jnp.minimum(head_g, head_t), head_u)
+
+    safe_count = jnp.where(req.count_nt <= 0, 1, req.count_nt)
+    k = jnp.clip(head_min // safe_count, 0, req.nreq)
+    k = jnp.where(req.count_nt > 0, k, 0)
+    d = k * req.count_nt  # identical debit at every level
+
+    # One packed scatter for the whole path: [3K] updates on the own
+    # TAKEN lane. A tenant/global row shared by several live requests
+    # accumulates correctly under scatter-add (each path admitted
+    # against the pre-tick sums — the coalescing batcher keeps
+    # same-tenant bursts in one row when exactness matters, the same
+    # contract as duplicate bucket rows in ops/take.py).
+    debit = jnp.concatenate([d, d, d])
+    pn = state.pn.at[rows, node_slot, TAKEN].add(debit)
+
+    result = QuotaResult(
+        admitted=k,
+        headroom_global_nt=head_g - d,
+        headroom_tenant_nt=head_t - d,
+        headroom_user_nt=head_u - d,
+        own_taken_user_nt=pn_rows[2 * k_batch :, node_slot, TAKEN] + d,
+    )
+    return LimiterState(pn=pn, elapsed=state.elapsed), result
+
+
+quota_take_batch_jit = partial(
+    jax.jit, static_argnames=("node_slot",), donate_argnums=0
+)(quota_take_batch)

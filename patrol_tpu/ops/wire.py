@@ -1305,3 +1305,153 @@ def decode_member_packet(data: bytes) -> Optional[MemberPacket]:
     if off != end:
         return None  # trailing garbage ⇒ reject whole
     return MemberPacket(sender_slot, sender_epoch, MemberEvent(op, lane, epoch, addr))
+
+
+# ---------------------------------------------------------------------------
+# patrol-cert: certified-kernel lane trailers ("PK").
+#
+# Each certified limiter family beyond the token bucket ships its own
+# exact own-lane watermarks in a self-sized trailer appended AFTER the
+# P2 (and trace) trailers, invisible to every peer that does not know
+# it — the same self-described-size argument as the P2 trailer itself:
+# v1 reference nodes read exactly data[25:25+L], patrol decoders read
+# trailers by magic + size and skip unknown tails. Magic "PK" + a kind
+# byte select the family; version + checksum make a random tail
+# unparseable. Validation is all-or-nothing (PTP003: the obligations
+# registry declares encode->decode bit-exact round-trip for every
+# trailer below; a torn trailer must never half-apply).
+#
+# Payloads are the families' OWN-LANE lattice coordinates — monotone
+# watermarks a receiver max-merges, never aggregates:
+#   GCRA   u64 own TAT watermark (ns)
+#   CONC   u64 own acquired, u64 own released (nanotokens)
+#   QUOTA  u64 own taken per path level (global, tenant, user)
+
+CERT_TRAILER_MAGIC = b"PK"
+CERT_TRAILER_VERSION = 1
+CERT_KIND_GCRA = 1
+CERT_KIND_CONC = 2
+CERT_KIND_QUOTA = 3
+_CERT_GCRA = struct.Struct(">2sBBHQB")  # magic|ver|kind|own_slot|tat|ck
+_CERT_CONC = struct.Struct(">2sBBHQQB")  # …|acquired|released|ck
+_CERT_QUOTA = struct.Struct(">2sBBHQQQB")  # …|taken g|t|u|ck
+CERT_GCRA_TRAILER_SIZE = _CERT_GCRA.size
+CERT_CONC_TRAILER_SIZE = _CERT_CONC.size
+CERT_QUOTA_TRAILER_SIZE = _CERT_QUOTA.size
+
+
+@dataclasses.dataclass(frozen=True)
+class GcraTrailer:
+    own_slot: int
+    tat_ns: int  # this node's TAT watermark (max-register lane)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConcTrailer:
+    own_slot: int
+    acquired_nt: int  # own TAKEN lane (monotone acquires)
+    released_nt: int  # own ADDED lane (monotone releases, clamp-kept <=)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuotaTrailer:
+    own_slot: int
+    taken_global_nt: int  # own TAKEN lane of each path level's row
+    taken_tenant_nt: int
+    taken_user_nt: int
+
+
+def _cert_clamp(v: int) -> int:
+    """Lane watermarks are non-negative int64 on device; clamp before the
+    u64 pack so a hostile in-process value cannot wrap."""
+    return min(max(int(v), 0), _INT64_MAX)
+
+
+def _cert_seal(packed: bytes) -> bytes:
+    return packed[:-1] + bytes([sum(packed[:-1]) & 0xFF])
+
+
+def _cert_open(data: bytes, st: struct.Struct, kind: int):
+    """Shared all-or-nothing frame checks → unpacked payload or None."""
+    if len(data) != st.size:
+        return None
+    if data[-1] != sum(data[:-1]) & 0xFF:
+        return None
+    fields = st.unpack(data)
+    if fields[0] != CERT_TRAILER_MAGIC or fields[1] != CERT_TRAILER_VERSION:
+        return None
+    if fields[2] != kind:
+        return None
+    if any(v > _INT64_MAX for v in fields[4:-1]):
+        return None
+    return fields
+
+
+def encode_gcra_trailer(t: GcraTrailer) -> bytes:
+    return _cert_seal(
+        _CERT_GCRA.pack(
+            CERT_TRAILER_MAGIC,
+            CERT_TRAILER_VERSION,
+            CERT_KIND_GCRA,
+            t.own_slot & 0xFFFF,
+            _cert_clamp(t.tat_ns),
+            0,
+        )
+    )
+
+
+def decode_gcra_trailer(data: bytes) -> Optional[GcraTrailer]:
+    f = _cert_open(data, _CERT_GCRA, CERT_KIND_GCRA)
+    if f is None:
+        return None
+    return GcraTrailer(own_slot=f[3], tat_ns=f[4])
+
+
+def encode_conc_trailer(t: ConcTrailer) -> bytes:
+    return _cert_seal(
+        _CERT_CONC.pack(
+            CERT_TRAILER_MAGIC,
+            CERT_TRAILER_VERSION,
+            CERT_KIND_CONC,
+            t.own_slot & 0xFFFF,
+            _cert_clamp(t.acquired_nt),
+            _cert_clamp(t.released_nt),
+            0,
+        )
+    )
+
+
+def decode_conc_trailer(data: bytes) -> Optional[ConcTrailer]:
+    f = _cert_open(data, _CERT_CONC, CERT_KIND_CONC)
+    if f is None:
+        return None
+    if f[5] > f[4]:
+        return None  # released > acquired can never leave a clamped kernel
+    return ConcTrailer(own_slot=f[3], acquired_nt=f[4], released_nt=f[5])
+
+
+def encode_quota_trailer(t: QuotaTrailer) -> bytes:
+    return _cert_seal(
+        _CERT_QUOTA.pack(
+            CERT_TRAILER_MAGIC,
+            CERT_TRAILER_VERSION,
+            CERT_KIND_QUOTA,
+            t.own_slot & 0xFFFF,
+            _cert_clamp(t.taken_global_nt),
+            _cert_clamp(t.taken_tenant_nt),
+            _cert_clamp(t.taken_user_nt),
+            0,
+        )
+    )
+
+
+def decode_quota_trailer(data: bytes) -> Optional[QuotaTrailer]:
+    f = _cert_open(data, _CERT_QUOTA, CERT_KIND_QUOTA)
+    if f is None:
+        return None
+    return QuotaTrailer(
+        own_slot=f[3],
+        taken_global_nt=f[4],
+        taken_tenant_nt=f[5],
+        taken_user_nt=f[6],
+    )

@@ -64,6 +64,9 @@ from patrol_tpu.ops.take import (
     remaining_for_request,
 )
 from patrol_tpu.ops import lifecycle as lifecycle_ops
+from patrol_tpu.ops.gcra import GcraRequest, gcra_take_batch_jit
+from patrol_tpu.ops.concurrency import ConcRequest, conc_acquire_batch_jit
+from patrol_tpu.ops.hierquota import QuotaRequest, quota_take_batch_jit
 from patrol_tpu.runtime.bucket import ClockFn, system_clock
 from patrol_tpu.runtime.directory import (
     BucketDirectory,
@@ -2075,6 +2078,75 @@ class DeviceEngine:
             f"flush_hosted: promotion join for {len(rows)} rows did not "
             f"land within {timeout}s"
         )
+
+    # -- cert-kit kernel families (ops/gcra.py, ops/concurrency.py, ----
+    # ops/hierquota.py): synchronous microbatch entry points, one device
+    # dispatch per call against the SHARED planes — these families ride
+    # the same replication/merge path as the bucket take, so they share
+    # its state lock and donate-and-replace discipline. Certified by
+    # check.sh stage 9 (patrol-cert); registered in PROVE_ROOTS.
+
+    def gcra_take(
+        self, rows, now_ns, emission_ns, tol_ns, nreq
+    ):
+        """GCRA conformance microbatch → GcraResult (device arrays)."""
+        req = GcraRequest(
+            rows=jnp.asarray(np.asarray(rows, np.int32)),
+            now_ns=jnp.asarray(np.asarray(now_ns, np.int64)),
+            emission_ns=jnp.asarray(np.asarray(emission_ns, np.int64)),
+            tol_ns=jnp.asarray(np.asarray(tol_ns, np.int64)),
+            nreq=jnp.asarray(np.asarray(nreq, np.int64)),
+        )
+        with self._state_mu:
+            self.state, res = gcra_take_batch_jit(
+                self.state, req, self.node_slot
+            )
+        return res
+
+    def conc_acquire(
+        self, rows, limit_nt, count_nt, nreq, releases
+    ):
+        """Concurrency acquire/release microbatch → ConcResult."""
+        req = ConcRequest(
+            rows=jnp.asarray(np.asarray(rows, np.int32)),
+            limit_nt=jnp.asarray(np.asarray(limit_nt, np.int64)),
+            count_nt=jnp.asarray(np.asarray(count_nt, np.int64)),
+            nreq=jnp.asarray(np.asarray(nreq, np.int64)),
+            releases=jnp.asarray(np.asarray(releases, np.int64)),
+        )
+        with self._state_mu:
+            self.state, res = conc_acquire_batch_jit(
+                self.state, req, self.node_slot
+            )
+        return res
+
+    def quota_take(
+        self,
+        rows_global,
+        rows_tenant,
+        rows_user,
+        limit_global_nt,
+        limit_tenant_nt,
+        limit_user_nt,
+        count_nt,
+        nreq,
+    ):
+        """Hierarchical-quota path-take microbatch → QuotaResult."""
+        req = QuotaRequest(
+            rows_global=jnp.asarray(np.asarray(rows_global, np.int32)),
+            rows_tenant=jnp.asarray(np.asarray(rows_tenant, np.int32)),
+            rows_user=jnp.asarray(np.asarray(rows_user, np.int32)),
+            limit_global_nt=jnp.asarray(np.asarray(limit_global_nt, np.int64)),
+            limit_tenant_nt=jnp.asarray(np.asarray(limit_tenant_nt, np.int64)),
+            limit_user_nt=jnp.asarray(np.asarray(limit_user_nt, np.int64)),
+            count_nt=jnp.asarray(np.asarray(count_nt, np.int64)),
+            nreq=jnp.asarray(np.asarray(nreq, np.int64)),
+        )
+        with self._state_mu:
+            self.state, res = quota_take_batch_jit(
+                self.state, req, self.node_slot
+            )
+        return res
 
     def snapshot_planes(self) -> Tuple[np.ndarray, np.ndarray]:
         """Host copies of the device planes with every host-resident

@@ -32,26 +32,12 @@ ships the histograms — by the audit plane's own tick
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Callable, Dict, List, Optional
 
+from patrol_tpu.utils import config
 from patrol_tpu.utils import histogram as hist
 from patrol_tpu.utils import profiling
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except ValueError:
-        return default
 
 
 # Observations in buckets strictly ABOVE this index are guaranteed over
@@ -77,17 +63,17 @@ class SloSentinel:
         overshoot_budget: Optional[float] = None,
     ):
         self.take_budget_ns = (
-            _env_int("PATROL_SLO_TAKE_P99_NS", 0)
+            config.env_int("PATROL_SLO_TAKE_P99_NS")
             if take_budget_ns is None
             else take_budget_ns
         )
         self.stage_budget_ns = (
-            _env_int("PATROL_SLO_STAGE_P99_NS", 0)
+            config.env_int("PATROL_SLO_STAGE_P99_NS")
             if stage_budget_ns is None
             else stage_budget_ns
         )
         self.overshoot_budget = (
-            _env_float("PATROL_SLO_OVERSHOOT", 0.0)
+            config.env_float("PATROL_SLO_OVERSHOOT")
             if overshoot_budget is None
             else overshoot_budget
         )

@@ -141,6 +141,16 @@ EXACT_GATES: Dict[str, object] = {
     "churn_token_conservation": True,
     "churn_members_final": 5,
     "churn_tombstones_final": 0,
+    # cert-kit kernel families (check.sh stage 9): the smoke drives the
+    # GCRA / concurrency / hierarchical-quota device kernels against a
+    # literal python replay of their registered sequential semantics on
+    # frozen inputs — the admitted counts are fully deterministic, so
+    # they pin exactly (a drift means the kernel algebra changed without
+    # re-certification).
+    "cert_kernels": "bit-exact",
+    "cert_gcra_admitted": 15,
+    "cert_conc_admitted": 21,
+    "cert_quota_admitted": 8,
 }
 
 # Fields that must be present AND strictly positive (no baseline needed):

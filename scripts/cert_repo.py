@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Run patrol-cert — the kernel-certification meta-check over the
+declarative ``KernelFamily`` registry (patrol_tpu/ops/obligations.py).
+
+Stage 9 of the `scripts/check.sh` gate, runnable standalone. Walks
+every registered lattice family and checks, cross-stage:
+
+  PTK001  every family reaches every applicable checking stage
+          (prove / protocol / lin / bench) or carries a written
+          exemption justification
+  PTK002  every seeded mutation is rejected with its EXACT registered
+          code — mutant kernels and family-law payloads are executed
+          here; legacy stage-6/8 registry references are membership-
+          and expect-checked
+  PTK003  every obligation declared absent carries a justification
+          string, and none has gone stale
+  PTK004  every module-level ``*_jit`` lattice kernel under
+          patrol_tpu/ops/ is registered (or PROVE_EXEMPT, with the
+          reason on record)
+  PTK005  registry integrity: unique names, >= 2 mutations per family,
+          resolvable targets, well-formed codes
+
+Exit code 0 = clean; 1 = findings printed one per line as
+`path:line: CODE message`. Deterministic; the jax models run on CPU.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from patrol_tpu.analysis import driver
+
+    repo_root = driver.repo_root_for(__file__)
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--list",
+        action="store_true",
+        help="list registered families and their seeded mutations, then exit",
+    )
+    ap.add_argument(
+        "--mutation",
+        default=None,
+        help="execute ONE named seeded mutation and print the verdict",
+    )
+    ap.add_argument(
+        "--no-execute",
+        action="store_true",
+        help="registry/reachability checks only (skip mutation execution)",
+    )
+    args = ap.parse_args()
+
+    from patrol_tpu.analysis import cert
+    from patrol_tpu.ops.obligations import KERNEL_FAMILIES
+
+    if args.list:
+        for fam in KERNEL_FAMILIES:
+            stages = [
+                "prove" if fam.prove_roots else "-",
+                f"protocol={fam.protocol}" if fam.protocol else "protocol:exempt",
+                "lin" if fam.lin_specs else "lin:exempt",
+                "bench" if fam.bench_fields else "bench:exempt",
+            ]
+            print(f"family   {fam.name}  [{' '.join(stages)}]")
+            for mut in fam.mutations:
+                kind = "stage-ref" if mut.stage == "lin" else "executed"
+                print(
+                    f"mutation {mut.name}  → {mut.expect} "
+                    f"[{mut.stage}, {kind}]"
+                )
+            if fam.mutations_exempt:
+                print(f"mutation (exempt: {fam.mutations_exempt})")
+        return 0
+
+    if args.mutation:
+        fam = next(
+            (
+                f
+                for f in KERNEL_FAMILIES
+                if any(m.name == args.mutation for m in f.mutations)
+            ),
+            None,
+        )
+        if fam is None:
+            return driver.unknown_name("patrol-cert", "mutation", args.mutation)
+        findings = cert.check_mutations(families=[fam], execute=True)
+        mine = [f for f in findings if f"'{args.mutation}'" in f.message]
+        hit = not mine
+        mut = next(m for m in fam.mutations if m.name == args.mutation)
+        detail = (
+            f"rejected with {mut.expect} (family {fam.name})"
+            if hit
+            else f"NOT rejected: {mine[0].message}"
+        )
+        return driver.mutation_verdict("patrol-cert", args.mutation, hit, detail)
+
+    findings = cert.check_repo(execute_mutations=not args.no_execute)
+    findings = driver.apply_stage_suppressions(findings, repo_root, "PTK")
+
+    executed = sum(
+        1
+        for f in KERNEL_FAMILIES
+        for m in f.mutations
+        if m.stage != "lin"
+    )
+    referenced = sum(
+        1 for f in KERNEL_FAMILIES for m in f.mutations if m.stage == "lin"
+    )
+    return driver.finish(
+        "patrol-cert",
+        findings,
+        lambda: (
+            f"patrol-cert: clean ({len(KERNEL_FAMILIES)} families, "
+            f"{executed} seeded mutations executed + {referenced} "
+            "stage-8 references pinned, all rejected with their "
+            "exact codes)"
+        ),
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

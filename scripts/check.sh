@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # patrol-check: the repo-wide static-analysis + sanitizer + prover gate.
 #
-# One command, one pass/fail exit code, eight stages (plus one opt-in):
+# One command, one pass/fail exit code, nine stages (plus one opt-in):
 #
 #   lint    — repo-specific AST checks over patrol_tpu/ (clock seams,
 #             jit-reachable sync primitives, lock order, nanotoken dtype
@@ -65,6 +65,19 @@
 #             mutations demonstrably rejected with their exact codes
 #             (PTN005); plus the pytest -m lin self-tests.
 #             Pure python, never skips.
+#   cert    — patrol-cert: the kernel-certification meta-check
+#             (patrol_tpu/analysis/cert.py, scripts/cert_repo.py) over
+#             the declarative KernelFamily registry
+#             (ops/obligations.py::KERNEL_FAMILIES): every lattice
+#             family reachable by every applicable stage — prove /
+#             protocol / lin / bench — or justified-exempt (PTK001),
+#             every seeded mutation demonstrably rejected with its
+#             exact code, mutant kernels and family-law payloads
+#             executed in-process (PTK002), every declared-absent
+#             obligation justified (PTK003), every module-level *_jit
+#             kernel under ops/ registered (PTK004), and registry
+#             integrity (PTK005); plus the pytest -m cert self-tests.
+#             CPU-pinned jax models, never skips.
 #   asan-py — OPT-IN (never in the default set; select explicitly with
 #             --stage): the ctypes-facing pytest subset under
 #             LD_PRELOAD=libasan with an ASan-instrumented
@@ -77,14 +90,14 @@
 #                    check.sh --stage asan-py        # the opt-in seam check
 # The final line is machine-readable so an outer CI can assert that no
 # stage silently skipped (scripts/ci_gate.sh does exactly that):
-#                    PATROL_CHECK stages=8 pass=7 skip=1 fail=0 skipped=tidy failed=-
+#                    PATROL_CHECK stages=9 pass=8 skip=1 fail=0 skipped=tidy failed=-
 #
 # Prereqs and the lint/prove suppression format are documented in
 # README.md ("patrol-check").
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-DEFAULT_STAGES="lint,tidy,san,prove,abi,protocol,race,lin"
+DEFAULT_STAGES="lint,tidy,san,prove,abi,protocol,race,lin,cert"
 STAGES="$DEFAULT_STAGES"
 while [[ $# -gt 0 ]]; do
   case "$1" in
@@ -93,7 +106,7 @@ while [[ $# -gt 0 ]]; do
     -h|--help)
       sed -n '2,83p' "$0" | sed 's/^# \{0,1\}//'
       exit 0 ;;
-    *) echo "unknown argument: $1 (try --stage lint,tidy,san,prove,abi,protocol,race,lin,asan-py)" >&2
+    *) echo "unknown argument: $1 (try --stage lint,tidy,san,prove,abi,protocol,race,lin,cert,asan-py)" >&2
        exit 2 ;;
   esac
 done
@@ -249,6 +262,18 @@ stage_lin() (
   fi
 )
 
+stage_cert() (
+  set -euo pipefail
+  echo "== patrol-check [cert] kernel-certification meta-check =="
+  python scripts/cert_repo.py
+  if have_pytest; then
+    env JAX_PLATFORMS=cpu python -m pytest tests/test_cert.py -q -m cert \
+      -p no:cacheprovider
+  else
+    echo "pytest unavailable: cert self-tests skipped (meta-check itself ran)"
+  fi
+)
+
 stage_asan_py() (
   set -euo pipefail
   echo "== patrol-check [asan-py] ctypes seam under LD_PRELOAD=libasan =="
@@ -312,11 +337,11 @@ run_stage() {
 IFS=',' read -r -a SELECTED <<<"$STAGES"
 for s in "${SELECTED[@]}"; do
   case "$s" in
-    lint|tidy|san|prove|abi|protocol|race|lin|asan-py) ;;
-    *) echo "unknown stage: '$s' (valid: lint tidy san prove abi protocol race lin asan-py)" >&2; exit 2 ;;
+    lint|tidy|san|prove|abi|protocol|race|lin|cert|asan-py) ;;
+    *) echo "unknown stage: '$s' (valid: lint tidy san prove abi protocol race lin cert asan-py)" >&2; exit 2 ;;
   esac
 done
-for s in lint tidy san prove abi protocol race lin asan-py; do
+for s in lint tidy san prove abi protocol race lin cert asan-py; do
   for sel in "${SELECTED[@]}"; do
     if [[ "$sel" == "$s" ]]; then
       case "$s" in
@@ -328,6 +353,7 @@ for s in lint tidy san prove abi protocol race lin asan-py; do
         protocol) run_stage protocol stage_protocol ;;
         race)    run_stage race    stage_race ;;
         lin)     run_stage lin     stage_lin ;;
+        cert)    run_stage cert    stage_cert ;;
         asan-py) run_stage asan-py stage_asan_py ;;
       esac
     fi

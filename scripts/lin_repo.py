@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """Run patrol-lin — replication-aware linearizability checking against a
-sequential token-bucket spec (arXiv:2502.19967).
+sequential limiter spec (arXiv:2502.19967).
 
 Stage 8 of the `scripts/check.sh` gate, runnable standalone. For every
 kernel family registered in patrol_tpu/ops/obligations.py::LIN_SPECS it
@@ -38,10 +38,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
 
 def main() -> int:
+    from patrol_tpu.analysis import driver
+
+    repo_root = driver.repo_root_for(__file__)
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--mutation",
@@ -60,7 +62,7 @@ def main() -> int:
 
     if args.list:
         for spec in LIN_SPECS:
-            flags = f"wire={spec.wire}" + (
+            flags = f"wire={spec.wire} algebra={spec.algebra}" + (
                 " lifecycle" if spec.lifecycle else ""
             )
             print(f"family   {spec.name}  [{flags}]")
@@ -71,8 +73,7 @@ def main() -> int:
     if args.mutation:
         mut = lin.LIN_MUTATIONS.get(args.mutation)
         if mut is None:
-            print(f"unknown mutation: {args.mutation}", file=sys.stderr)
-            return 2
+            return driver.unknown_name("patrol-lin", "mutation", args.mutation)
         spec = next((s for s in LIN_SPECS if s.name == mut.family), None)
         if spec is None:
             print(f"family not registered: {mut.family}", file=sys.stderr)
@@ -80,36 +81,32 @@ def main() -> int:
         explored, findings = lin.check_family(
             spec, mut.laws, stop_at_first=False
         )
-        for f in findings:
-            print(f)
+        driver.print_findings(findings)
         hit = any(f.check == mut.expect for f in findings)
-        print(
-            f"patrol-lin: mutation '{args.mutation}' "
-            + (
+        return driver.mutation_verdict(
+            "patrol-lin",
+            args.mutation,
+            hit,
+            (
                 f"REJECTED by {mut.expect} (good)"
                 if hit
                 else f"NOT caught by {mut.expect} (bad)"
             )
-            + f" — {explored} schedules"
+            + f" — {explored} schedules",
         )
-        return 0 if hit else 1
-
-    from patrol_tpu.analysis.lint import apply_suppressions
 
     explored, findings = lin.check_repo(LIN_SPECS)
-    findings = apply_suppressions(findings, REPO_ROOT, stale_family="PTN")
-    for f in findings:
-        print(f)
-    if findings:
-        print(f"patrol-lin: {len(findings)} finding(s)", file=sys.stderr)
-        return 1
-    print(
+    findings = driver.apply_stage_suppressions(
+        findings, repo_root, stale_family="PTN"
+    )
+    return driver.finish(
+        "patrol-lin",
+        findings,
         "patrol-lin: clean "
         f"(schedules explored={explored} across {len(LIN_SPECS)} kernel "
         f"families, {len(lin.LIN_MUTATIONS)} seeded mutations all "
-        "rejected with their exact codes)"
+        "rejected with their exact codes)",
     )
-    return 0
 
 
 if __name__ == "__main__":

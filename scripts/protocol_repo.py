@@ -27,6 +27,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
+    from patrol_tpu.analysis import driver
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--mutation",
@@ -48,32 +50,27 @@ def main() -> int:
     if args.mutation:
         sem = protocol.MUTATIONS.get(args.mutation)
         if sem is None:
-            print(f"unknown mutation: {args.mutation}", file=sys.stderr)
-            return 2
+            return driver.unknown_name(
+                "patrol-protocol", "mutation", args.mutation
+            )
         findings = protocol.check_protocol(sem)
-        for f in findings:
-            print(f)
-        print(
-            f"patrol-protocol: mutation '{args.mutation}' "
-            + ("REJECTED (good)" if findings else "NOT caught (bad)")
+        driver.print_findings(findings)
+        return driver.mutation_verdict(
+            "patrol-protocol",
+            args.mutation,
+            bool(findings),
+            "REJECTED (good)" if findings else "NOT caught (bad)",
         )
-        return 0 if findings else 1
 
-    findings = protocol.check_repo()
-    for f in findings:
-        print(f)
-    if findings:
-        print(
-            f"patrol-protocol: {len(findings)} finding(s)", file=sys.stderr
+    def clean_line() -> str:
+        explored, _ = protocol.check_async_schedules()
+        return (
+            "patrol-protocol: clean "
+            f"(async states explored={explored}, "
+            f"{len(protocol.MUTATIONS)} seeded mutations all rejected)"
         )
-        return 1
-    explored, _ = protocol.check_async_schedules()
-    print(
-        "patrol-protocol: clean "
-        f"(async states explored={explored}, "
-        f"{len(protocol.MUTATIONS)} seeded mutations all rejected)"
-    )
-    return 0
+
+    return driver.finish("patrol-protocol", protocol.check_repo(), clean_line)
 
 
 if __name__ == "__main__":

@@ -25,10 +25,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
+    from patrol_tpu.analysis import driver
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--root",
-        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        default=driver.repo_root_for(__file__),
         help="repo root (default: this script's parent)",
     )
     ap.add_argument(
@@ -64,20 +66,16 @@ def main() -> int:
     else:
         findings = prove.prove_repo(args.root)
 
-    for f in findings:
-        print(f)
-    if findings:
-        print(
-            f"patrol-prove: {len(findings)} finding(s) across "
-            f"{len({f.path for f in findings})} file(s)",
-            file=sys.stderr,
-        )
-        return 1
-    print(
+    return driver.finish(
+        "patrol-prove",
+        findings,
         f"patrol-prove: clean ({len(roots)} roots, all obligations hold; "
-        "engine dispatch graph fully registered)"
+        "engine dispatch graph fully registered)",
+        findings_line=lambda fs: (
+            f"patrol-prove: {len(fs)} finding(s) across "
+            f"{len({f.path for f in fs})} file(s)"
+        ),
     )
-    return 0
 
 
 if __name__ == "__main__":

@@ -39,10 +39,12 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
 
 def main() -> int:
+    from patrol_tpu.analysis import driver
+
+    repo_root = driver.repo_root_for(__file__)
+
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
         "--mutation",
@@ -70,53 +72,48 @@ def main() -> int:
     if args.mutation:
         entry = race.SEAM_MUTATIONS.get(args.mutation)
         if entry is None:
-            print(f"unknown mutation: {args.mutation}", file=sys.stderr)
-            return 2
+            return driver.unknown_name("patrol-race", "mutation", args.mutation)
         sem, code = entry
         findings = race.check_seam(sem)
-        for f in findings:
-            print(f)
+        driver.print_findings(findings)
         hit = any(f.check == code for f in findings)
-        print(
-            f"patrol-race: mutation '{args.mutation}' "
-            + (f"REJECTED by {code} (good)" if hit else "NOT caught (bad)")
+        return driver.mutation_verdict(
+            "patrol-race",
+            args.mutation,
+            hit,
+            f"REJECTED by {code} (good)" if hit else "NOT caught (bad)",
         )
-        return 0 if hit else 1
 
     if args.static_only:
-        from patrol_tpu.analysis.lint import apply_suppressions
-
         used = set()
-        findings = apply_suppressions(
-            race.race_static(race.race_sources(REPO_ROOT), used_out=used),
-            REPO_ROOT,
+        findings = driver.apply_stage_suppressions(
+            race.race_static(race.race_sources(repo_root), used_out=used),
+            repo_root,
             stale_family="PTR",
             inline_used=used,
         )
     else:
-        findings = race.race_repo(REPO_ROOT)
-    for f in findings:
-        print(f)
-    if findings:
-        print(f"patrol-race: {len(findings)} finding(s)", file=sys.stderr)
-        return 1
-    explored = sum(
-        race.explore_seam(sc)[0] for sc in race.builtin_seam_scenarios()
-    )
-    n_guards = sum(
-        len(attrs)
-        for per_cls in race.GUARDS.values()
-        for attrs in per_cls.values()
-    )
-    print(
-        "patrol-race: clean "
-        f"(seam states explored={explored} across "
-        f"{len(race.builtin_seam_scenarios())} scenarios, "
-        f"{len(race.SEAM_MUTATIONS)} seeded mutations all rejected; "
-        f"{n_guards} guarded attrs, "
-        f"{len(race.RACE_FILES)} thread-ensemble files)"
-    )
-    return 0
+        findings = race.race_repo(repo_root)
+
+    def clean_line() -> str:
+        explored = sum(
+            race.explore_seam(sc)[0] for sc in race.builtin_seam_scenarios()
+        )
+        n_guards = sum(
+            len(attrs)
+            for per_cls in race.GUARDS.values()
+            for attrs in per_cls.values()
+        )
+        return (
+            "patrol-race: clean "
+            f"(seam states explored={explored} across "
+            f"{len(race.builtin_seam_scenarios())} scenarios, "
+            f"{len(race.SEAM_MUTATIONS)} seeded mutations all rejected; "
+            f"{n_guards} guarded attrs, "
+            f"{len(race.RACE_FILES)} thread-ensemble files)"
+        )
+
+    return driver.finish("patrol-race", findings, clean_line)
 
 
 if __name__ == "__main__":

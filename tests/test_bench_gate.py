@@ -26,6 +26,10 @@ def healthy_receipts():
         {
             "ingest_commit_equivalence": "bit-exact",
             "ingest_raw_vs_host_fixpoint": "bit-exact",
+            "cert_kernels": "bit-exact",
+            "cert_gcra_admitted": 15,
+            "cert_conc_admitted": 21,
+            "cert_quota_admitted": 8,
             "ingest_raw_device_dispatches": 25,
             "wire_raw_device_dispatches": 15,
             "metrics_exposition": "parsed",

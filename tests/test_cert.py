@@ -1,0 +1,480 @@
+"""Stage-9 patrol-cert self-tests (``pytest -m cert``).
+
+Two halves, mirroring the other stage suites:
+
+* **Real-repo gate** — the live ``KERNEL_FAMILIES`` registry passes
+  every meta-check (reachability, absence justifications, the ops/
+  ``*_jit`` sweep, registry integrity) and one seeded prove mutant is
+  executed end-to-end to show the rejection evidence is live, not just
+  registered.
+* **Fixture self-tests, both ways** — for each PTK code, a synthetic
+  family that SHOULD fire it does, and the minimally-correct variant
+  stays silent. These pin the checker itself: a regression that makes
+  patrol-cert stop seeing a hole fails here, not in production.
+
+The heavy payload executions (family-law protocol models, all three
+mutant kernels) are stage 9's ``scripts/cert_repo.py`` leg — this suite
+executes exactly one mutant so the pytest half stays seconds-class.
+"""
+
+import dataclasses
+
+import pytest
+
+from patrol_tpu.analysis import cert
+from patrol_tpu.analysis.prove import ProveRoot
+from patrol_tpu.ops import obligations as ob
+
+pytestmark = pytest.mark.cert
+
+FAMS = {f.name: f for f in ob.KERNEL_FAMILIES}
+
+
+def _codes(findings):
+    return sorted(f.check for f in findings)
+
+
+def _messages(findings):
+    return "\n".join(str(f) for f in findings)
+
+
+def _root(**kw):
+    """A synthetic prove root; defaults declare every PTP code so the
+    absence checker has nothing to say unless a test removes one."""
+    base = dict(
+        name="fixture.ops.kernel",
+        module="patrol_tpu.ops.merge",
+        attr="merge_batch",
+        obligations=("PTP001", "PTP002", "PTP003", "PTP004", "PTP005"),
+        structural=None,
+        model=None,
+        tracer=None,
+    )
+    base.update(kw)
+    return ProveRoot(**base)
+
+
+def _fam(**kw):
+    """A synthetic family that passes every check unless a test breaks
+    one field: a fully-declared root, exemptions everywhere else."""
+    base = dict(
+        name="fixture-family",
+        domain="fixture lattice for checker self-tests",
+        prove_roots=(_root(),),
+        protocol_exempt="fixture: no replication plane",
+        lin_exempt="fixture: no linearizable surface",
+        bench_exempt="fixture: no smoke leg",
+        mutations_exempt="fixture: checker self-test record",
+    )
+    base.update(kw)
+    return ob.KernelFamily(**base)
+
+
+# ---------------------------------------------------------------------------
+# Real-repo gate.
+
+
+class TestRepoGate:
+    def test_registry_is_clean_without_execution(self):
+        findings = cert.check_repo(execute_mutations=False)
+        assert not findings, _messages(findings)
+
+    def test_cert_kit_families_are_fully_registered(self):
+        for name, algebra in (
+            ("gcra", "gcra"),
+            ("concurrency", "conc"),
+            ("hierquota", "quota"),
+        ):
+            fam = FAMS[name]
+            assert fam.prove_roots, name
+            assert fam.protocol, name
+            assert fam.wire_codec in {r.name for r in fam.prove_roots}
+            assert fam.bench_fields, name
+            assert len(fam.mutations) >= 2, name
+            assert {s.algebra for s in fam.lin_specs} == {algebra}
+
+    def test_derived_registries_aggregate_the_families(self):
+        fam_roots = [r for f in ob.KERNEL_FAMILIES for r in f.prove_roots]
+        assert tuple(fam_roots) == ob.PROVE_ROOTS
+        fam_specs = [s for f in ob.KERNEL_FAMILIES for s in f.lin_specs]
+        assert tuple(fam_specs) == ob.LIN_SPECS
+        # Root names stay unique — tests/test_prove.py keys on attr.
+        names = [r.name for r in ob.PROVE_ROOTS]
+        assert len(names) == len(set(names))
+
+    def test_seeded_gcra_mutant_is_rejected_live(self):
+        """One end-to-end execution: the seeded off-by-one window mutant
+        must be rejected with exactly its registered code."""
+        fam = FAMS["gcra"]
+        mut = next(m for m in fam.mutations if m.stage == "prove")
+        only = dataclasses.replace(fam, mutations=(mut,))
+        findings = cert.check_mutations(families=[only], execute=True)
+        assert not findings, _messages(findings)
+
+    def test_tampered_expect_code_is_caught_on_execution(self):
+        """The same mutant with a WRONG pinned code must be a PTK002
+        finding — the 'gone soft' detector works both ways."""
+        fam = FAMS["gcra"]
+        mut = next(m for m in fam.mutations if m.stage == "prove")
+        bad = dataclasses.replace(
+            fam, mutations=(dataclasses.replace(mut, expect="PTP004"),)
+        )
+        findings = cert.check_mutations(families=[bad], execute=True)
+        hits = [f for f in findings if "gone soft" in f.message]
+        assert hits and _codes(hits) == ["PTK002"]
+
+
+# ---------------------------------------------------------------------------
+# PTK001 — stage reachability, both ways.
+
+
+class TestReachability:
+    def test_fully_exempt_family_is_clean(self):
+        assert cert.check_reachability(families=[_fam()]) == []
+
+    def test_no_prove_roots_fires(self):
+        findings = cert.check_reachability(families=[_fam(prove_roots=())])
+        assert "PTK001" in _codes(findings)
+        assert "never reaches stage 4" in _messages(findings)
+
+    def test_undispatchable_model_tag_fires(self):
+        fam = _fam(prove_roots=(_root(model="no-such-model"),))
+        findings = cert.check_reachability(families=[fam])
+        assert _codes(findings) == ["PTK001"]
+        assert "cannot dispatch" in _messages(findings)
+
+    def test_undispatchable_join_batch_suffix_fires(self):
+        fam = _fam(prove_roots=(_root(model="join_batch:no-such"),))
+        assert _codes(cert.check_reachability(families=[fam])) == ["PTK001"]
+
+    def test_known_join_batch_suffix_is_clean(self):
+        fam = _fam(prove_roots=(_root(model="join_batch:merge_batch"),))
+        assert cert.check_reachability(families=[fam]) == []
+
+    def test_missing_protocol_hook_without_exemption_fires(self):
+        fam = _fam(protocol_exempt="")
+        findings = cert.check_reachability(families=[fam])
+        assert _codes(findings) == ["PTK001"]
+        assert "stage 6 never sees" in _messages(findings)
+
+    def test_unknown_protocol_key_fires(self):
+        fam = _fam(protocol="no-such-hook")
+        findings = cert.check_reachability(families=[fam])
+        assert _codes(findings) == ["PTK001"]
+        assert "FAMILY_CHECKS" in _messages(findings)
+
+    def test_missing_lin_spec_without_exemption_fires(self):
+        fam = _fam(lin_exempt="")
+        findings = cert.check_reachability(families=[fam])
+        assert _codes(findings) == ["PTK001"]
+        assert "stage 8" in _messages(findings)
+
+    def test_unknown_lin_algebra_fires(self):
+        spec = FAMS["gcra"].lin_specs[0]
+        fam = _fam(
+            lin_specs=(dataclasses.replace(spec, algebra="no-such"),),
+            lin_exempt="",
+        )
+        assert _codes(cert.check_reachability(families=[fam])) == ["PTK001"]
+
+    def test_missing_bench_field_without_exemption_fires(self):
+        fam = _fam(bench_exempt="")
+        findings = cert.check_reachability(families=[fam])
+        assert _codes(findings) == ["PTK001"]
+        assert "smoke gate" in _messages(findings)
+
+    def test_bench_field_not_emitted_by_bench_py_fires(self):
+        fam = _fam(bench_fields=("no_such_smoke_field",), bench_exempt="")
+        findings = cert.check_reachability(families=[fam])
+        assert _codes(findings) == ["PTK001"]
+        assert "not" in _messages(findings) and "bench.py" in _messages(
+            findings
+        )
+
+    def test_emitted_bench_field_is_clean(self):
+        fam = _fam(bench_fields=("cert_gcra_admitted",), bench_exempt="")
+        assert cert.check_reachability(families=[fam]) == []
+
+
+# ---------------------------------------------------------------------------
+# PTK002 — mutation registration, both ways (no execution needed).
+
+
+class TestMutationRegistration:
+    def test_prove_mutation_with_unknown_root_fires(self):
+        fam = _fam(
+            mutations=(
+                ob.CertMutation(
+                    name="fixture-unknown-root",
+                    stage="prove",
+                    target="no.such.root",
+                    expect="PTP002",
+                    mutant=lambda *a: None,
+                ),
+            ),
+            mutations_exempt="",
+        )
+        findings = cert.check_mutations(families=[fam], execute=False)
+        assert _codes(findings) == ["PTK002"]
+        assert "unknown prove root" in _messages(findings)
+
+    def test_prove_mutation_without_mutant_fires(self):
+        fam = _fam(
+            mutations=(
+                ob.CertMutation(
+                    name="fixture-no-mutant",
+                    stage="prove",
+                    target="fixture.ops.kernel",
+                    expect="PTP002",
+                ),
+            ),
+        )
+        findings = cert.check_mutations(families=[fam], execute=False)
+        assert _codes(findings) == ["PTK002"]
+        assert "no mutant kernel" in _messages(findings)
+
+    def test_law_mutation_targeting_foreign_hook_fires(self):
+        gcra = FAMS["gcra"]
+        law_mut = next(m for m in gcra.mutations if m.laws is not None)
+        fam = dataclasses.replace(
+            gcra,
+            protocol="bucket-full",
+            mutations=(law_mut,),
+        )
+        findings = cert.check_mutations(families=[fam], execute=False)
+        assert _codes(findings) == ["PTK002"]
+        assert "not the family's own protocol hook" in _messages(findings)
+
+    def test_registry_reference_to_unknown_semantics_fires(self):
+        fam = _fam(
+            mutations=(
+                ob.CertMutation(
+                    name="fixture-unknown-sem",
+                    stage="protocol",
+                    target="no-such-registered-mutation",
+                    expect="PTC001",
+                ),
+            ),
+        )
+        findings = cert.check_mutations(families=[fam], execute=False)
+        assert _codes(findings) == ["PTK002"]
+        assert "protocol.MUTATIONS" in _messages(findings)
+
+    def test_lin_reference_to_unknown_mutation_fires(self):
+        fam = _fam(
+            mutations=(
+                ob.CertMutation(
+                    name="fixture-unknown-lin",
+                    stage="lin",
+                    target="no-such-lin-mutation",
+                    expect="PTN001",
+                ),
+            ),
+        )
+        findings = cert.check_mutations(families=[fam], execute=False)
+        assert _codes(findings) == ["PTK002"]
+        assert "LIN_MUTATIONS" in _messages(findings)
+
+    def test_lin_expect_disagreement_fires(self):
+        """Stage 8 registers PTN004 for the gc mutation — a family that
+        pins any other code is a registry split-brain finding."""
+        fam = _fam(
+            lin_specs=FAMS["lifecycle"].lin_specs,
+            lin_exempt="",
+            mutations=(
+                ob.CertMutation(
+                    name="fixture-wrong-lin-code",
+                    stage="lin",
+                    target="gc-forgets-visible-admits",
+                    expect="PTN001",
+                ),
+            ),
+        )
+        findings = cert.check_mutations(families=[fam], execute=False)
+        assert "PTK002" in _codes(findings)
+        assert "registries disagree" in _messages(findings)
+
+    def test_lin_mutation_against_unregistered_spec_fires(self):
+        """A family may only claim lin mutations that run against a
+        spec it actually registers."""
+        fam = _fam(
+            mutations=(
+                ob.CertMutation(
+                    name="fixture-foreign-spec",
+                    stage="lin",
+                    target="gc-forgets-visible-admits",
+                    expect="PTN004",
+                ),
+            ),
+        )
+        findings = cert.check_mutations(families=[fam], execute=False)
+        assert _codes(findings) == ["PTK002"]
+        assert "does not register" in _messages(findings)
+
+
+# ---------------------------------------------------------------------------
+# PTK003 — absence justifications, both ways.
+
+
+class TestAbsenceJustifications:
+    def test_fully_declared_root_needs_no_justification(self):
+        assert cert.check_absent_justifications(families=[_fam()]) == []
+
+    def test_unjustified_absence_fires_per_missing_code(self):
+        root = _root(obligations=("PTP001", "PTP004", "PTP005"))
+        fam = _fam(prove_roots=(root,))
+        findings = cert.check_absent_justifications(families=[fam])
+        assert _codes(findings) == ["PTK003", "PTK003"]
+        msgs = _messages(findings)
+        assert "PTP002" in msgs and "PTP003" in msgs
+        assert "silence is not a design decision" in msgs
+
+    def test_written_justification_silences_the_absence(self):
+        root = _root(obligations=("PTP001", "PTP004", "PTP005"))
+        fam = _fam(
+            prove_roots=(root,),
+            absent={
+                "fixture.ops.kernel:PTP002": "host-side scalar path",
+                "fixture.ops.kernel:PTP003": "no wire surface",
+            },
+        )
+        assert cert.check_absent_justifications(families=[fam]) == []
+
+    def test_blank_justification_is_not_a_justification(self):
+        root = _root(obligations=("PTP001", "PTP002", "PTP003", "PTP004"))
+        fam = _fam(
+            prove_roots=(root,),
+            absent={"fixture.ops.kernel:PTP005": "   "},
+        )
+        findings = cert.check_absent_justifications(families=[fam])
+        assert _codes(findings) == ["PTK003"]
+
+    def test_stale_justification_for_declared_code_fires(self):
+        fam = _fam(
+            absent={"fixture.ops.kernel:PTP003": "was absent once"},
+        )
+        findings = cert.check_absent_justifications(families=[fam])
+        assert _codes(findings) == ["PTK003"]
+        assert "stale" in _messages(findings)
+
+    def test_justification_for_unknown_root_fires(self):
+        fam = _fam(
+            absent={"no.such.root:PTP003": "orphaned entry"},
+        )
+        findings = cert.check_absent_justifications(families=[fam])
+        assert _codes(findings) == ["PTK003"]
+        assert "does not register" in _messages(findings)
+
+
+# ---------------------------------------------------------------------------
+# PTK004 — the ops/ *_jit sweep, both ways.
+
+
+class TestUnregisteredKernels:
+    def test_every_jitted_ops_kernel_is_registered(self):
+        findings = cert.check_unregistered_kernels()
+        assert not findings, _messages(findings)
+
+    def test_deregistering_a_kernel_is_caught(self, monkeypatch):
+        pruned = tuple(
+            r for r in ob.PROVE_ROOTS if r.attr != "gcra_take_batch"
+        )
+        monkeypatch.setattr(ob, "PROVE_ROOTS", pruned)
+        findings = cert.check_unregistered_kernels()
+        assert _codes(findings) == ["PTK004"]
+        assert "patrol_tpu.ops.gcra.gcra_take_batch" in _messages(findings)
+        assert "cannot land uncertified" in _messages(findings)
+
+
+# ---------------------------------------------------------------------------
+# PTK005 — registry integrity, both ways.
+
+
+class TestRegistryIntegrity:
+    def test_wellformed_family_is_clean(self):
+        assert cert.check_registry_integrity(families=[_fam()]) == []
+
+    def test_duplicate_family_name_fires(self):
+        findings = cert.check_registry_integrity(families=[_fam(), _fam()])
+        assert "PTK005" in _codes(findings)
+        assert "duplicate family name" in _messages(findings)
+
+    def test_empty_domain_fires(self):
+        findings = cert.check_registry_integrity(families=[_fam(domain=" ")])
+        assert _codes(findings) == ["PTK005"]
+        assert "empty domain" in _messages(findings)
+
+    def test_root_claimed_by_two_families_fires(self):
+        a = _fam(name="fixture-a")
+        b = _fam(name="fixture-b")
+        findings = cert.check_registry_integrity(families=[a, b])
+        assert _codes(findings) == ["PTK005"]
+        assert "also" in _messages(findings)
+
+    def test_single_mutation_without_exemption_fires(self):
+        fam = _fam(
+            mutations=(
+                ob.CertMutation(
+                    name="fixture-lonely",
+                    stage="lin",
+                    target="gc-forgets-visible-admits",
+                    expect="PTN004",
+                ),
+            ),
+            mutations_exempt="",
+        )
+        findings = cert.check_registry_integrity(families=[fam])
+        assert _codes(findings) == ["PTK005"]
+        assert ">= 2" in _messages(findings)
+
+    def test_unknown_stage_fires(self):
+        fam = _fam(
+            mutations=(
+                ob.CertMutation(
+                    name="fixture-bad-stage",
+                    stage="bench",
+                    target="x",
+                    expect="PTK001",
+                ),
+                ob.CertMutation(
+                    name="fixture-bad-stage-2",
+                    stage="race",
+                    target="x",
+                    expect="PTK001",
+                ),
+            ),
+        )
+        findings = cert.check_registry_integrity(families=[fam])
+        assert _codes(findings) == ["PTK005", "PTK005"]
+        assert "unknown stage" in _messages(findings)
+
+    def test_malformed_expect_code_fires(self):
+        fam = _fam(
+            mutations=(
+                ob.CertMutation(
+                    name="fixture-bad-code",
+                    stage="lin",
+                    target="x",
+                    expect="PTX01",
+                ),
+                ob.CertMutation(
+                    name="fixture-bad-code-2",
+                    stage="lin",
+                    target="x",
+                    expect="not-a-code",
+                ),
+            ),
+        )
+        findings = cert.check_registry_integrity(families=[fam])
+        assert _codes(findings) == ["PTK005", "PTK005"]
+        assert "not a PT code" in _messages(findings)
+
+    def test_wire_codec_must_name_a_family_root(self):
+        fam = _fam(wire_codec="some.other.codec")
+        findings = cert.check_registry_integrity(families=[fam])
+        assert _codes(findings) == ["PTK005"]
+        assert "ship uncertified" in _messages(findings)
+
+    def test_wire_codec_naming_own_root_is_clean(self):
+        fam = _fam(wire_codec="fixture.ops.kernel")
+        assert cert.check_registry_integrity(families=[fam]) == []

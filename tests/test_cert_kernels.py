@@ -1,0 +1,296 @@
+"""Unit tests for the cert-kit device kernels (ops/gcra.py,
+ops/concurrency.py, ops/hierquota.py) and their wire trailers: admission
+semantics against a sequential replay, the lattice discipline of every
+commit (monotone own-lane writes, padding rows commit nothing, remote
+lanes respected but never written), and the all-or-nothing trailer
+codecs. The end-to-end engine dispatch of the same kernels is the bench
+--smoke cert leg; the protocol/lin laws live in stages 6/8/9."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from patrol_tpu.models.limiter import (
+    ADDED,
+    TAKEN,
+    LimiterConfig,
+    LimiterState,
+    init_state,
+)
+from patrol_tpu.ops.concurrency import ConcRequest, conc_acquire_batch
+from patrol_tpu.ops.gcra import GcraRequest, gcra_take_batch
+from patrol_tpu.ops.hierquota import QuotaRequest, quota_take_batch
+from patrol_tpu.ops import wire
+
+SLOT = 0
+REMOTE = 1
+
+
+def _state(buckets: int = 32, nodes: int = 4) -> LimiterState:
+    return init_state(LimiterConfig(buckets=buckets, nodes=nodes))
+
+
+def _i64(*vals) -> jnp.ndarray:
+    return jnp.asarray(vals, jnp.int64)
+
+
+def _i32(*vals) -> jnp.ndarray:
+    return jnp.asarray(vals, jnp.int32)
+
+
+def _gcra_req(rows, now, t=100, tol=300, nreq=10) -> GcraRequest:
+    k = len(rows)
+    return GcraRequest(
+        rows=_i32(*rows),
+        now_ns=_i64(*([now] * k)),
+        emission_ns=_i64(*([t] * k)),
+        tol_ns=_i64(*([tol] * k)),
+        nreq=_i64(*([nreq] * k)),
+    )
+
+
+class TestGcra:
+    def test_burst_equals_window_capacity(self):
+        """T=100, tol=300: the burst is 1 + tol//T = 4; the own lane
+        lands exactly at base + k*T and Retry-After points past it."""
+        st, res = gcra_take_batch(_state(), _gcra_req([3], now=0), SLOT)
+        assert int(res.admitted[0]) == 4
+        assert int(res.own_tat_ns[0]) == 400
+        assert int(res.tat_ns[0]) == 400
+        assert int(res.allow_at_ns[0]) == 100
+        assert int(st.pn[3, SLOT, TAKEN]) == 400
+
+    def test_sequential_replay_equivalence(self):
+        """The coalesced closed form is the greedy per-request loop."""
+
+        def replay(tat, now, t, tol, nreq):
+            k = 0
+            for _ in range(nreq):
+                if tat <= now + tol:
+                    tat = max(tat, now) + t
+                    k += 1
+            return k, tat
+
+        st = _state()
+        tat = 0
+        for now in (0, 150, 151, 700, 700, 4000):
+            want_k, tat = replay(tat, now, 100, 300, 3)
+            st, res = gcra_take_batch(
+                st, _gcra_req([5], now=now, nreq=3), SLOT
+            )
+            assert int(res.admitted[0]) == want_k, now
+            assert int(st.pn[5, SLOT, TAKEN]) == tat, now
+
+    def test_remote_watermark_denies(self):
+        """Global view: a merged remote TAT past the window refuses the
+        request and the own lane is untouched."""
+        st0 = _state()
+        st0 = LimiterState(
+            pn=st0.pn.at[3, REMOTE, TAKEN].set(1000), elapsed=st0.elapsed
+        )
+        st, res = gcra_take_batch(st0, _gcra_req([3], now=0), SLOT)
+        assert int(res.admitted[0]) == 0
+        assert int(res.tat_ns[0]) == 1000
+        assert int(st.pn[3, SLOT, TAKEN]) == 0
+
+    def test_padding_rows_commit_nothing(self):
+        st0 = _state()
+        req = _gcra_req([3, 3], now=0, nreq=0)  # duplicate rows, nreq=0
+        st, res = gcra_take_batch(st0, req, SLOT)
+        assert res.admitted.tolist() == [0, 0]
+        np.testing.assert_array_equal(np.asarray(st.pn), np.asarray(st0.pn))
+
+    def test_nonpositive_emission_admits_nothing(self):
+        st0 = _state()
+        req = GcraRequest(
+            rows=_i32(1),
+            now_ns=_i64(0),
+            emission_ns=_i64(0),
+            tol_ns=_i64(300),
+            nreq=_i64(5),
+        )
+        st, res = gcra_take_batch(st0, req, SLOT)
+        assert int(res.admitted[0]) == 0
+        np.testing.assert_array_equal(np.asarray(st.pn), np.asarray(st0.pn))
+
+    def test_commit_is_monotone(self):
+        """Every commit only grows lanes — the scatter is a max, so the
+        post state joins the pre state to itself (G-register law)."""
+        st0 = _state()
+        st0 = LimiterState(
+            pn=st0.pn.at[7, SLOT, TAKEN].set(250), elapsed=st0.elapsed
+        )
+        st, _ = gcra_take_batch(st0, _gcra_req([7], now=500), SLOT)
+        assert np.all(np.asarray(st.pn) >= np.asarray(st0.pn))
+
+
+def _conc_req(rows, limit=5, count=1, nreq=0, releases=0) -> ConcRequest:
+    k = len(rows)
+    return ConcRequest(
+        rows=_i32(*rows),
+        limit_nt=_i64(*([limit] * k)),
+        count_nt=_i64(*([count] * k)),
+        nreq=_i64(*([nreq] * k)),
+        releases=_i64(*([releases] * k)),
+    )
+
+
+class TestConcurrency:
+    def test_acquires_saturate_at_the_limit(self):
+        st, res = conc_acquire_batch(_state(), _conc_req([2], nreq=8), SLOT)
+        assert int(res.admitted[0]) == 5
+        assert int(res.inflight_nt[0]) == 5
+        assert int(st.pn[2, SLOT, TAKEN]) == 5
+        assert int(st.pn[2, SLOT, ADDED]) == 0
+
+    def test_release_applies_before_acquire(self):
+        st, _ = conc_acquire_batch(_state(), _conc_req([2], nreq=8), SLOT)
+        st, res = conc_acquire_batch(
+            st, _conc_req([2], nreq=4, releases=2), SLOT
+        )
+        assert int(res.released_nt[0]) == 2
+        assert int(res.admitted[0]) == 2
+        assert int(res.inflight_nt[0]) == 5
+        assert int(res.clamped_nt[0]) == 0
+
+    def test_phantom_release_is_clamped(self):
+        """Releasing what was never acquired must not invent capacity:
+        the own ADDED lane stays put and the refusal is reported."""
+        st0 = _state()
+        st, res = conc_acquire_batch(st0, _conc_req([2], releases=3), SLOT)
+        assert int(res.released_nt[0]) == 0
+        assert int(res.clamped_nt[0]) == 3
+        np.testing.assert_array_equal(np.asarray(st.pn), np.asarray(st0.pn))
+
+    def test_remote_holds_count_against_the_limit(self):
+        st0 = _state()
+        st0 = LimiterState(
+            pn=st0.pn.at[2, REMOTE, TAKEN].set(4), elapsed=st0.elapsed
+        )
+        st, res = conc_acquire_batch(st0, _conc_req([2], nreq=8), SLOT)
+        assert int(res.admitted[0]) == 1
+        assert int(res.inflight_nt[0]) == 5
+
+    def test_remote_holds_are_not_ours_to_release(self):
+        st0 = _state()
+        st0 = LimiterState(
+            pn=st0.pn.at[2, REMOTE, TAKEN].set(4), elapsed=st0.elapsed
+        )
+        _, res = conc_acquire_batch(st0, _conc_req([2], releases=2), SLOT)
+        assert int(res.released_nt[0]) == 0
+        assert int(res.clamped_nt[0]) == 2
+
+    def test_own_lane_pair_invariant_survives_every_tick(self):
+        """ADDED <= TAKEN on the own lane after any sequence — the
+        per-lane invariant the clamp exists to maintain."""
+        st = _state()
+        for nreq, rel in ((3, 0), (0, 5), (2, 1), (0, 9), (4, 4)):
+            st, _ = conc_acquire_batch(
+                st, _conc_req([9], nreq=nreq, releases=rel), SLOT
+            )
+            own = np.asarray(st.pn[9, SLOT])
+            assert own[ADDED] <= own[TAKEN]
+
+
+def _quota_req(
+    g, t, u, limits=(10, 6, 4), count=1, nreq=5
+) -> QuotaRequest:
+    k = len(u)
+    return QuotaRequest(
+        rows_global=_i32(*g),
+        rows_tenant=_i32(*t),
+        rows_user=_i32(*u),
+        limit_global_nt=_i64(*([limits[0]] * k)),
+        limit_tenant_nt=_i64(*([limits[1]] * k)),
+        limit_user_nt=_i64(*([limits[2]] * k)),
+        count_nt=_i64(*([count] * k)),
+        nreq=_i64(*([nreq] * k)),
+    )
+
+
+class TestHierQuota:
+    def test_leaf_binds_the_path(self):
+        st, res = quota_take_batch(
+            _state(), _quota_req([0], [1], [2]), SLOT
+        )
+        assert int(res.admitted[0]) == 4
+        assert int(res.headroom_user_nt[0]) == 0
+        assert int(res.headroom_tenant_nt[0]) == 2
+        assert int(res.headroom_global_nt[0]) == 6
+
+    def test_ancestor_binds_the_path(self):
+        _, res = quota_take_batch(
+            _state(), _quota_req([0], [1], [2], limits=(2, 6, 8)), SLOT
+        )
+        assert int(res.admitted[0]) == 2
+
+    def test_debit_is_all_or_nothing_across_levels(self):
+        st, res = quota_take_batch(
+            _state(), _quota_req([0], [1], [2]), SLOT
+        )
+        d = int(res.admitted[0])
+        for row in (0, 1, 2):
+            assert int(st.pn[row, SLOT, TAKEN]) == d
+
+    def test_exhausted_leaf_starves_the_path(self):
+        st, _ = quota_take_batch(_state(), _quota_req([0], [1], [2]), SLOT)
+        _, res = quota_take_batch(st, _quota_req([0], [1], [2]), SLOT)
+        assert int(res.admitted[0]) == 0
+
+    def test_shared_ancestor_rows_accumulate(self):
+        """Two paths under one global row in one batch: the packed
+        scatter-add accumulates both debits on the shared row."""
+        st, res = quota_take_batch(
+            _state(), _quota_req([0, 0], [1, 3], [2, 4]), SLOT
+        )
+        total = int(res.admitted[0]) + int(res.admitted[1])
+        assert res.admitted.tolist() == [4, 4]
+        assert int(st.pn[0, SLOT, TAKEN]) == total
+
+    def test_padding_rows_commit_nothing(self):
+        st0 = _state()
+        st, res = quota_take_batch(
+            st0, _quota_req([0], [1], [2], nreq=0), SLOT
+        )
+        assert int(res.admitted[0]) == 0
+        np.testing.assert_array_equal(np.asarray(st.pn), np.asarray(st0.pn))
+
+
+class TestCertTrailers:
+    def test_gcra_roundtrip(self):
+        t = wire.GcraTrailer(own_slot=7, tat_ns=123456789)
+        assert wire.decode_gcra_trailer(wire.encode_gcra_trailer(t)) == t
+
+    def test_conc_roundtrip(self):
+        t = wire.ConcTrailer(own_slot=3, acquired_nt=50, released_nt=20)
+        assert wire.decode_conc_trailer(wire.encode_conc_trailer(t)) == t
+
+    def test_quota_roundtrip(self):
+        t = wire.QuotaTrailer(
+            own_slot=1, taken_global_nt=9, taken_tenant_nt=6, taken_user_nt=4
+        )
+        assert wire.decode_quota_trailer(wire.encode_quota_trailer(t)) == t
+
+    def test_truncation_and_corruption_reject_whole_frame(self):
+        data = wire.encode_gcra_trailer(
+            wire.GcraTrailer(own_slot=0, tat_ns=42)
+        )
+        assert wire.decode_gcra_trailer(data[:-1]) is None
+        flipped = bytes([data[0] ^ 0xFF]) + data[1:]
+        assert wire.decode_gcra_trailer(flipped) is None
+
+    def test_kind_confusion_rejected(self):
+        gcra = wire.encode_gcra_trailer(wire.GcraTrailer(0, 42))
+        assert wire.decode_conc_trailer(gcra) is None
+        assert wire.decode_quota_trailer(gcra) is None
+
+    def test_conc_released_above_acquired_rejected(self):
+        bad = wire.encode_conc_trailer(
+            wire.ConcTrailer(own_slot=0, acquired_nt=1, released_nt=5)
+        )
+        assert wire.decode_conc_trailer(bad) is None
+
+    def test_negative_watermarks_clamp_to_zero(self):
+        t = wire.GcraTrailer(own_slot=0, tat_ns=-5)
+        out = wire.decode_gcra_trailer(wire.encode_gcra_trailer(t))
+        assert out == wire.GcraTrailer(own_slot=0, tat_ns=0)

@@ -1,0 +1,126 @@
+"""The ``PATROL_*`` knob registry (utils/config.py) and its contracts:
+the README knob table is byte-identical to the generated one, the typed
+accessors honor the registry defaults and the repo's malformed-value /
+flag idioms, and unregistered names are a hard error at the seam."""
+
+import os
+
+import pytest
+
+from patrol_tpu.utils import config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BEGIN = "<!-- knob-table:begin"
+END = "<!-- knob-table:end -->"
+
+
+class TestReadmeTable:
+    def test_readme_block_is_byte_identical_to_the_registry(self):
+        with open(os.path.join(REPO, "README.md"), encoding="utf-8") as fh:
+            readme = fh.read()
+        assert BEGIN in readme and END in readme, (
+            "README.md lost its knob-table markers"
+        )
+        block = readme.split(BEGIN, 1)[1].split(END, 1)[0]
+        # Strip the marker's own trailing "-->" line and surrounding
+        # blank lines; what remains must be exactly the generated table.
+        body = block.split("-->", 1)[1].strip()
+        assert body == config.render_knob_table(), (
+            "README knob table drifted from utils/config.py — regenerate "
+            'with python -c "from patrol_tpu.utils.config import '
+            'render_knob_table; print(render_knob_table())"'
+        )
+
+    def test_rendered_table_has_one_row_per_knob(self):
+        rows = config.render_knob_table().splitlines()
+        assert len(rows) == 2 + len(config.KNOBS)
+        for knob in config.KNOBS.values():
+            assert any(f"`{knob.name}`" in r for r in rows)
+
+
+class TestRegistryHygiene:
+    def test_every_knob_is_namespaced_and_documented(self):
+        assert config.KNOBS, "empty registry"
+        for knob in config.KNOBS.values():
+            assert knob.name.startswith("PATROL_"), knob.name
+            assert knob.doc.strip(), f"{knob.name} has no operator doc"
+
+    def test_declaration_order_has_no_duplicates(self):
+        names = [k.name for k in config._DECLARED]
+        assert len(names) == len(set(names))
+
+
+class TestTypedAccessors:
+    def test_env_int_falls_back_to_registry_default(self, monkeypatch):
+        monkeypatch.delenv("PATROL_MAX_MERGE_ROWS", raising=False)
+        assert config.env_int("PATROL_MAX_MERGE_ROWS") == 8192
+
+    def test_env_int_reads_the_environment(self, monkeypatch):
+        monkeypatch.setenv("PATROL_MAX_MERGE_ROWS", "1024")
+        assert config.env_int("PATROL_MAX_MERGE_ROWS") == 1024
+
+    def test_env_int_malformed_value_falls_back(self, monkeypatch):
+        monkeypatch.setenv("PATROL_MAX_MERGE_ROWS", "not-an-int")
+        assert config.env_int("PATROL_MAX_MERGE_ROWS") == 8192
+        assert config.env_int("PATROL_MAX_MERGE_ROWS", 7) == 7
+
+    def test_env_float_malformed_value_falls_back(self, monkeypatch):
+        monkeypatch.setenv("PATROL_COMMIT_BUDGET_MS", "fifty")
+        assert config.env_float("PATROL_COMMIT_BUDGET_MS") == 50.0
+
+    def test_env_str_caller_default_beats_registry_default(
+        self, monkeypatch
+    ):
+        monkeypatch.delenv("PATROL_COMMIT_BLOCKS", raising=False)
+        assert config.env_str("PATROL_COMMIT_BLOCKS") == "auto"
+        assert config.env_str("PATROL_COMMIT_BLOCKS", "4") == "4"
+
+    def test_env_flag_is_set_and_not_zero(self, monkeypatch):
+        monkeypatch.setenv("PATROL_DEVICE_TIMING", "0")
+        assert config.env_flag("PATROL_DEVICE_TIMING") is False
+        monkeypatch.setenv("PATROL_DEVICE_TIMING", "yes")
+        assert config.env_flag("PATROL_DEVICE_TIMING") is True
+        monkeypatch.delenv("PATROL_DEVICE_TIMING", raising=False)
+        assert config.env_flag("PATROL_DEVICE_TIMING") is True  # default 1
+
+    def test_unregistered_name_is_a_hard_error(self, monkeypatch):
+        monkeypatch.setenv("PATROL_NOT_A_KNOB", "1")
+        for fn in (
+            config.env_str,
+            config.env_int,
+            config.env_float,
+            config.env_flag,
+        ):
+            with pytest.raises(KeyError):
+                fn("PATROL_NOT_A_KNOB")
+
+
+class TestNoDeadKnobs:
+    def test_every_registered_knob_is_read_somewhere(self):
+        """A knob declared but never read anywhere outside the registry
+        is doc rot — PTL007 catches the inverse (reads of undeclared
+        names); this closes the loop."""
+        corpus = []
+        for root, dirs, files in os.walk(REPO):
+            dirs[:] = [
+                d
+                for d in dirs
+                if d not in (".git", "__pycache__", "benchmarks")
+            ]
+            for fname in files:
+                if fname.endswith((".py", ".sh", ".cc", ".h")):
+                    path = os.path.join(root, fname)
+                    try:
+                        with open(path, encoding="utf-8") as fh:
+                            corpus.append(fh.read())
+                    except OSError:
+                        pass
+        text = "\n".join(corpus)
+        dead = [
+            name
+            for name in config.KNOBS
+            # registry declaration + at least one other mention
+            if text.count(name) < 2
+        ]
+        assert not dead, f"registered but never read: {dead}"

@@ -403,6 +403,9 @@ class TestRepoGate:
             "ops.take.take_batch",
             "ops.delta.delta_fold",
             "ops.lifecycle.lifecycle_probe",
+            "ops.gcra.gcra_take_batch",
+            "ops.concurrency.conc_acquire_batch",
+            "ops.hierquota.quota_take_batch",
         }
 
     def test_shared_enumerator_is_stage6s(self):

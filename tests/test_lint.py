@@ -620,3 +620,67 @@ class TestRepoIsClean:
         assert ("patrol_tpu/ops/take.py", "take_batch") in roots
         assert ("patrol_tpu/ops/merge.py", "merge_batch") in roots
         assert ("patrol_tpu/ops/merge.py", "merge_dense") in roots
+
+
+class TestEnvRegistry:
+    """PTL007 — PATROL_* environment reads against utils/config.py."""
+
+    def test_fires_on_undeclared_literal_knob(self):
+        src = "import os\n\ndef f():\n    return os.getenv('PATROL_NOT_A_KNOB')\n"
+        f = lint.lint_sources({"patrol_tpu/x.py": src})
+        assert codes(f) == ["PTL007"]
+        assert "undeclared knob" in f[0].message
+
+    def test_fires_on_undeclared_environ_get(self):
+        src = (
+            "import os\n\ndef f():\n"
+            "    return os.environ.get('PATROL_MYSTERY', '1')\n"
+        )
+        assert codes(lint.lint_sources({"patrol_tpu/x.py": src})) == ["PTL007"]
+
+    def test_fires_on_undeclared_subscript_read(self):
+        src = "import os\n\ndef f():\n    return os.environ['PATROL_MYSTERY']\n"
+        assert codes(lint.lint_sources({"patrol_tpu/x.py": src})) == ["PTL007"]
+
+    def test_silent_on_declared_knob(self):
+        src = (
+            "import os\n\ndef f():\n"
+            "    return os.environ.get('PATROL_MAX_MERGE_ROWS', '8192')\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def test_silent_on_non_patrol_names(self):
+        src = "import os\n\ndef f():\n    return os.getenv('HOME')\n"
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def test_fires_on_computed_name(self):
+        src = "import os\n\ndef f(name):\n    return os.getenv(name)\n"
+        f = lint.lint_sources({"patrol_tpu/x.py": src})
+        assert codes(f) == ["PTL007"]
+        assert "computed environment name" in f[0].message
+
+    def test_computed_name_allowed_in_the_config_seam(self):
+        src = "import os\n\ndef _raw(name):\n    return os.environ.get(name)\n"
+        assert lint.lint_sources({"patrol_tpu/utils/config.py": src}) == []
+
+    def test_inline_disable_suppresses(self):
+        src = (
+            "import os\n\ndef f():\n"
+            "    return os.getenv('PATROL_ODDBALL')"
+            "  # patrol-lint: disable=PTL007\n"
+        )
+        assert lint.lint_sources({"patrol_tpu/x.py": src}) == []
+
+    def test_aliased_environ_import_is_tracked(self):
+        src = (
+            "from os import environ as env\n\ndef f():\n"
+            "    return env['PATROL_MYSTERY']\n"
+        )
+        assert codes(lint.lint_sources({"patrol_tpu/x.py": src})) == ["PTL007"]
+
+    def test_registry_is_loaded_for_real(self):
+        """Guard against a vacuously-silent PTL007: the knob loader must
+        see the real registry, not an empty degraded set."""
+        names = lint.known_knob_names()
+        assert "PATROL_MAX_MERGE_ROWS" in names
+        assert len(names) >= 30

@@ -342,3 +342,137 @@ class TestScheduleEnumerator:
         terms = list(P.enumerate_schedules(P.CLEAN, bounds, factory))
         assert made == [(bounds.n_nodes, bounds.limit)]
         assert terms and all(isinstance(t.cluster, Tagged) for t in terms)
+
+
+class TestExtendedAlphabet:
+    """enumerate_schedules with a family's OWN move alphabet (the
+    ``extras`` budget → Cluster.extra_moves): trails that contain
+    family moves still replay bit-exactly, the memoizer keys on the
+    extra state (so advance/release-differing prefixes are not
+    collapsed), and the depth cap marks extra-heavy schedules instead
+    of silently dropping them."""
+
+    def _replay_with(self, factory, events, sem, bounds):
+        c = factory(bounds.n_nodes, bounds.limit, sem)
+        for mv in events:
+            if mv[0] == "take":
+                c.take(mv[1])
+            elif mv[0] == "refill":
+                c.refill(mv[1])
+            elif mv[0] == "gc":
+                c.gc(mv[1])
+            elif mv[0] == "partition":
+                c.set_partition(dict(mv[1]))
+            elif mv[0] == "heal":
+                c.set_partition(None)
+            elif mv[0] == "flush":
+                c.flush(mv[1])
+            elif mv[0] == "deliver":
+                c.deliver(mv[1], mv[2], mv[3])
+            elif mv[0] == "dup":
+                c.deliver(mv[1], mv[2], mv[3], dup=True)
+            elif mv[0] == "drop":
+                c.drop(mv[1], mv[2], mv[3])
+            else:  # a family-specific move rides the same replay path
+                c.apply_extra(mv)
+        return c
+
+    def test_gcra_advance_trails_replay_to_their_state(self):
+        bounds = P.ScheduleBounds(takes=2, disruptions=1, extras=2)
+        factory = lambda n, l, s: P.GcraCluster(n, l, s)  # noqa: E731
+        terms = list(P.enumerate_schedules(P.CLEAN, bounds, factory))
+        assert terms
+        with_advance = 0
+        for term in terms:
+            assert term.violation is None, term.events
+            if any(mv[0] == "advance" for mv in term.events):
+                with_advance += 1
+            replayed = self._replay_with(
+                factory, term.events, P.CLEAN, bounds
+            )
+            assert replayed.memo_key() == term.cluster.memo_key(), (
+                term.events
+            )
+        assert with_advance > 0, "extras budget never spent"
+
+    def test_conc_release_trails_replay_to_their_state(self):
+        bounds = P.ScheduleBounds(takes=2, disruptions=1, extras=2)
+        factory = lambda n, l, s: P.ConcCluster(n, l, s)  # noqa: E731
+        terms = list(P.enumerate_schedules(P.CLEAN, bounds, factory))
+        assert any(
+            mv[0] == "release" for t in terms for mv in t.events
+        ), "extras budget never spent"
+        for term in terms:
+            assert term.violation is None, term.events
+            replayed = self._replay_with(
+                factory, term.events, P.CLEAN, bounds
+            )
+            assert replayed.memo_key() == term.cluster.memo_key(), (
+                term.events
+            )
+
+    def test_memoizer_keys_on_the_extra_state(self):
+        """Two prefixes identical except for a family move must not be
+        memo-collapsed — the extra state is part of memo_key."""
+        g = P.GcraCluster(2, 2, P.CLEAN)
+        before = g.memo_key()
+        g.apply_extra(("advance",))
+        assert g.memo_key() != before
+
+        c = P.ConcCluster(2, 2, P.CLEAN)
+        c.take(0)
+        held = c.memo_key()
+        c.apply_extra(("release", 0))
+        assert c.memo_key() != held
+        # Clamped no-op release (nothing of ours held): key unchanged.
+        c2 = P.ConcCluster(2, 2, P.CLEAN)
+        idle = c2.memo_key()
+        c2.apply_extra(("release", 0))
+        assert c2.memo_key() == idle
+
+    def test_memoization_preserves_advance_distinct_terminals(self):
+        """The enumeration must reach terminals at EVERY advance count
+        the budget allows — a memoizer that ignored the clock would
+        fold them together."""
+        bounds = P.ScheduleBounds(takes=3, disruptions=0, extras=2)
+        factory = lambda n, l, s: P.GcraCluster(n, l, s)  # noqa: E731
+        terms = list(P.enumerate_schedules(P.CLEAN, bounds, factory))
+        assert {t.cluster.advances for t in terms} == {0, 1, 2}
+
+    def test_advance_extends_the_admission_frontier(self):
+        """Clock advance admits conforming requests past the burst.
+        On a single node (schedules whose takes all land on node 0 —
+        cross-node schedules may legitimately overshoot while async):
+        zero advances admit at most the burst (= limit); at least one
+        advance schedule exceeds it."""
+        bounds = P.ScheduleBounds(takes=3, disruptions=0, extras=2)
+        factory = lambda n, l, s: P.GcraCluster(n, l, s)  # noqa: E731
+        over_burst = 0
+        for term in P.enumerate_schedules(P.CLEAN, bounds, factory):
+            if any(
+                mv[0] == "take" and mv[1] != 0 for mv in term.events
+            ):
+                continue
+            admitted = term.cluster.nodes[0].admitted
+            if term.cluster.advances == 0:
+                assert admitted <= bounds.limit, term.events
+            if admitted > bounds.limit:
+                assert term.cluster.advances > 0, term.events
+                over_burst += 1
+        assert over_burst > 0
+
+    def test_extra_budget_is_a_hard_bound(self):
+        bounds = P.ScheduleBounds(takes=1, disruptions=0, extras=2)
+        factory = lambda n, l, s: P.GcraCluster(n, l, s)  # noqa: E731
+        for term in P.enumerate_schedules(P.CLEAN, bounds, factory):
+            n_adv = sum(1 for mv in term.events if mv[0] == "advance")
+            assert n_adv <= bounds.extras
+            assert term.cluster.advances == n_adv
+
+    def test_depth_cap_marks_extra_heavy_trails(self):
+        bounds = P.ScheduleBounds(takes=1, disruptions=0, extras=2, depth=1)
+        factory = lambda n, l, s: P.GcraCluster(n, l, s)  # noqa: E731
+        terms = list(P.enumerate_schedules(P.CLEAN, bounds, factory))
+        assert terms
+        assert all(t.depth_capped for t in terms)
+        assert all(len(t.events) <= 1 for t in terms)
