@@ -1679,6 +1679,25 @@ def smoke_main() -> int:
         OUT["trace_off_branch_ns"] = round(off_ns, 1)
         assert off_ns < 1_000, f"disabled-recorder branch cost {off_ns} ns"
 
+        # (5) compile-cache stability (patrol-dispatch, check.sh stage
+        # 10): warm every registered engine hot path, then re-drive each
+        # at identical shapes under the jax compile counter + the
+        # device-to-host transfer guard. retraces_after_warmup is
+        # EXACT-gated at 0 by scripts/bench_gate.py and CI — one stray
+        # python-size call site shows up here the day it is written.
+        from patrol_tpu.analysis import dispatch as dispatch_mod
+
+        witness = dispatch_mod.run_witness()
+        assert not witness.findings, (
+            f"dispatch witness findings: {[str(f) for f in witness.findings]}"
+        )
+        OUT["retraces_after_warmup"] = witness.retraces_after_warmup
+        OUT["jit_cache_entries"] = witness.jit_cache_entries
+        OUT["dispatch_witness_paths"] = len(witness.paths)
+        assert witness.retraces_after_warmup == 0, (
+            f"post-warmup retraces: {witness.compiles}"
+        )
+
         OUT["ingest_commit_smoke_seconds"] = round(time.time() - t0, 2)
         OUT["stages_completed"] = 1
         OUT["stages"] = ["commit-smoke"]

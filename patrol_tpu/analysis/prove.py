@@ -2059,13 +2059,15 @@ ENGINE_DISPATCH_FILES: Tuple[str, ...] = (
 _KERNEL_PKG = "patrol_tpu.ops."
 
 
-def registration_findings(
+def collect_dispatched_kernels(
     sources: Dict[str, str],
-    registered: Optional[Set[Tuple[str, str]]] = None,
     engine_files: Sequence[str] = ENGINE_DISPATCH_FILES,
-) -> List[Finding]:
-    """PTP006: sweep the engine files for jit-dispatched kernels and flag
-    any (module, func) in neither PROVE_ROOTS nor PROVE_EXEMPT.
+) -> List[Tuple[str, int, str, str]]:
+    """Sweep the engine files for jit-dispatched ops kernels; return
+    ``(relpath, line, module, func)`` rows, one per (file, kernel), at
+    the kernel's first dispatch line. Shared recognizer: PTP006 checks
+    the rows against PROVE_ROOTS/PROVE_EXEMPT, and stage 10's PTD005
+    (analysis/dispatch.py) checks them against DISPATCH_SPECS.
 
     Two dispatch idioms are recognized, matching the engines' shapes:
 
@@ -2083,13 +2085,6 @@ def registration_findings(
     that are module-level ``def``\\ s in the target ops module count (a
     target module absent from ``sources`` keeps its candidates — an
     unresolvable dispatch must not silently pass)."""
-    if registered is None:
-        from patrol_tpu.ops.obligations import PROVE_EXEMPT, PROVE_ROOTS
-
-        registered = {(r.module, r.attr) for r in PROVE_ROOTS} | set(
-            PROVE_EXEMPT
-        )
-
     defs_cache: Dict[str, Optional[Set[str]]] = {}
 
     def kernel_defs(module: str) -> Optional[Set[str]]:
@@ -2111,7 +2106,7 @@ def registration_findings(
                 defs_cache[module] = None
         return defs_cache[module]
 
-    out: List[Finding] = []
+    rows: List[Tuple[str, int, str, str]] = []
     for rel in engine_files:
         src = sources.get(rel)
         if src is None:
@@ -2224,19 +2219,41 @@ def registration_findings(
         for (module, name), line in sorted(
             candidates.items(), key=lambda kv: (kv[1], kv[0])
         ):
-            if (module, name) not in registered:
-                out.append(
-                    Finding(
-                        "PTP006",
-                        rel,
-                        line,
-                        f"jitted kernel {module}.{name} is dispatched here "
-                        "but registered in neither PROVE_ROOTS nor "
-                        "PROVE_EXEMPT — declare its obligations (or its "
-                        "exemption, with the reason) in "
-                        "patrol_tpu/ops/obligations.py",
-                    )
+            rows.append((rel, line, module, name))
+    return rows
+
+
+def registration_findings(
+    sources: Dict[str, str],
+    registered: Optional[Set[Tuple[str, str]]] = None,
+    engine_files: Sequence[str] = ENGINE_DISPATCH_FILES,
+) -> List[Finding]:
+    """PTP006: sweep the engine files for jit-dispatched kernels
+    (:func:`collect_dispatched_kernels`) and flag any (module, func) in
+    neither PROVE_ROOTS nor PROVE_EXEMPT."""
+    if registered is None:
+        from patrol_tpu.ops.obligations import PROVE_EXEMPT, PROVE_ROOTS
+
+        registered = {(r.module, r.attr) for r in PROVE_ROOTS} | set(
+            PROVE_EXEMPT
+        )
+    out: List[Finding] = []
+    for rel, line, module, name in collect_dispatched_kernels(
+        sources, engine_files
+    ):
+        if (module, name) not in registered:
+            out.append(
+                Finding(
+                    "PTP006",
+                    rel,
+                    line,
+                    f"jitted kernel {module}.{name} is dispatched here "
+                    "but registered in neither PROVE_ROOTS nor "
+                    "PROVE_EXEMPT — declare its obligations (or its "
+                    "exemption, with the reason) in "
+                    "patrol_tpu/ops/obligations.py",
                 )
+            )
     return sorted(out, key=lambda f: (f.path, f.line, f.check))
 
 

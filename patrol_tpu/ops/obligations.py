@@ -1137,3 +1137,145 @@ PROVE_EXEMPT: frozenset = frozenset(
         ("patrol_tpu.ops.merge", "zero_rows"),
     }
 )
+
+
+# ---------------------------------------------------------------------------
+# Dispatch-discipline registry (check.sh stage 10, patrol-dispatch).
+# Every kernel the runtime engines push through jax.jit declares HERE the
+# dispatch contract stage 10 proves: which buffers are donated, which
+# argnames are static, what shape-bucket law its call sites must pad to
+# (StagingPool's power-of-two buckets, machine-readable at last), and
+# which witness path re-drives it post-warmup under the compile counter
+# and transfer guard (analysis/dispatch.py::WITNESS_PATHS). A kernel
+# with no witness carries a written justification instead — PTD005
+# rejects both a dispatched kernel missing from this registry and a
+# registered kernel with neither witness nor justification.
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchSpec:
+    """One jit-dispatched kernel's dispatch-discipline contract.
+
+    ``buckets`` names the shape-bucket law of the kernel's call sites:
+
+    * ``"pow2"`` — batches are padded through ``engine._pad_size`` with
+      the declared ``(bucket_lo, bucket_hi)`` clamp; PTD001 requires a
+      textually matching ``_pad_size`` site in the engine files (lo/hi
+      compared by ``ast.unparse``, defaults ``"8"`` /
+      ``"MAX_MERGE_ROWS"``), so silently dropping the padding — or
+      drifting the clamp away from the declared ceiling — is a finding.
+    * ``"fixed"`` — every dispatch ships one pinned shape
+      (``bucket_hi`` names the constant: the commit ring's
+      ``MAX_MERGE_ROWS`` block width, the rx ring's plane geometry).
+    * ``"caller"`` — shapes are the caller's contract (the cert-kit
+      microbatches: bench/tests drive fixed shapes); the witness still
+      pins post-warmup stability for the shapes it drives.
+
+    ``witness`` names the ``analysis/dispatch.py::WITNESS_PATHS`` entry
+    that warms and re-drives this kernel (PTD004); ``witness_absent``
+    is the REQUIRED justification when no witness path can reach it.
+    """
+
+    name: str
+    module: str  # owning ops module, e.g. "patrol_tpu.ops.take"
+    attr: str  # kernel function name in that module
+    donate_argnums: Tuple[int, ...] = (0,)
+    static_argnames: Tuple[str, ...] = ()
+    buckets: str = "pow2"  # "pow2" | "fixed" | "caller"
+    bucket_lo: str = "8"
+    bucket_hi: str = "MAX_MERGE_ROWS"
+    witness: str = ""
+    witness_absent: str = ""
+    note: str = ""
+
+
+DISPATCH_SPECS: Tuple[DispatchSpec, ...] = (
+    DispatchSpec(
+        "take_batch", "patrol_tpu.ops.take", "take_batch",
+        static_argnames=("node_slot",),
+        bucket_hi="MAX_TAKE_ROWS", witness="take",
+        note="packed [8,K] request / [7,K] result; feeder tick path",
+    ),
+    DispatchSpec(
+        "merge_batch", "patrol_tpu.ops.merge", "merge_batch",
+        witness="merge_packed",
+        note="packed [5,K] scatter-max join; promotion + CPU commit path",
+    ),
+    DispatchSpec(
+        "merge_batch_folded", "patrol_tpu.ops.merge", "merge_batch_folded",
+        witness="merge_folded",
+        note="unique/sorted-asserted fold; accelerator tick commit",
+    ),
+    DispatchSpec(
+        "commit_blocks", "patrol_tpu.ops.commit", "commit_blocks",
+        buckets="fixed", witness="commit_blocks",
+        note="[6,J,MAX_MERGE_ROWS] coalesced block ring, J a pow2 "
+        "block count warmed per variant",
+    ),
+    DispatchSpec(
+        "merge_rows_dense", "patrol_tpu.ops.merge", "merge_rows_dense",
+        bucket_hi="MAX_ROW_DENSE", witness="merge_rows_dense",
+        note="row-window dense half of the fold-to-dense hybrid",
+    ),
+    DispatchSpec(
+        "merge_scalar_batch", "patrol_tpu.ops.merge", "merge_scalar_batch",
+        witness="merge_scalar",
+        note="deficit-attribution interop merge",
+    ),
+    DispatchSpec(
+        "zero_rows", "patrol_tpu.ops.merge", "zero_rows",
+        bucket_hi="1 << 20", witness="zero_rows",
+        note="lifecycle reclaim / checkpoint-restore scatter of bottom",
+    ),
+    DispatchSpec(
+        "lifecycle_probe", "patrol_tpu.ops.lifecycle", "lifecycle_probe",
+        donate_argnums=(), static_argnames=("node_slot",),
+        bucket_hi="1 << 20", witness="lifecycle_probe",
+        note="pure read (no donation): GC sweep idle/full probe",
+    ),
+    DispatchSpec(
+        "gcra_take_batch", "patrol_tpu.ops.gcra", "gcra_take_batch",
+        static_argnames=("node_slot",), buckets="caller", witness="gcra",
+    ),
+    DispatchSpec(
+        "conc_acquire_batch", "patrol_tpu.ops.concurrency",
+        "conc_acquire_batch",
+        static_argnames=("node_slot",), buckets="caller", witness="conc",
+    ),
+    DispatchSpec(
+        "quota_take_batch", "patrol_tpu.ops.hierquota", "quota_take_batch",
+        static_argnames=("node_slot",), buckets="caller", witness="quota",
+    ),
+    DispatchSpec(
+        "delta_fold", "patrol_tpu.ops.delta", "delta_fold",
+        witness="delta_fold",
+        note="interval-encoded replication deltas, host decode fold",
+    ),
+    DispatchSpec(
+        "decode_fold_raw", "patrol_tpu.ops.ingest", "decode_fold_raw",
+        buckets="fixed", bucket_hi="rx-ring planes",
+        witness="raw_ingest",
+        note="whole rx ring ships as-is: [P,row_w] planes + [P]/[P,E] "
+        "framing, geometry pinned by the ring allocation",
+    ),
+    DispatchSpec(
+        "read_rows", "patrol_tpu.ops.merge", "read_rows",
+        donate_argnums=(), bucket_lo="1", bucket_hi="1 << 20",
+        witness="read_rows",
+        note="eager (un-jitted) padded gather behind every "
+        "snapshot/introspection read; donation-free by construction",
+    ),
+    DispatchSpec(
+        "merge_batch_pallas", "patrol_tpu.ops.pallas_merge",
+        "merge_batch_pallas",
+        static_argnames=("interpret",), buckets="fixed",
+        witness_absent="accelerator-only Pallas scatter-max, lazily "
+        "imported behind PATROL_PALLAS and unreachable on the CPU "
+        "witness host; interpret-mode tracing is minutes-class. Covered "
+        "by tests/test_pallas_merge.py interpret-mode equivalence.",
+    ),
+)
+
+DISPATCH_KERNELS: frozenset = frozenset(
+    (s.module, s.attr) for s in DISPATCH_SPECS
+)

@@ -231,6 +231,18 @@ HOST_DEMOTE_WINDOW_NS = int(
     float(os.environ.get("PATROL_HOST_DEMOTE_WINDOW_MS", 200)) * 1e6
 )
 
+# Scrape mirror (patrol-dispatch stage 10, PTD003): the stats/debug
+# surfaces (snapshot/snapshot_many/tokens_if_known/row_view → /metrics,
+# /debug/vars, audit + anti-entropy fan-ins) used to pay one device
+# gather PER CALL. The mirror keeps a host copy of the low row window,
+# stamped with the (_ticks, _state_gen) epoch it reflects: while the
+# epoch is unchanged the mirror is EXACT (not merely fresh-ish), so a
+# steady-state scrape costs zero device transfers. Refreshes ride the
+# completion pipeline when scrapes are active; a stale scrape pays one
+# batched window gather instead of a targeted one.
+SCRAPE_MIRROR = os.environ.get("PATROL_SCRAPE_MIRROR", "1") != "0"
+SCRAPE_MIRROR_ROWS = int(os.environ.get("PATROL_SCRAPE_MIRROR_ROWS", 4096))
+
 # Bucket lifecycle (ROADMAP item 4): idle-bucket GC on the feeder tick.
 # A bound bucket whose reconstructed value equals its rate-derived refill
 # (the IsZero predicate, ops/lifecycle.py) is reclaimed from the device
@@ -1050,6 +1062,21 @@ class DeviceEngine:
         # shutdown never deadlocks behind a forgotten pause.
         self._tick_paused = False
         self._ticks = 0  # device calls issued (observability)
+        # Device-state mutations that do NOT ride a _ticks bump (row
+        # zeroing on evict/demote/reclaim, the gcra/conc/quota
+        # microbatches). (_ticks, _state_gen) together form the scrape
+        # epoch: any device-state change moves it, so an epoch-matched
+        # mirror read is exactly the gather it replaces.
+        self._state_gen = 0
+        # (epoch, pn[K,N,2], elapsed[K]) or None — swapped atomically as
+        # one tuple so readers never see torn pn/elapsed/epoch combos.
+        self._scrape_mirror: Optional[Tuple[Tuple[int, int], np.ndarray, np.ndarray]] = None
+        self._mirror_window = (
+            min(int(config.buckets), SCRAPE_MIRROR_ROWS)
+            if SCRAPE_MIRROR
+            else 0
+        )
+        self._mirror_want = False  # a scrape went stale; completer refreshes
         # Cross-node tracing: (trace_id, bucket) pairs drained into the
         # current tick; the feeder records their merge spans after _apply.
         self._tick_traced: List[Tuple[int, str]] = []
@@ -1113,6 +1140,7 @@ class DeviceEngine:
         rows[: victims.size] = victims
         with self._state_mu:
             self.state = zero_rows_jit(self.state, jnp.asarray(rows))
+            self._state_gen += 1
         self.directory.recycle(victims)
         self._evictions += int(victims.size)
         log.info("evicted %d idle buckets (pool pressure)", victims.size)
@@ -1410,10 +1438,13 @@ class DeviceEngine:
                 view = lifecycle_ops.lifecycle_probe_jit(
                     self.state, probe, self.node_slot
                 )
-            full[dev_idx] = np.asarray(view.full)[:m]
-            own_a[dev_idx] = np.asarray(view.own_added_nt)[:m]
-            own_t[dev_idx] = np.asarray(view.own_taken_nt)[:m]
-            el[dev_idx] = np.asarray(view.elapsed_ns)[:m]
+            # One batched, padded probe readback per GC sweep: the host
+            # must learn which rows are reclaimable — cadenced by the
+            # sweep interval, never per-request.
+            full[dev_idx] = np.asarray(view.full)[:m]  # patrol-lint: disable=PTD003
+            own_a[dev_idx] = np.asarray(view.own_added_nt)[:m]  # patrol-lint: disable=PTD003
+            own_t[dev_idx] = np.asarray(view.own_taken_nt)[:m]  # patrol-lint: disable=PTD003
+            el[dev_idx] = np.asarray(view.elapsed_ns)[:m]  # patrol-lint: disable=PTD003
         vict = np.flatnonzero(full)
         if not vict.size:
             return 0
@@ -1431,6 +1462,7 @@ class DeviceEngine:
             rows_z[: kept.size] = kept
             with self._state_mu:
                 self.state = zero_rows_jit(self.state, jnp.asarray(rows_z))
+                self._state_gen += 1
             if self.directory.recycle_compact(kept):
                 self._gc_compactions += 1
                 profiling.COUNTERS.inc("directory_compactions")
@@ -2026,6 +2058,7 @@ class DeviceEngine:
                 rows_arr[: len(demoted)] = demoted
                 with self._state_mu:
                     self.state = zero_rows_jit(self.state, jnp.asarray(rows_arr))
+                    self._state_gen += 1
                 self._demotions += len(demoted)
                 log.debug("demoted %d idle buckets to host residency", len(demoted))
 
@@ -2101,6 +2134,7 @@ class DeviceEngine:
             self.state, res = gcra_take_batch_jit(
                 self.state, req, self.node_slot
             )
+            self._state_gen += 1
         return res
 
     def conc_acquire(
@@ -2118,6 +2152,7 @@ class DeviceEngine:
             self.state, res = conc_acquire_batch_jit(
                 self.state, req, self.node_slot
             )
+            self._state_gen += 1
         return res
 
     def quota_take(
@@ -2146,6 +2181,7 @@ class DeviceEngine:
             self.state, res = quota_take_batch_jit(
                 self.state, req, self.node_slot
             )
+            self._state_gen += 1
         return res
 
     def snapshot_planes(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -2848,7 +2884,10 @@ class DeviceEngine:
                 released = True
 
                 def _commit_plane() -> None:
-                    jax.block_until_ready(planes_dev)
+                    # Plane-recycle gate ON the completion pipeline: the
+                    # rx buffer may not be reused before the kernel has
+                    # consumed it. Runs on the completer, not the rx path.
+                    jax.block_until_ready(planes_dev)  # patrol-lint: disable=PTD003
                     release()
 
                 self._enqueue_completion(_commit_plane, (), {})
@@ -2860,15 +2899,18 @@ class DeviceEngine:
                 # output (valid ∩ hosted) and decoded entry values: the
                 # readback joins them into the host lanes; entries whose
                 # row promoted mid-flight ride the feeder tick instead.
-                hm = np.asarray(hosted_mask)
+                # Kernel-verdict readback: one batched D2H per rx ring,
+                # only on the (rare) host-resident branch — the price of
+                # letting the kernel, not the host, decide residency.
+                hm = np.asarray(hosted_mask)  # patrol-lint: disable=PTD003
                 hpi, hei = np.nonzero(hm)
                 if hpi.size:
                     h_rows = rows_pe[hpi, hei].astype(np.int64)
-                    h_slots = np.asarray(d_slot)[hpi, hei]
-                    h_added = np.asarray(d_added)[hpi, hei]
-                    h_taken = np.asarray(d_taken)[hpi, hei]
+                    h_slots = np.asarray(d_slot)[hpi, hei]  # patrol-lint: disable=PTD003
+                    h_added = np.asarray(d_added)[hpi, hei]  # patrol-lint: disable=PTD003
+                    h_taken = np.asarray(d_taken)[hpi, hei]  # patrol-lint: disable=PTD003
                     h_elapsed = np.maximum(
-                        np.asarray(d_elapsed)[hpi, hei], 0
+                        np.asarray(d_elapsed)[hpi, hei], 0  # patrol-lint: disable=PTD003
                     )
                     keep_h = self._host_absorb_ingest(
                         h_rows, h_slots, h_added, h_taken, h_elapsed, None
@@ -3197,7 +3239,60 @@ class DeviceEngine:
         idx = jnp.asarray(padded)
         with self._state_mu:
             rs = read_rows(self.state, idx)
-            return np.asarray(rs.pn)[:n], np.asarray(rs.elapsed)[:n]
+            # THE sanctioned gather seam: one batched D2H per call. The
+            # scrape surfaces (snapshot/row_view/debug vars) answer from
+            # the epoch-validated host mirror and only land here on a
+            # mirror miss.
+            return (
+                np.asarray(rs.pn)[:n],  # patrol-lint: disable=PTD003
+                np.asarray(rs.elapsed)[:n],  # patrol-lint: disable=PTD003
+            )
+
+    def _scrape_epoch(self) -> Tuple[int, int]:
+        """The device-state version a mirror snapshot is stamped with.
+        Plain int reads (GIL-atomic): a bump landing mid-read only makes
+        the mirror LOOK stale — never lets stale data serve as fresh."""
+        return (self._ticks, self._state_gen)
+
+    def _refresh_scrape_mirror(self) -> None:
+        """One batched window gather re-stamping the scrape mirror. The
+        epoch is captured BEFORE the gather: a mutation racing the
+        gather leaves the mirror stamped older than its data, which only
+        costs an extra refresh — stamping after could mark pre-mutation
+        data as current."""
+        k = self._mirror_window
+        if k <= 0:
+            return
+        epoch = self._scrape_epoch()
+        pn, elapsed = self.read_rows(np.arange(k, dtype=np.int32))
+        self._scrape_mirror = (epoch, pn, elapsed)
+        profiling.COUNTERS.inc("scrape_mirror_refreshes")
+
+    def _scrape_rows(self, rows) -> Tuple[np.ndarray, np.ndarray]:
+        """(pn[len,N,2], elapsed[len]) for device rows on the STATS path
+        (snapshot/tokens//debug/vars): answered from the host mirror
+        whenever its epoch still matches — exact, zero device transfers
+        — else one window gather re-arms it. Rows beyond the mirror
+        window (or with the mirror disabled) fall back to a targeted
+        gather. The serve path never calls this; ticket completion reads
+        ride the completer's batched readback."""
+        rows = np.asarray(rows, dtype=np.int32)
+        if SCRAPE_MIRROR and rows.size and int(rows.max()) < self._mirror_window:
+            mir = self._scrape_mirror
+            if mir is None or mir[0] != self._scrape_epoch():
+                # Stale: flag interest so the completion pipeline keeps
+                # the mirror hot while load is flowing, and re-arm it
+                # here so an IDLE engine converges to zero-gather
+                # scrapes immediately.
+                self._mirror_want = True
+                self._refresh_scrape_mirror()
+                mir = self._scrape_mirror
+            if mir is not None:
+                profiling.COUNTERS.inc("scrape_mirror_hits")
+                epoch, pn, elapsed = mir
+                return pn[rows], elapsed[rows]
+        profiling.COUNTERS.inc("scrape_device_gathers")
+        return self.read_rows(rows)
 
     def _hosted_view(self, row: int):
         """(pn[N,2] copy, elapsed_ns) if the row is host-resident, else
@@ -3219,7 +3314,7 @@ class DeviceEngine:
         hv = self._hosted_view(row)
         if hv is not None:
             return hv
-        pn_rows, elapsed_rows = self.read_rows([row])
+        pn_rows, elapsed_rows = self._scrape_rows([row])
         return pn_rows[0], int(elapsed_rows[0])
 
     def snapshot(self, name: str) -> List[wire.WireState]:
@@ -3238,7 +3333,7 @@ class DeviceEngine:
                 return []
             pn, elapsed = hv
         else:
-            pn_rows, elapsed_rows = self.read_rows([row])
+            pn_rows, elapsed_rows = self._scrape_rows([row])
             if self.directory.lookup(name) != row:
                 return []  # evicted mid-read
             pn = pn_rows[0]  # [N, 2]
@@ -3292,6 +3387,7 @@ class DeviceEngine:
                 self.state = zero_rows_jit(
                     self.state, jnp.array([row], jnp.int32)
                 )
+                self._state_gen += 1
             self.directory.recycle([row])
         return True
 
@@ -3308,7 +3404,7 @@ class DeviceEngine:
         }
         device_rows = [r for _, r in known if r not in hosted_views]
         if device_rows:
-            pn_dev, el_dev = self.read_rows(device_rows)
+            pn_dev, el_dev = self._scrape_rows(device_rows)
             dev_at = {r: i for i, r in enumerate(device_rows)}
         out: Dict[str, List[wire.WireState]] = {}
         for name, row in known:
@@ -3362,7 +3458,7 @@ class DeviceEngine:
                 return None  # evicted and re-bound (possibly re-hosted)
             pn = hv[0]
         else:
-            pn_rows, _ = self.read_rows([row])
+            pn_rows, _ = self._scrape_rows([row])
             if self.directory.lookup(name) != row:
                 return None  # evicted (and possibly rebound) mid-read
             pn = pn_rows[0]
@@ -3563,6 +3659,17 @@ class DeviceEngine:
                 with self._pcond:
                     self._completing = False
                     self._pcond.notify_all()
+            if SCRAPE_MIRROR and self._mirror_want:
+                # Scrapes are active and went stale under load: re-arm
+                # the mirror HERE, off the scrape threads, so the next
+                # stats read costs zero transfers. One window gather per
+                # completion batch, and only while scrape interest is
+                # flagged.
+                try:
+                    self._refresh_scrape_mirror()
+                    self._mirror_want = False
+                except Exception:  # pragma: no cover - gauge refresh
+                    log.exception("scrape-mirror refresh failed")
 
     @property
     def ticks(self) -> int:
@@ -4162,7 +4269,9 @@ class DeviceEngine:
         kh = hist.kernel_histogram(kernel)
 
         def done() -> None:
-            jax.block_until_ready(marker)
+            # Device-commit latency gauge: awaiting the marker IS the
+            # measurement. Runs on the completer, never the feeder.
+            jax.block_until_ready(marker)  # patrol-lint: disable=PTD003
             dur = time.perf_counter_ns() - t_dispatch_ns
             hist.STAGE_DEVICE_COMMIT.record(dur)
             kh.record(dur)
@@ -4189,7 +4298,9 @@ class DeviceEngine:
         completer waits out the transfer."""
 
         def done() -> None:
-            jax.block_until_ready(dev)
+            # Staging-recycle gate on the completion pipeline (see
+            # docstring): the wait rides the completer by construction.
+            jax.block_until_ready(dev)  # patrol-lint: disable=PTD003
             self._staging.release(buf)
 
         self._enqueue_completion(done, (), {})
@@ -4331,7 +4442,9 @@ class DeviceEngine:
         n_keys = len(keys)
 
         def complete() -> None:
-            res = np.asarray(out)  # one D2H transfer; blocks until device done
+            # THE sanctioned completer readback: one batched D2H per
+            # take tick, on the completion pipeline by construction.
+            res = np.asarray(out)  # patrol-lint: disable=PTD003
             if DEVICE_TIMING:
                 # Device-side take duration: dispatch → results readable
                 # (the completion-pipeline readback delta, patrol-fleet).

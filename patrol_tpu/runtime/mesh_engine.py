@@ -271,6 +271,7 @@ class MeshEngine(DeviceEngine):
                 sharding = topo.batch_sharding(new_mesh)
                 with self._state_mu:
                     self.state = topo.place_state(self.state, new_mesh)
+                    self._state_gen += 1  # scrape-mirror epoch: new placement
                     self.mesh = new_mesh
                     self.plan = plan
                     self._step = step
@@ -532,7 +533,9 @@ class MeshEngine(DeviceEngine):
         n_keys = len(keys_d)
 
         def complete() -> None:
-            res = np.asarray(out)  # one D2H gather; blocks until ready
+            # THE sanctioned mesh completer readback: one batched D2H
+            # per fused step, on the completion pipeline by construction.
+            res = np.asarray(out)  # patrol-lint: disable=PTD003
             if engine_mod.DEVICE_TIMING:
                 dur = time.perf_counter_ns() - t_dispatch
                 hist.STAGE_DEVICE_TAKE.record(dur)

@@ -81,6 +81,14 @@ _DECLARED: Tuple[Knob, ...] = (
          "Window for the host-demotion take counter."),
     Knob("PATROL_NATIVE_PROMOTE_TAKES", "0",
          "Promotion threshold for the native (C++) host store (0 = off)."),
+    # --- runtime/engine.py: stats/debug scrape mirror ------------------
+    Knob("PATROL_SCRAPE_MIRROR", "1",
+         "Serve stats/debug reads (snapshot/tokens//debug/vars) from an "
+         "epoch-validated host mirror instead of a device gather per "
+         "scrape (0 = gather every time)."),
+    Knob("PATROL_SCRAPE_MIRROR_ROWS", "4096",
+         "Max device rows the scrape mirror caches per refresh; rows "
+         "beyond the window fall back to a targeted gather."),
     # --- runtime/engine.py: bucket lifecycle / GC ----------------------
     Knob("PATROL_GC_WINDOW_MS", "500",
          "Idle-bucket GC sweep cadence."),

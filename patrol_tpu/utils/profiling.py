@@ -318,6 +318,13 @@ class CounterRegistry:
         "peer_leaves",
         "lane_tombstones",
         "mesh_resizes",
+        # patrol-dispatch (runtime/engine.py scrape mirror): stats/debug
+        # reads served from the epoch-validated host mirror vs. reads
+        # that had to gather device rows, and mirror refreshes run (the
+        # regression test pins gathers at zero per steady-state scrape).
+        "scrape_mirror_hits",
+        "scrape_device_gathers",
+        "scrape_mirror_refreshes",
     )
 
     def __init__(self):

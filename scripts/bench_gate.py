@@ -91,6 +91,15 @@ TREND_GATES: Dict[str, dict] = {
     "audit_overshoot_factor": {
         "direction": "lower", "rel_tol": 0.05, "abs_floor": 0.01,
     },
+    # patrol-dispatch: cached jit variants after the witness warm+redrive.
+    # Deterministic per commit, but legitimately grows when a kernel gains
+    # a shape bucket — wide band + floor so only a specialization explosion
+    # (one python-size argument can mint a variant per distinct value)
+    # trips it without a re-pin. Zero-entries vacuity is caught by the
+    # NONZERO gate below; per-variant stability by retraces_after_warmup.
+    "jit_cache_entries": {
+        "direction": "lower", "rel_tol": 0.5, "abs_floor": 16.0,
+    },
 }
 
 # Hard boolean/exactness gates: value must equal the expectation.
@@ -151,6 +160,16 @@ EXACT_GATES: Dict[str, object] = {
     "cert_gcra_admitted": 15,
     "cert_conc_admitted": 21,
     "cert_quota_admitted": 8,
+    # patrol-dispatch (check.sh stage 10): the smoke warms every
+    # registered engine hot path and re-drives each at identical shapes
+    # under the jax compile counter — a single post-warmup retrace means
+    # a call site started feeding raw python sizes (or drifted off its
+    # declared shape-bucket law) and every steady-state request is now
+    # paying a recompile. EXACT zero, no tolerance. The witness-path
+    # count pins the coverage half: a path silently dropped from
+    # WITNESS_PATHS would otherwise weaken the retrace gate unseen.
+    "retraces_after_warmup": 0,
+    "dispatch_witness_paths": 15,
 }
 
 # Fields that must be present AND strictly positive (no baseline needed):
@@ -182,6 +201,11 @@ NONZERO_GATES = (
     "churn_counter_peer_leaves",
     "churn_counter_lane_tombstones",
     "churn_counter_mesh_resizes",
+    # patrol-dispatch: the warmed jit cache actually holds entries —
+    # zero would mean the witness ran against stub kernels (the retrace
+    # gate above would then pass vacuously). Not EXACT: the absolute
+    # count varies with which other smoke legs warmed jits first.
+    "jit_cache_entries",
 )
 
 # Device-stage columns (patrol-fleet device-dispatch timing): the smoke's

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # patrol-check: the repo-wide static-analysis + sanitizer + prover gate.
 #
-# One command, one pass/fail exit code, nine stages (plus one opt-in):
+# One command, one pass/fail exit code, ten stages (plus one opt-in):
 #
 #   lint    — repo-specific AST checks over patrol_tpu/ (clock seams,
 #             jit-reachable sync primitives, lock order, nanotoken dtype
@@ -78,6 +78,22 @@
 #             kernel under ops/ registered (PTK004), and registry
 #             integrity (PTK005); plus the pytest -m cert self-tests.
 #             CPU-pinned jax models, never skips.
+#   dispatch— patrol-dispatch: the dispatch-discipline prover +
+#             compile-cache stability witness
+#             (patrol_tpu/analysis/dispatch.py, scripts/dispatch_repo.py)
+#             over the declared DispatchSpec registry
+#             (ops/obligations.py::DISPATCH_SPECS): retrace-risk AST
+#             dataflow at the engine jit call sites incl. shape-bucket
+#             law drift (PTD001), donation discipline incl.
+#             use-after-donate (PTD002), implicit host transfers on the
+#             serve graph (PTD003), a deterministic witness that warms
+#             every registered hot path then re-drives it at identical
+#             shapes under a compile counter + the jax device-to-host
+#             transfer guard (PTD004), and witness completeness over
+#             every engine-dispatched jitted kernel (PTD005) — with
+#             seeded mutations demonstrably rejected with their exact
+#             codes; plus the pytest -m dispatch self-tests.
+#             CPU-pinned jax, never skips.
 #   asan-py — OPT-IN (never in the default set; select explicitly with
 #             --stage): the ctypes-facing pytest subset under
 #             LD_PRELOAD=libasan with an ASan-instrumented
@@ -90,23 +106,23 @@
 #                    check.sh --stage asan-py        # the opt-in seam check
 # The final line is machine-readable so an outer CI can assert that no
 # stage silently skipped (scripts/ci_gate.sh does exactly that):
-#                    PATROL_CHECK stages=9 pass=8 skip=1 fail=0 skipped=tidy failed=-
+#                    PATROL_CHECK stages=10 pass=9 skip=1 fail=0 skipped=tidy failed=-
 #
 # Prereqs and the lint/prove suppression format are documented in
 # README.md ("patrol-check").
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-DEFAULT_STAGES="lint,tidy,san,prove,abi,protocol,race,lin,cert"
+DEFAULT_STAGES="lint,tidy,san,prove,abi,protocol,race,lin,cert,dispatch"
 STAGES="$DEFAULT_STAGES"
 while [[ $# -gt 0 ]]; do
   case "$1" in
     --stage|--stages) STAGES="$2"; shift 2 ;;
     --stage=*|--stages=*) STAGES="${1#*=}"; shift ;;
     -h|--help)
-      sed -n '2,83p' "$0" | sed 's/^# \{0,1\}//'
+      sed -n '2,99p' "$0" | sed 's/^# \{0,1\}//'
       exit 0 ;;
-    *) echo "unknown argument: $1 (try --stage lint,tidy,san,prove,abi,protocol,race,lin,cert,asan-py)" >&2
+    *) echo "unknown argument: $1 (try --stage lint,tidy,san,prove,abi,protocol,race,lin,cert,dispatch,asan-py)" >&2
        exit 2 ;;
   esac
 done
@@ -274,6 +290,18 @@ stage_cert() (
   fi
 )
 
+stage_dispatch() (
+  set -euo pipefail
+  echo "== patrol-check [dispatch] dispatch-discipline prover + compile-cache witness =="
+  python scripts/dispatch_repo.py
+  if have_pytest; then
+    env JAX_PLATFORMS=cpu python -m pytest tests/test_dispatch.py -q -m dispatch \
+      -p no:cacheprovider
+  else
+    echo "pytest unavailable: dispatch self-tests skipped (prover itself ran)"
+  fi
+)
+
 stage_asan_py() (
   set -euo pipefail
   echo "== patrol-check [asan-py] ctypes seam under LD_PRELOAD=libasan =="
@@ -337,11 +365,11 @@ run_stage() {
 IFS=',' read -r -a SELECTED <<<"$STAGES"
 for s in "${SELECTED[@]}"; do
   case "$s" in
-    lint|tidy|san|prove|abi|protocol|race|lin|cert|asan-py) ;;
-    *) echo "unknown stage: '$s' (valid: lint tidy san prove abi protocol race lin cert asan-py)" >&2; exit 2 ;;
+    lint|tidy|san|prove|abi|protocol|race|lin|cert|dispatch|asan-py) ;;
+    *) echo "unknown stage: '$s' (valid: lint tidy san prove abi protocol race lin cert dispatch asan-py)" >&2; exit 2 ;;
   esac
 done
-for s in lint tidy san prove abi protocol race lin cert asan-py; do
+for s in lint tidy san prove abi protocol race lin cert dispatch asan-py; do
   for sel in "${SELECTED[@]}"; do
     if [[ "$sel" == "$s" ]]; then
       case "$s" in
@@ -354,6 +382,7 @@ for s in lint tidy san prove abi protocol race lin cert asan-py; do
         race)    run_stage race    stage_race ;;
         lin)     run_stage lin     stage_lin ;;
         cert)    run_stage cert    stage_cert ;;
+        dispatch) run_stage dispatch stage_dispatch ;;
         asan-py) run_stage asan-py stage_asan_py ;;
       esac
     fi

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Run patrol-dispatch — the dispatch-discipline prover + compile-cache
+stability witness over ``DISPATCH_SPECS`` (patrol_tpu/ops/obligations.py).
+
+Stage 10 of the `scripts/check.sh` gate, runnable standalone:
+
+  PTD001  retrace risk: jit dispatches fed raw python sizes /
+          f-strings of shapes, and shape-bucket (_pad_size) law drift
+          against the declared registry
+  PTD002  donation discipline: binding/factory drift against the
+          declared donate_argnums + use-after-donate dataflow at the
+          engine dispatch sites
+  PTD003  implicit host transfers (.item(), float()/int()/bool() on
+          device values, np.asarray of device arrays, device_get) in
+          functions reachable from the serve graph roots
+  PTD004  compile-cache stability witness: every registered hot path
+          warmed, then re-driven at identical shapes under a compile
+          counter + the jax transfer guard — any post-warmup trace or
+          implicit transfer is a finding carrying kernel + aval
+  PTD005  completeness: every engine-dispatched jitted kernel is
+          registered with a witness path or a written justified
+          absence; stale/contradictory declarations flagged
+
+Exit code 0 = clean; 1 = findings printed one per line as
+`path:line: CODE message`. Deterministic; the witness runs on CPU.
+"""
+
+import argparse
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from patrol_tpu.analysis import driver
+
+    repo_root = driver.repo_root_for(__file__)
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--list",
+        action="store_true",
+        help="list registered dispatch specs and seeded mutations, then exit",
+    )
+    ap.add_argument(
+        "--mutation",
+        default=None,
+        help="execute ONE named seeded mutation and print the verdict",
+    )
+    ap.add_argument(
+        "--no-witness",
+        action="store_true",
+        help="static checks only (skip the PTD004 dynamic witness)",
+    )
+    args = ap.parse_args()
+
+    from patrol_tpu.analysis import dispatch
+    from patrol_tpu.ops.obligations import DISPATCH_SPECS
+
+    if args.list:
+        for spec in DISPATCH_SPECS:
+            cover = (
+                f"witness={spec.witness}"
+                if spec.witness
+                else "witness:absent (justified)"
+            )
+            print(
+                f"spec     {spec.name}  donate={spec.donate_argnums} "
+                f"static={spec.static_argnames} buckets={spec.buckets}"
+                f"({spec.bucket_lo},{spec.bucket_hi}) [{cover}]"
+            )
+        for name, code in dispatch.DISPATCH_MUTATIONS.items():
+            kind = "dynamic" if code == "PTD004" else "static"
+            print(f"mutation {name}  → {code} [{kind}]")
+        return 0
+
+    if args.mutation:
+        expect = dispatch.DISPATCH_MUTATIONS.get(args.mutation)
+        if expect is None:
+            return driver.unknown_name(
+                "patrol-dispatch", "mutation", args.mutation
+            )
+        findings = dispatch.mutation_findings(args.mutation)
+        hit = any(f.check == expect for f in findings)
+        stray = sorted(
+            {f.check for f in findings if f.check != expect}
+        )
+        detail = (
+            f"rejected with {expect}"
+            + (f" (riders: {','.join(stray)})" if stray else "")
+            if hit
+            else f"NOT rejected (saw: {','.join(stray) or 'nothing'})"
+        )
+        return driver.mutation_verdict(
+            "patrol-dispatch", args.mutation, hit, detail
+        )
+
+    used = set()
+    findings = dispatch.check_repo(repo_root, used_out=used)
+    report = None
+    if not args.no_witness:
+        report = dispatch.run_witness()
+        findings += report.findings
+    findings = driver.apply_stage_suppressions(
+        findings, repo_root, "PTD", inline_used=used
+    )
+
+    witnessed = sum(1 for s in DISPATCH_SPECS if s.witness)
+    absent = sum(1 for s in DISPATCH_SPECS if s.witness_absent)
+    wtail = (
+        "witness skipped (--no-witness)"
+        if report is None
+        else (
+            f"{len(report.paths)} witness paths re-driven: "
+            f"{report.retraces_after_warmup} post-warmup retraces, "
+            f"{report.jit_cache_entries} cached variants"
+        )
+    )
+    return driver.finish(
+        "patrol-dispatch",
+        findings,
+        lambda: (
+            f"patrol-dispatch: clean ({len(DISPATCH_SPECS)} specs: "
+            f"{witnessed} witnessed + {absent} justified-absent; {wtail})"
+        ),
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,8 @@ def healthy_receipts():
             "cert_gcra_admitted": 15,
             "cert_conc_admitted": 21,
             "cert_quota_admitted": 8,
+            "retraces_after_warmup": 0,
+            "dispatch_witness_paths": 15,
             "ingest_raw_device_dispatches": 25,
             "wire_raw_device_dispatches": 15,
             "metrics_exposition": "parsed",

@@ -1,0 +1,393 @@
+"""patrol-dispatch self-tests (PTD001-PTD005) — the `pytest -m dispatch`
+slice of the scripts/check.sh stage-10 gate.
+
+Every code is proven BOTH ways: the clean form of each fixture (and the
+real repo, with its justified inline seams) passes, and a seeded defect
+of the same shape is flagged with the exact code. The static half covers
+the retrace-risk shape-taint model (including the value-flow patterns
+that must NOT flag: gathered scalars, m-sized payloads written into
+padded buffers), donation drift / use-after-donate / donated-aliasing,
+and implicit host transfers on the serve graph. The dynamic half runs
+the real witness once per module (warm every registered hot path,
+re-drive under the compile counter + the D2H transfer guard) and proves
+the seeded unbucketed-aval mutation is rejected. The scrape-mirror
+tests pin satellite fix #1: steady-state stats scrapes cost zero device
+gathers, stay bit-exact against a direct gather, and never serve stale
+epochs.
+"""
+
+import numpy as np
+import pytest
+
+from patrol_tpu.analysis import dispatch, driver
+from patrol_tpu.models.limiter import LimiterConfig
+from patrol_tpu.ops.obligations import DISPATCH_SPECS
+from patrol_tpu.ops.rate import Rate
+from patrol_tpu.runtime import engine as engine_mod
+from patrol_tpu.runtime.engine import DeviceEngine
+from patrol_tpu.utils import profiling
+
+import os
+
+pytestmark = pytest.mark.dispatch
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(findings):
+    return sorted({f.check for f in findings})
+
+
+def fixture_findings(snippet, extra_sources=None):
+    """Static stack over the clean baseline + one appended snippet."""
+    sources = {
+        "patrol_tpu/runtime/engine.py": dispatch._FIXTURE_BASELINE + snippet
+    }
+    sources.update(extra_sources or {})
+    return dispatch.check_sources(sources)
+
+
+# ===========================================================================
+# PTD001 — retrace risk (shape-level taint model).
+
+
+class TestRetrace:
+    def test_clean_baseline(self):
+        assert dispatch.clean_fixture_findings() == []
+
+    def test_raw_len_at_dispatch_flagged(self):
+        f = fixture_findings(
+            """
+
+    def serve_raw(self, keys):
+        packed = jnp.zeros((8, MAX_TAKE_ROWS), jnp.int64)
+        self.state, out = take_batch_jit(self.state, packed, len(keys))
+        return out
+"""
+        )
+        assert codes(f) == ["PTD001"]
+        assert any("serve_raw" in x.message or x.line for x in f)
+
+    def test_bare_shape_at_dispatch_flagged(self):
+        f = fixture_findings(
+            """
+
+    def serve_shaped(self, keys, packed):
+        self.state, out = take_batch_jit(
+            self.state, packed, keys.shape[0]
+        )
+        return out
+"""
+        )
+        assert "PTD001" in codes(f)
+
+    def test_size_tainted_constructor_flagged(self):
+        """A buffer CONSTRUCTED from a python size, dispatched later —
+        the taint must survive the intermediate assignment."""
+        f = fixture_findings(
+            """
+
+    def serve_grown(self, keys):
+        n = len(keys)
+        packed = jnp.zeros((8, n), jnp.int64)
+        self.state, out = take_batch_jit(self.state, packed, 0)
+        return out
+"""
+        )
+        assert "PTD001" in codes(f)
+
+    def test_pad_size_cleanses(self):
+        """The declared bucket law (_pad_size) is the sanctioned shape
+        quantizer: sizes routed through it are NOT retrace vectors."""
+        f = fixture_findings(
+            """
+
+    def serve_padded(self, keys):
+        n = _pad_size(len(keys), hi=MAX_TAKE_ROWS)
+        packed = jnp.zeros((8, n), jnp.int64)
+        self.state, out = take_batch_jit(self.state, packed, 0)
+        return out
+"""
+        )
+        assert f == []
+
+    def test_masked_payload_into_padded_buffer_is_clean(self):
+        """The value-flow pattern behind the engine's GC probe: an
+        m-sized payload written into a FIXED-shape padded buffer. The
+        data varies, the aval does not — shape-level taint must not
+        leak through the .at[].set() value plane (regression for the
+        false positive the first value-level model produced)."""
+        f = fixture_findings(
+            """
+
+    def probe_masked(self, mask):
+        m = mask.shape[0]
+        vals = np.full(m, 7, np.int64)
+        packed = jnp.zeros((8, MAX_TAKE_ROWS), jnp.int64)
+        packed = packed.at[0, :m].set(vals)
+        self.state, out = take_batch_jit(self.state, packed, 0)
+        return out
+"""
+        )
+        assert f == []
+
+    def test_gathered_scalar_is_not_a_size(self):
+        """kept[0] from an opaque gather is data, not a shape — writing
+        it into a fixed-shape buffer must stay clean."""
+        f = fixture_findings(
+            """
+
+    def probe_gathered(self, mask):
+        kept = np.nonzero(mask)[0]
+        packed = jnp.zeros((8, MAX_TAKE_ROWS), jnp.int64)
+        packed = packed.at[0, 0].set(int(kept[0]))
+        self.state, out = take_batch_jit(self.state, packed, 0)
+        return out
+"""
+        )
+        assert f == []
+
+
+# ===========================================================================
+# PTD002 — donation discipline.
+
+
+class TestDonation:
+    def test_rebound_donated_state_is_clean(self):
+        # The baseline's serve() donates self.state and rebinds it from
+        # the result tuple in the same assignment.
+        assert dispatch.clean_fixture_findings() == []
+
+    def test_unbound_donated_result_flagged(self):
+        f = fixture_findings(
+            """
+
+    def commit_shadow(self, packed):
+        shadow = merge_batch_jit(self.state, packed)
+        return shadow
+"""
+        )
+        assert "PTD002" in codes(f)
+        assert any("use-after-donate" in x.message for x in f)
+
+    def test_donated_buffer_aliased_as_second_arg_flagged(self):
+        f = fixture_findings(
+            """
+
+    def merge_alias(self):
+        self.state = merge_batch_jit(self.state, self.state)
+"""
+        )
+        assert "PTD002" in codes(f)
+        assert any("again as a non-donated" in x.message for x in f)
+
+    def test_registry_covers_every_declared_donation(self):
+        # Internal consistency of the registry itself: a spec with a
+        # donation but no witness story is a stage-10 finding, so the
+        # shipped registry must declare one for every kernel.
+        for spec in DISPATCH_SPECS:
+            assert bool(spec.witness) != bool(spec.witness_absent), spec.name
+
+
+# ===========================================================================
+# PTD003 — implicit host transfers on the serve graph.
+
+
+class TestTransfers:
+    def test_item_on_serve_path_flagged(self):
+        f = fixture_findings(
+            """
+
+class DeviceEngine:
+    def _complete_loop(self):
+        self.state = merge_batch_jit(self.state, self.packed)
+        return self.state.pn[0].item()
+"""
+        )
+        assert "PTD003" in codes(f)
+        assert any(".item()" in x.message for x in f)
+
+    def test_float_on_dispatch_result_flagged(self):
+        f = fixture_findings(
+            """
+
+class DeviceEngine:
+    def _run_loop(self):
+        self.state, out = take_batch_jit(self.state, self.packed, 0)
+        return float(out[0])
+"""
+        )
+        assert "PTD003" in codes(f)
+
+    def test_engine_read_rows_result_is_host(self):
+        """self.read_rows returns host numpy (the D2H inside it is the
+        one sanctioned, suppressed seam) — int() on its result must NOT
+        flag (regression for the _maybe_demote false positives)."""
+        f = fixture_findings(
+            """
+
+class DeviceEngine:
+    def _complete_loop(self):
+        pn, el = self.read_rows([0])
+        return int(el[0])
+"""
+        )
+        assert f == []
+
+    def test_off_graph_function_not_flagged(self):
+        """A .item() in a helper nothing on the serve graph calls is
+        out of scope — PTD003 is a serve-path check, not a style ban."""
+        f = fixture_findings(
+            """
+
+def _offline_report(state):
+    return state.pn[0].item()
+"""
+        )
+        assert f == []
+
+
+# ===========================================================================
+# PTD005 — registry/witness completeness.
+
+
+class TestCompleteness:
+    def test_unregistered_kernel_flagged(self):
+        f = dispatch.mutation_findings("unregistered_kernel")
+        assert "PTD005" in codes(f)
+        assert any("DISPATCH_SPECS" in x.message for x in f)
+
+    def test_every_witness_name_is_implemented(self):
+        for spec in DISPATCH_SPECS:
+            if spec.witness:
+                assert spec.witness in dispatch.WITNESS_PATHS, spec.name
+
+    def test_real_repo_static_stack_clean(self):
+        """Stage 10's static half over the live tree: every finding is
+        either fixed or covered by a justified inline seam, and the
+        seams are non-vacuous (they actually suppressed something, so
+        the PTL006 stale sweep stays meaningful)."""
+        used = set()
+        findings = dispatch.check_repo(REPO_ROOT, used_out=used)
+        findings = driver.apply_stage_suppressions(
+            findings, REPO_ROOT, "PTD", inline_used=used
+        )
+        assert findings == [], [str(f) for f in findings]
+        ptd3 = {u for u in used if u[2] == "PTD003"}
+        assert len(ptd3) >= 8, (
+            "the sanctioned D2H seams (completer readback, GC probe, "
+            "read_rows gather) should be live suppressions"
+        )
+
+
+# ===========================================================================
+# Seeded mutations — each rejected with its exact registered code.
+
+
+class TestMutations:
+    @pytest.mark.parametrize("name", sorted(dispatch.DISPATCH_MUTATIONS))
+    def test_mutation_rejected_by_target_code(self, name):
+        expected = dispatch.DISPATCH_MUTATIONS[name]
+        findings = dispatch.mutation_findings(name)
+        assert findings, f"mutation {name} produced no findings"
+        assert expected in codes(findings), (
+            f"{name} expected {expected}, got {codes(findings)}"
+        )
+        if name == "unbucketed_aval":
+            # The witness names the seeded path. (Checked here, in the
+            # one run per process: a re-run would find the off-bucket
+            # aval already in the jit cache and prove nothing.)
+            assert any("unbucketed_aval" in x.message for x in findings)
+
+
+# ===========================================================================
+# PTD004 — the dynamic witness (one run shared across the module).
+
+
+@pytest.fixture(scope="module")
+def witness():
+    return dispatch.run_witness()
+
+
+class TestWitness:
+    def test_clean_tree_has_no_findings(self, witness):
+        assert witness.findings == [], [str(f) for f in witness.findings]
+
+    def test_zero_post_warmup_retraces(self, witness):
+        assert witness.retraces_after_warmup == 0, witness.compiles
+
+    def test_every_registered_path_driven(self, witness):
+        assert set(witness.paths) == set(dispatch.WITNESS_PATHS)
+        assert len(witness.paths) == len(dispatch.WITNESS_PATHS)
+
+    def test_cache_actually_warmed(self, witness):
+        # Zero entries would mean the retrace gate passed vacuously.
+        assert witness.jit_cache_entries > 0
+
+
+# ===========================================================================
+# Scrape-mirror regression (satellite fix: stats scrapes off the device).
+
+
+def _drive(eng, names, rate):
+    for n in names:
+        _, ok, _ = eng.take(n, rate, 1)
+        assert ok
+    assert eng.flush(timeout=30)
+
+
+class TestScrapeMirror:
+    def test_steady_state_scrape_is_gather_free_and_exact(self, monkeypatch):
+        monkeypatch.setattr(engine_mod, "HOST_FASTPATH", False)
+        eng = DeviceEngine(LimiterConfig(buckets=32, nodes=2), node_slot=0)
+        rate = Rate(freq=1000, per_ns=0)
+        names = [f"b{i}" for i in range(4)]
+        try:
+            _drive(eng, names, rate)
+            g0 = profiling.COUNTERS.get("scrape_device_gathers")
+            h0 = profiling.COUNTERS.get("scrape_mirror_hits")
+            rows = [eng.directory.lookup(n) for n in names]
+            # Direct gather reference BEFORE the scrape loop.
+            ref_pn, ref_el = eng.read_rows(np.array(rows, np.int32))
+            for _ in range(25):
+                for i, row in enumerate(rows):
+                    pn, el = eng.row_view(row)
+                    assert np.array_equal(pn, ref_pn[i])
+                    assert int(el) == int(ref_el[i])
+            # 100 scrapes, zero per-scrape device gathers: the mirror
+            # (re-armed by at most window refreshes) answered them all.
+            assert profiling.COUNTERS.get("scrape_device_gathers") == g0
+            assert profiling.COUNTERS.get("scrape_mirror_hits") >= h0 + 100
+        finally:
+            eng.stop()
+
+    def test_mutation_invalidates_the_mirror(self, monkeypatch):
+        """A scrape after new admitted work must NOT serve the old
+        epoch: the (ticks, state_gen) stamp forces a refresh."""
+        monkeypatch.setattr(engine_mod, "HOST_FASTPATH", False)
+        eng = DeviceEngine(LimiterConfig(buckets=16, nodes=2), node_slot=0)
+        rate = Rate(freq=1000, per_ns=0)
+        try:
+            _drive(eng, ["m0"], rate)
+            before = eng.tokens("m0")
+            _, ok, _ = eng.take("m0", rate, 1)
+            assert ok
+            assert eng.flush(timeout=30)
+            assert eng.tokens("m0") == before - 1
+        finally:
+            eng.stop()
+
+    def test_mirror_disabled_falls_back_to_gathers(self, monkeypatch):
+        monkeypatch.setattr(engine_mod, "HOST_FASTPATH", False)
+        monkeypatch.setattr(engine_mod, "SCRAPE_MIRROR", False)
+        eng = DeviceEngine(LimiterConfig(buckets=16, nodes=2), node_slot=0)
+        rate = Rate(freq=1000, per_ns=0)
+        try:
+            _drive(eng, ["d0"], rate)
+            g0 = profiling.COUNTERS.get("scrape_device_gathers")
+            row = eng.directory.lookup("d0")
+            eng.row_view(row)
+            eng.row_view(row)
+            assert profiling.COUNTERS.get("scrape_device_gathers") == g0 + 2
+        finally:
+            eng.stop()
