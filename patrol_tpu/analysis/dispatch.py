@@ -77,7 +77,7 @@ _ALL = ("PTD001", "PTD002", "PTD003", "PTD004", "PTD005")
 # the PTD002 drift check — so the table is itself checked: a _jit_*
 # factory in the engine files missing from here is a PTD002 finding.
 FACTORY_KERNELS: Dict[str, str] = {
-    "_jit_take_packed": "take_batch",
+    "_jit_take_packed": "take_n_batch",
     "_jit_merge_packed": "merge_batch",
     "_jit_merge_packed_folded": "merge_batch_folded",
     "_jit_commit_packed": "commit_blocks",
@@ -856,6 +856,7 @@ def check_transfers(mods: Sequence[Module]) -> List[Finding]:
 
 WITNESS_PATHS: Tuple[str, ...] = (
     "take",
+    "take_n",
     "merge_packed",
     "merge_folded",
     "commit_blocks",
@@ -1186,8 +1187,26 @@ def _witness_drives(eng, cfg):
         st = engine_mod._jit_commit_packed()(st, jnp.asarray(warm))
         jax.block_until_ready(st.pn)
 
+    def take_n():
+        # The coalesced serving dispatch at a hot-key shape: one packed
+        # row per bucket with nreq > 1 (a folded crowd), driven through
+        # the SAME lru-cached feeder factory the engine tick uses.
+        packed = np.zeros((8, 8), np.int64)
+        packed[0] = np.arange(8)  # rows (real bucket rows — takes gather)
+        packed[1] = NANO  # now_ns
+        packed[2] = 100  # freq
+        packed[3] = 3600 * NANO  # per_ns
+        packed[4] = NANO  # count_nt
+        packed[5] = 3  # nreq: the coalesced crowd size
+        packed[6] = 100 * NANO  # cap_base_nt
+        st = _scratch()
+        st, out = engine_mod._jit_take_packed(0)(st, jnp.asarray(packed))
+        jax.block_until_ready(st.pn)
+        jax.block_until_ready(out)
+
     return {
         "take": take,
+        "take_n": take_n,
         "merge_packed": merge_packed,
         "merge_folded": merge_folded,
         "commit_blocks": commit_blocks,
@@ -1251,6 +1270,7 @@ def _jit_cache_entries() -> int:
 
     fns = [
         take_mod.take_batch_jit,
+        take_mod.take_n_batch_jit,
         merge_mod.merge_batch_jit,
         merge_mod.merge_scalar_batch_jit,
         merge_mod.merge_dense_jit,

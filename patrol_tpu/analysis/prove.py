@@ -855,6 +855,209 @@ def _model_take_monotone(
     return findings
 
 
+def _model_take_n_laws(
+    root: ProveRoot, fn: Callable, site: Tuple[str, int]
+) -> List[Finding]:
+    """The coalesced take-n serving kernel, checked bit-exactly over a
+    small states × requests grid:
+
+    * PTP002 — hot-key coalescing is exact: ONE packed row carrying
+      ``nreq = n`` commits the same state and admits the same count as
+      n sequential ``nreq = 1`` applications of the same request at the
+      same timestamp (the reference's serialized takes, where only the
+      first sees a refill). The replay leg runs the CERTIFIED per-ticket
+      kernel — not ``fn`` — so a seeded defect in the checked kernel
+      cannot vouch for itself by breaking both legs identically. This is
+      the law that lets the feeder fold a Zipf crowd into one dispatch
+      without changing a single outcome.
+    * PTP003 — deny fixpoint: a row admitting zero commits NOTHING, so
+      replaying a denied crowd any number of times never moves state
+      (a deny storm must not drift the bucket).
+    * PTP004 — monotone lanes + own-lane locality, as take_monotone.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from patrol_tpu.models.limiter import NANO, LimiterState
+    from patrol_tpu.ops.take import take_n_batch as _reference_take_n
+
+    findings: List[Finding] = []
+    node_slot = 0
+    max_n = 3  # the grid's largest crowd; unrolled in the replay below
+    dom = JoinDomain(B=2, N=2, vals=(0, NANO, 3 * NANO))
+    pn0, el0 = dom.states(dom.vals)
+
+    reqs = np.array(
+        [
+            (row, now, freq, per, count, nreq, cap, created)
+            for row in (0, 1)
+            for now in (0, NANO, 3 * NANO)
+            for freq in (0, 2)
+            for per in (0, NANO)
+            for count in (0, NANO)
+            for nreq in (0, 1, max_n)
+            for cap in (0, 2 * NANO)
+            for created in (0, NANO)
+        ],
+        np.int64,
+    )
+
+    def one(pn, el, r):
+        packed = r[:, None]  # the kernel's [TAKE_PACK_ROWS, K=1] layout
+        b_state, b_out = fn(LimiterState(pn=pn, elapsed=el), packed, node_slot)
+
+        # Sequential replay on the certified per-ticket kernel: max_n
+        # unit takes at the same timestamp, step j live iff j < nreq
+        # (an nreq=0 row is the kernel's own padding no-op, so the
+        # unroll is exact for every grid n).
+        seq = LimiterState(pn=pn, elapsed=el)
+        seq_adm = jnp.zeros((1,), jnp.int64)
+        for j in range(max_n):
+            unit = packed.at[5, 0].set(
+                jnp.where(j < r[5], jnp.int64(1), jnp.int64(0))
+            )
+            seq, s_out = _reference_take_n(seq, unit, node_slot)
+            seq_adm = seq_adm + s_out[1]
+        return b_state.pn, b_state.elapsed, b_out[1], seq.pn, seq.elapsed, seq_adm
+
+    app = jax.jit(jax.vmap(one))
+    S_pn, S_el, R = _grid((pn0, el0), (reqs,))
+    b_pn, b_el, b_adm, s_pn, s_el, s_adm = _chunked(app, [S_pn, S_el, R])
+
+    if "PTP002" in root.obligations:
+        eq = _states_eq((b_pn, b_el), (s_pn, s_el)) & (
+            b_adm[:, 0] == s_adm[:, 0]
+        )
+        i = _first_bad(eq)
+        if i is not None:
+            findings.append(
+                Finding(
+                    "PTP002",
+                    *site,
+                    f"[{root.name}] coalesced take-n diverges from the "
+                    f"sequential replay at request {R[i].tolist()}: one "
+                    "row with nreq=n must commit exactly what n unit "
+                    "takes at the same timestamp commit (admitted "
+                    f"{int(b_adm[i, 0])} vs {int(s_adm[i, 0])})",
+                )
+            )
+
+    if "PTP003" in root.obligations:
+        denied = b_adm[:, 0] == 0
+        moved = ~_states_eq((b_pn, b_el), (S_pn, S_el))
+        i = _first_bad(~(denied & moved))
+        if i is not None:
+            findings.append(
+                Finding(
+                    "PTP003",
+                    *site,
+                    f"[{root.name}] a fully denied row mutated state at "
+                    f"request {R[i].tolist()}: denies must be a fixpoint "
+                    "or a replayed deny storm drifts the bucket",
+                )
+            )
+
+    if "PTP004" in root.obligations:
+        i = _first_bad(_states_ge((b_pn, b_el), (S_pn, S_el)))
+        if i is not None:
+            findings.append(
+                Finding(
+                    "PTP004",
+                    *site,
+                    f"[{root.name}] take-n shrank a state plane at "
+                    f"request {R[i].tolist()}: lanes must stay monotone "
+                    "G-counters or max-joins resurrect forfeited tokens",
+                )
+            )
+        other = np.ones(pn0.shape[1:3], bool)
+        other[:, node_slot] = False
+        locality = (
+            (b_pn[:, other] == S_pn[:, other]).reshape(len(S_pn), -1).all(axis=1)
+        )
+        i = _first_bad(locality)
+        if i is not None:
+            findings.append(
+                Finding(
+                    "PTP004",
+                    *site,
+                    f"[{root.name}] take-n wrote a PN lane other than its "
+                    f"own (node_slot={node_slot}) at request "
+                    f"{R[i].tolist()}: remote lanes change only by merge",
+                )
+            )
+    return findings
+
+
+def _model_take_split_fifo(
+    root: ProveRoot, fn: Callable, site: Tuple[str, int]
+) -> List[Finding]:
+    """The host-side grant split behind take-n coalescing: ``fn`` fans
+    one coalesced row's ``(have, admitted, count, nreq)`` out to
+    per-ticket ``(remaining, ok)`` responses, exhaustively checked
+    against an explicit sequential ledger replay:
+
+    * PTP002 — FIFO first-k-of-m: ticket i (0-based arrival order)
+      succeeds iff ``i < admitted``; each admitted ticket sees the
+      balance after its OWN commit, each denied ticket the balance
+      after ALL admitted commits. A LIFO or round-robin split — late
+      arrivals jumping the crowd — is a counterexample here.
+    * PTP003 — deny storm: an ``admitted == 0`` row hands every ticket
+      the SAME untouched balance with ``ok = False`` (clamped at zero:
+      PN merges can drive it negative) — the reported balance must not
+      walk down a ledger nobody spent.
+    """
+    from patrol_tpu.models.limiter import NANO
+
+    findings: List[Finding] = []
+    want_002 = "PTP002" in root.obligations
+    want_003 = "PTP003" in root.obligations
+    haves = (-NANO, 0, NANO // 2, NANO, 2 * NANO, 3 * NANO, 5 * NANO + 7)
+    for have in haves:
+        for count in (NANO, 2 * NANO):
+            for nreq in range(5):
+                for admitted in range(nreq + 1):
+                    got = [
+                        (int(r), bool(ok))
+                        for r, ok in fn(have, admitted, count, nreq)
+                    ]
+                    bal = have
+                    want = []
+                    for i in range(admitted):
+                        bal -= count
+                        want.append((max(bal, 0) // NANO, True))
+                    post = max(have - admitted * count, 0) // NANO
+                    want.extend((post, False) for _ in range(admitted, nreq))
+                    if want_002 and got != want:
+                        findings.append(
+                            Finding(
+                                "PTP002",
+                                *site,
+                                f"[{root.name}] grant split diverges from "
+                                "the FIFO first-k-of-m ledger at "
+                                f"(have={have}, admitted={admitted}, "
+                                f"count={count}, nreq={nreq}): got {got}, "
+                                f"sequential replay says {want}",
+                            )
+                        )
+                        want_002 = False  # first counterexample suffices
+                    if want_003 and admitted == 0 and nreq > 0:
+                        fixed = (max(have, 0) // NANO, False)
+                        if any(entry != fixed for entry in got):
+                            findings.append(
+                                Finding(
+                                    "PTP003",
+                                    *site,
+                                    f"[{root.name}] deny storm drifted the "
+                                    f"reported balance at (have={have}, "
+                                    f"count={count}, nreq={nreq}): every "
+                                    f"denied ticket must see {fixed}, got "
+                                    f"{got}",
+                                )
+                            )
+                            want_003 = False
+    return findings
+
+
 def _model_scalar_monotone(
     root: ProveRoot, fn: Callable, site: Tuple[str, int]
 ) -> List[Finding]:
@@ -1985,6 +2188,8 @@ _MODELS: Dict[str, Callable] = {
     "dense_join": _model_dense_join,
     "tree_converge": _model_tree_converge,
     "take_monotone": _model_take_monotone,
+    "take_n_laws": _model_take_n_laws,
+    "take_split_fifo": _model_take_split_fifo,
     "lifecycle_iszero": _model_lifecycle_iszero,
     "scalar_monotone": _model_scalar_monotone,
     "rate_algebra": _model_rate_algebra,

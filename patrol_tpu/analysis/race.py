@@ -664,6 +664,12 @@ GUARDS: Dict[str, Dict[str, Dict[str, Guard]]] = {
             # under the work condvar's lock.
             "_takes": Guard("_cond", "mutate"),
             "_deltas": Guard("_cond", "mutate"),
+            # Hot-key coalescer index (take-fold key → open _TakeFold):
+            # submitters fold under the work condvar, the feeder's drain
+            # closes folds under the same lock — an unlocked mutation
+            # could append a ticket to an entry the feeder already
+            # popped, stranding its caller forever.
+            "_open_folds": Guard("_cond", "rw"),
             # "Set mutations run under _host_mu (drain/drop)" — the
             # feeder reads it under _cond, but every mutation site is a
             # _host_mu critical section (engine.py:799-802).
@@ -798,6 +804,10 @@ HOLDERS: Dict[str, Dict[str, Tuple[str, ...]]] = {
     "patrol_tpu/runtime/engine.py": {
         # "Caller holds ``_host_mu``." (engine.py:_promote_locked)
         "DeviceEngine._promote_locked": ("_host_mu",),
+        # Hot-key coalescer: submit-side fold and feeder-side drain both
+        # run inside the caller's ``with self._cond`` block.
+        "DeviceEngine._enqueue_take_locked": ("_cond",),
+        "DeviceEngine._drain_takes": ("_cond",),
         # AuditLedger's *_locked helpers run under its leaf lock.
         "AuditLedger._close_locked": ("_mu",),
         "AuditLedger._clock_window": ("_mu",),

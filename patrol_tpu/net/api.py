@@ -163,6 +163,10 @@ class API:
             if method != "POST":
                 return 405, b"method not allowed\n", "text/plain"
             return await self._take(path[len("/take/") :], query)
+        if path == "/take_batch":
+            if method != "POST":
+                return 405, b"method not allowed\n", "text/plain"
+            return await self._take_batch(query)
         if path.startswith("/tokens/"):
             if method != "GET":
                 return 405, b"method not allowed\n", "text/plain"
@@ -243,6 +247,111 @@ class API:
                 extra={"code": status, "count": count, "rate": str(rate), "bucket": name},
             )
         return status, str(remaining).encode(), "text/plain"
+
+    async def _take_batch(self, query: str) -> Tuple[int, bytes, str]:
+        """``POST /take_batch?t=<name>,<rate>,<count>&t=...`` — many takes
+        in ONE request, one response line per entry in request order:
+        ``200 <remaining>`` / ``429 <remaining>`` / ``429 overloaded``
+        (memory watermark shed of a NEW name) / ``400 <error>``.
+
+        A Zipf crowd hammering one hot name pays one round-trip AND one
+        device dispatch: the whole request lands in a single
+        submit_takes_batch, where the engine's take-fold collapses
+        same-bucket entries into one take-n row (runtime/engine.py).
+        Per-entry fields ride the query value, ','-separated, so the
+        request needs no body (both fronts drain but ignore bodies, like
+        /take); names percent-encode ',' and '&'. rate/count parse
+        exactly like /take: malformed rate ⇒ zero Rate (unconditional
+        429), bad/zero count ⇒ 1 (api.go:60-65). The response status is
+        200 whenever the batch parsed — per-entry outcomes live in the
+        body, and a watermark shed 429s exactly the shed entries, never
+        the whole request (live names in the same batch still serve).
+        The C++ front forwards this route here via its non-/take seam
+        (native_http.py _dispatch_other), so one handler serves both
+        fronts."""
+        lines: List[Optional[bytes]] = []
+        idxs: List[int] = []
+        names: List[str] = []
+        rates: List[Rate] = []
+        counts: List[int] = []
+        # Manual '&'-split of the RAW query: parse_qs round-trips values
+        # through UTF-8 and would corrupt non-UTF8 names; the name part is
+        # split off BEFORE decoding so encoded ','/'&' bytes stay inside it.
+        for part in query.split("&"):
+            key, _, val = part.partition("=")
+            if key != "t":
+                continue
+            raw_name, _, rest = val.partition(",")
+            name, err = self._decode_name(raw_name)
+            if err is not None:
+                lines.append(b"400 " + err[1].rstrip(b"\n"))
+                continue
+            raw_rate, _, raw_count = rest.partition(",")
+            try:
+                rate = parse_rate(unquote(raw_rate, errors="surrogateescape"))
+            except ValueError:
+                rate = Rate()  # parse errors silently ignored (api.go:61)
+            try:
+                count = int(raw_count or "0")
+                if count < 0:
+                    count = 0
+            except ValueError:
+                count = 0
+            if count == 0:
+                count = 1  # api.go:63-65
+            idxs.append(len(lines))
+            lines.append(None)
+            names.append(name)
+            rates.append(rate)
+            counts.append(count)
+        if not lines:
+            return 400, b"no take entries (t=<name>,<rate>,<count>)\n", "text/plain"
+        if names:
+            submit = getattr(self.repo, "submit_takes_batch", None)
+            if submit is None:
+                # Minimal repo (tests): per-entry scalar path, no shed lane.
+                for i, (name, rate, count) in zip(idxs, zip(names, rates, counts)):
+                    try:
+                        remaining, ok = await self.repo.take_async(name, rate, count)
+                    except OverloadedError:
+                        lines[i] = b"429 overloaded"
+                        continue
+                    lines[i] = b"%d %d" % (200 if ok else 429, remaining)
+            else:
+                res = submit(names, rates, counts)
+                if res is None:
+                    # Pool spent with every row pinned — same per-entry
+                    # outcome the batcher reports for this overload.
+                    for i in idxs:
+                        lines[i] = b"429 0"
+                else:
+                    loop = asyncio.get_running_loop()
+                    futs = []
+                    for ticket, _created in res:
+                        fut: asyncio.Future = loop.create_future()
+
+                        def _done(f=fut):
+                            loop.call_soon_threadsafe(
+                                lambda: f.done() or f.set_result(None)
+                            )
+
+                        ticket.add_done_callback(_done)
+                        futs.append((ticket, fut))
+                    for i, (ticket, fut) in zip(idxs, futs):
+                        await fut
+                        if getattr(ticket, "shed", False):
+                            lines[i] = b"429 overloaded"
+                        else:
+                            lines[i] = b"%d %d" % (
+                                200 if ticket.ok else 429,
+                                ticket.remaining,
+                            )
+        body = b"\n".join(lines) + b"\n"
+        if self.log is not None:
+            self.log.debug(
+                "take_batch", extra={"entries": len(lines), "submitted": len(names)}
+            )
+        return 200, body, "text/plain"
 
     async def _tokens(self, raw_name: str) -> Tuple[int, bytes, str]:
         """Read-only balance introspection — ``GET /tokens/:name`` returns

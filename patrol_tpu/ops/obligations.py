@@ -205,6 +205,19 @@ def _trace_take_batch(fn) -> Trace:
     return _mk_trace(lambda s, r: fn(s, r, 1), _state(), req)
 
 
+def _trace_take_n_batch(fn) -> Trace:
+    from patrol_tpu.ops.take import TAKE_PACK_ROWS
+
+    # The feeder's exact transfer layout: ONE int64[TAKE_PACK_ROWS, K]
+    # request matrix (the coalesced nreq row included). State planes
+    # lead both sides, so the default (0, 1) indices hold.
+    return _mk_trace(
+        lambda s, p: fn(s, p, 1),
+        _state(),
+        _S((TAKE_PACK_ROWS, _K), jnp.int64),
+    )
+
+
 def _trace_lifecycle_probe(fn) -> Trace:
     from patrol_tpu.ops.lifecycle import LifecycleProbe
 
@@ -541,6 +554,45 @@ def _mutant_quota_admit_leaf_only(state, req, node_slot):
     return LimiterState(pn=pn, elapsed=state.elapsed), result
 
 
+def _mutant_take_n_uncapped(state, packed, node_slot):
+    """take_n_batch with the crowd-size clip dropped: the greedy grant
+    admits ``have // count`` takes even past the ``nreq`` tickets
+    actually waiting (and padding rows with ``nreq == 0`` start
+    committing) — the coalesced row no longer replays the sequential
+    per-ticket outcomes."""
+    from patrol_tpu.ops.take import take_n_batch
+
+    lifted = packed.at[5].set(jnp.int64(1) << 40)  # SEEDED defect
+    return take_n_batch(state, lifted, node_slot)
+
+
+def _mutant_split_grant_lifo(have_nt, admitted, count_nt, nreq):
+    """split_grant admitting the LAST k tickets instead of the first:
+    late arrivals jump the crowd — the aggregate grant is unchanged,
+    but the FIFO fan-out order the tickets were promised is broken."""
+    from patrol_tpu.ops.take import remaining_for_request
+
+    return [
+        remaining_for_request(have_nt, admitted, count_nt, nreq - 1 - i)
+        for i in range(nreq)  # SEEDED defect: arrival order reversed
+    ]
+
+
+def _mutant_split_deny_charges(have_nt, admitted, count_nt, nreq):
+    """split_grant charging denied tickets as if they had committed: a
+    deny storm walks the REPORTED balance down a ledger nobody spent
+    (admission itself is untouched — only the observable remaining
+    drifts, the drift a replayed hot-key flood would amplify)."""
+    from patrol_tpu.models.limiter import NANO
+
+    out = []
+    for i in range(nreq):
+        ok = i < admitted
+        remaining_nt = have_nt - (i + 1) * count_nt  # SEEDED defect
+        out.append((max(remaining_nt, 0) // NANO, ok))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # The families.
 
@@ -715,6 +767,33 @@ KERNEL_FAMILIES: Tuple[KernelFamily, ...] = (
                 model="take_monotone", tracer=_trace_take_batch,
             ),
             ProveRoot(
+                # The hot-key take-n serving kernel (one dispatch per
+                # coalesced crowd): the full obligation set, with the
+                # algebraic codes mapped onto the coalescing laws by
+                # the ``take_n_laws`` model — PTP002: one row carrying
+                # nreq=n commits and admits EXACTLY what n sequential
+                # unit takes at the same timestamp do (the replay leg
+                # runs the certified per-ticket kernel, so a defect
+                # cannot vouch for itself); PTP003: a fully denied row
+                # is a state fixpoint (deny storms never drift the
+                # bucket); PTP004: monotone lanes + own-lane locality.
+                "ops.take.take_n_batch", "patrol_tpu.ops.take",
+                "take_n_batch", _ALL, structural="callbacks",
+                model="take_n_laws", tracer=_trace_take_n_batch,
+            ),
+            ProveRoot(
+                # The host-side grant split behind take-n coalescing:
+                # pure-Python fan-out of one coalesced row's grant to
+                # its FIFO ticket queue. Registered as its own root so
+                # the split ORDER is a certified law, not a convention:
+                # PTP002 pins first-k-of-m against the sequential
+                # ledger (LIFO / round-robin splits are rejected),
+                # PTP003 pins the deny-storm balance.
+                "ops.take.split_grant", "patrol_tpu.ops.take",
+                "split_grant", ("PTP002", "PTP003"),
+                model="take_split_fifo",
+            ),
+            ProveRoot(
                 "ops.rate", "patrol_tpu.ops.rate", "parse_rate",
                 ("PTP003", "PTP004"), model="rate_algebra",
             ),
@@ -732,6 +811,17 @@ KERNEL_FAMILIES: Tuple[KernelFamily, ...] = (
             "ops.take.take_batch:PTP003": (
                 "grants are not invertible — the forfeit clamp "
                 "deliberately discards over-capacity remainder"
+            ),
+            "ops.take.split_grant:PTP001": (
+                "host-side python fan-out: no jaxpr, nothing to trace"
+            ),
+            "ops.take.split_grant:PTP004": (
+                "the split moves no lattice state — it fans one already-"
+                "committed row's grant out to tickets; monotonicity "
+                "lives in the take-n kernel root it serves"
+            ),
+            "ops.take.split_grant:PTP005": (
+                "host-side python fan-out: no jaxpr, nothing to trace"
             ),
             "ops.rate:PTP001": (
                 "host-side python parser: no jaxpr, nothing to trace"
@@ -753,6 +843,14 @@ KERNEL_FAMILIES: Tuple[KernelFamily, ...] = (
                 "from the full local view with the over-capacity forfeit "
                 "clamp",
             ),
+            LinSpecFamily(
+                "ops.take.take_n_batch", "patrol_tpu.ops.take",
+                "take_n_batch", wire="full",
+                note="hot-key coalesced take-n: the SAME sequential "
+                "bucket spec — one row carrying nreq=n must hand out "
+                "exactly the outcomes of n serialized takes, so "
+                "coalescing is invisible to linearizability",
+            ),
         ),
         protocol="bucket-full",
         abi=(
@@ -767,8 +865,34 @@ KERNEL_FAMILIES: Tuple[KernelFamily, ...] = (
             ),
         ),
         wire_codec="ops.wire.codec",
-        bench_fields=("device_kernel_breakdown",),
+        bench_fields=(
+            "device_kernel_breakdown",
+            "take_coalesce_ratio",
+            "hotkey_takes_per_s",
+        ),
         mutations=(
+            CertMutation(
+                "take-n-uncapped-crowd", "prove",
+                "ops.take.take_n_batch", "PTP002",
+                note="crowd-size clip dropped: one coalesced row "
+                "admits past its waiting tickets, diverging from the "
+                "sequential per-ticket replay",
+                mutant=_mutant_take_n_uncapped,
+            ),
+            CertMutation(
+                "take-split-lifo", "prove",
+                "ops.take.split_grant", "PTP002",
+                note="grant split admits the LAST k tickets: late "
+                "arrivals jump the FIFO crowd",
+                mutant=_mutant_split_grant_lifo,
+            ),
+            CertMutation(
+                "take-split-deny-drift", "prove",
+                "ops.take.split_grant", "PTP003",
+                note="denied tickets charged as if committed: the "
+                "reported balance drifts under a deny storm",
+                mutant=_mutant_split_deny_charges,
+            ),
             CertMutation(
                 "take-ignores-remote-lanes", "protocol",
                 "take-ignores-remote-lanes", "PTC003",
@@ -1195,6 +1319,14 @@ DISPATCH_SPECS: Tuple[DispatchSpec, ...] = (
         static_argnames=("node_slot",),
         bucket_hi="MAX_TAKE_ROWS", witness="take",
         note="packed [8,K] request / [7,K] result; feeder tick path",
+    ),
+    DispatchSpec(
+        "take_n_batch", "patrol_tpu.ops.take", "take_n_batch",
+        static_argnames=("node_slot",),
+        bucket_hi="MAX_TAKE_ROWS", witness="take_n",
+        note="the coalesced serving wrapper the feeder tick actually "
+        "dispatches: packed [8,K] in / [7,K] out with hot-key crowds "
+        "folded into the nreq row",
     ),
     DispatchSpec(
         "merge_batch", "patrol_tpu.ops.merge", "merge_batch",

@@ -168,6 +168,70 @@ take_batch_jit = partial(jax.jit, static_argnames=("node_slot",), donate_argnums
 )
 
 
+def take_n_batch(
+    state: LimiterState, packed: jax.Array, node_slot: int
+) -> tuple[LimiterState, jax.Array]:
+    """The take-n serving kernel: ONE packed ``int64[TAKE_PACK_ROWS, K]``
+    request matrix in, ONE packed ``int64[TAKE_RESULT_ROWS, K]`` result
+    matrix out — the exact transfer layout the feeder tick ships
+    (engine._apply_takes), promoted to a certified kernel root of its
+    own. Hot-key coalescing rides the ``nreq`` row: n same-(bucket,
+    rate, count) takes collapse into one kernel row granting
+    ``min(n, available)`` in a single dispatch, and the host splits the
+    grant FIFO across the waiting tickets (:func:`split_grant`).
+
+    The admission algebra is :func:`take_batch`'s — this wrapper only
+    fixes the wire layout — but it is registered as its own prove root
+    so the n>1 greedy grant is checked DIRECTLY against the sequential
+    one-at-a-time replay (PTP002), the deny fixpoint is pinned (PTP003),
+    and the packed layout's dtypes can't drift (PTP005)."""
+    req = TakeRequest(
+        rows=packed[0].astype(jnp.int32),
+        now_ns=packed[1],
+        freq=packed[2],
+        per_ns=packed[3],
+        count_nt=packed[4],
+        nreq=packed[5],
+        cap_base_nt=packed[6],
+        created_ns=packed[7],
+    )
+    state, res = take_batch(state, req, node_slot)
+    out = jnp.stack(
+        [
+            res.have_nt,
+            res.admitted,
+            res.own_added_nt,
+            res.own_taken_nt,
+            res.elapsed_ns,
+            res.sum_added_nt,
+            res.sum_taken_nt,
+        ]
+    )
+    return state, out
+
+
+take_n_batch_jit = partial(
+    jax.jit, static_argnames=("node_slot",), donate_argnums=0
+)(take_n_batch)
+
+
+def split_grant(
+    have_nt: int, admitted: int, count_nt: int, nreq: int
+) -> list[tuple[int, bool]]:
+    """Deterministic FIFO split of one coalesced row's grant across its
+    ``nreq`` waiting tickets, in arrival order: the first ``admitted``
+    tickets succeed (each seeing the balance after its own commit), the
+    rest get clean denies (each seeing the balance after ALL admitted
+    commits). This is host policy — the kernel only reports ``admitted``
+    — so it is registered as its own prove root: the small-domain model
+    checks the split against the first-k-of-m sequential outcome
+    bit-exactly (a LIFO or round-robin split is rejected as PTP002)."""
+    return [
+        remaining_for_request(have_nt, admitted, count_nt, i)
+        for i in range(nreq)
+    ]
+
+
 def remaining_for_request(
     have_nt: int, admitted: int, count_nt: int, index: int
 ) -> tuple[int, bool]:

@@ -59,9 +59,9 @@ from patrol_tpu.ops.merge import (
 from patrol_tpu.ops.rate import Rate
 from patrol_tpu.ops.take import (
     TAKE_PACK_ROWS,
-    TakeRequest,
-    take_batch,
     remaining_for_request,
+    split_grant,
+    take_n_batch,
 )
 from patrol_tpu.ops import lifecycle as lifecycle_ops
 from patrol_tpu.ops.gcra import GcraRequest, gcra_take_batch_jit
@@ -79,6 +79,17 @@ log = logging.getLogger("patrol.engine")
 # Per-tick caps: at most this many take rows / merge rows per device call;
 # the rest stays queued for the next tick (the loop runs back-to-back).
 MAX_TAKE_ROWS = 4096
+
+
+def _take_fold_enabled() -> bool:
+    """Hot-key take coalescing (rx-side fold): same-(row, rate, count)
+    takes fold into ONE queue entry at submit time, so a Zipf crowd on a
+    few buckets drains as a handful of take-n rows instead of eating the
+    whole per-tick row budget ticket-by-ticket. Read at call time (like
+    PATROL_TICK_FOLD) so the bench's per-ticket replay leg can flip it
+    without forking the engine; "0" also makes _group_tickets serve one
+    ticket per row per tick — the true pre-coalescing reference path."""
+    return os.environ.get("PATROL_TAKE_FOLD", "1") != "0"
 # Merge rows per engine tick. Bigger ticks amortize per-dispatch cost
 # (decisive on remote-execute transports: the axon tunnel charges ~60 ms
 # per execute regardless of kernel size) at the price of one compiled
@@ -483,6 +494,7 @@ class TakeTicket:
         "remaining",
         "ok",
         "deferred",
+        "shed",
         "t0_ns",
         "trace_id",
     )
@@ -502,6 +514,11 @@ class TakeTicket:
         # ticket is still live in the queue — failure paths must not
         # complete/unpin it (engine thread only; no lock needed).
         self.deferred = False
+        # True when completed by the memory watermark's overload shed
+        # (never pinned, never queued): lets the multi-take HTTP front
+        # answer 429 "overloaded" for exactly the shed entries of a batch
+        # while live names in the same request keep their real outcomes.
+        self.shed = False
         # patrol-scope: service-latency stamp (take_service_ns histogram)
         # and the sampled cross-node trace id (None when unsampled).
         self.t0_ns = time.perf_counter_ns()
@@ -539,6 +556,23 @@ class TakeTicket:
             # inspectable after the fact (damped inside anomaly()).
             trace_mod.anomaly("take-stall")
         return ok
+
+
+class _TakeFold:
+    """One coalesced take-queue entry: every ticket with the same
+    (row, freq, per_ns, count) key that arrived while the entry waited
+    for a tick, in arrival order. The feeder's drain counts ENTRIES
+    (future packed rows), so a hot-key flood of thousands of tickets
+    costs one row of the per-tick budget instead of drowning it; the
+    grant still splits FIFO per ticket (ops/take.py split_grant).
+    Created and appended-to only under the work condvar's lock, like
+    the queue it lives in (analysis/race.py GUARDS)."""
+
+    __slots__ = ("key", "tickets")
+
+    def __init__(self, key: tuple, first: TakeTicket):
+        self.key = key
+        self.tickets = [first]
 
 
 class _Delta:
@@ -799,29 +833,10 @@ def fold_hybrid(deltas: DeltaArrays, nodes: int, row_dense_min: int):
 @lru_cache(maxsize=8)
 def _jit_take_packed(node_slot: int):
     def step(state, packed):
-        req = TakeRequest(
-            rows=packed[0].astype(jnp.int32),
-            now_ns=packed[1],
-            freq=packed[2],
-            per_ns=packed[3],
-            count_nt=packed[4],
-            nreq=packed[5],
-            cap_base_nt=packed[6],
-            created_ns=packed[7],
-        )
-        state, res = take_batch(state, req, node_slot)
-        out = jnp.stack(
-            [
-                res.have_nt,
-                res.admitted,
-                res.own_added_nt,
-                res.own_taken_nt,
-                res.elapsed_ns,
-                res.sum_added_nt,
-                res.sum_taken_nt,
-            ]
-        )
-        return state, out
+        # The packed↔result layout lives with the kernel now
+        # (ops/take.py take_n_batch — its own certified prove root);
+        # this factory only binds the static node slot and donation.
+        return take_n_batch(state, packed, node_slot)
 
     return jax.jit(step, donate_argnums=0)
 
@@ -949,6 +964,10 @@ class DeviceEngine:
         self._evict_mu = threading.Lock()
         self._takes: deque = deque()
         self._deltas: deque = deque()
+        # Hot-key coalescer index: take-fold key → its OPEN _TakeFold
+        # entry in _takes (removed when the feeder drains the entry).
+        # Rides the work condvar like the queue it indexes.
+        self._open_folds: Dict[tuple, _TakeFold] = {}
         # Host fast path: row → HostLanes for buckets currently served
         # in-process (µs-class) instead of on-device. The bool flag array
         # gives the rx hot path an O(1)/vectorized residency probe; dict
@@ -1525,6 +1544,30 @@ class DeviceEngine:
 
     # -- entry points -------------------------------------------------------
 
+    def _enqueue_take_locked(self, ticket: TakeTicket) -> None:
+        """Queue one take (caller holds ``_cond``). With the hot-key fold
+        on, a ticket whose (row, rate, count) key already has an OPEN
+        queue entry rides that entry instead of appending its own — the
+        rx-side collapse that keeps a single-name flood at one row of
+        the per-tick budget."""
+        if _take_fold_enabled():
+            key = (
+                ticket.row,
+                ticket.rate.freq,
+                ticket.rate.per_ns,
+                ticket.count,
+            )
+            fold = self._open_folds.get(key)
+            if fold is not None:
+                fold.tickets.append(ticket)
+                profiling.COUNTERS.inc("take_tickets_folded")
+                return
+            fold = _TakeFold(key, ticket)
+            self._open_folds[key] = fold
+            self._takes.append(fold)
+            return
+        self._takes.append(ticket)
+
     def submit_take(
         self, name: str, rate: Rate, count: int, now_ns: Optional[int] = None
     ) -> Tuple[TakeTicket, bool]:
@@ -1566,7 +1609,7 @@ class DeviceEngine:
                 # merges apply before takes, so the first take commits on
                 # top of the restored own lane, never below it.
                 self._deltas.append(_Delta(row, self.node_slot, *seed))
-            self._takes.append(ticket)
+            self._enqueue_take_locked(ticket)
             self._cond.notify()
         return ticket, created
 
@@ -2332,6 +2375,7 @@ class DeviceEngine:
                 out: List = [None] * len(names)
                 for i in unknown:
                     t = TakeTicket(names[i], 0, rates[i], int(counts[i]), now)
+                    t.shed = True  # overload shed, not a rate deny
                     t.complete(0, False)  # never pinned, never queued
                     out[i] = (t, False)
                 keep = [i for i in range(len(names)) if i not in shed]
@@ -2433,7 +2477,8 @@ class DeviceEngine:
                     # Un-hosted fresh binds: the seed rides the same
                     # tick's merge phase, ahead of the queued takes.
                     self._deltas.append(_Delta(srow, self.node_slot, *s))
-                self._takes.extend(queued)
+                for t in queued:
+                    self._enqueue_take_locked(t)
                 self._cond.notify()
         return list(zip(tickets, created))
 
@@ -3733,7 +3778,10 @@ class DeviceEngine:
         delta inside a bulk chunk): the public backpressure signal for bulk
         feeders (bench replay, heal ingest)."""
         with self._cond:
-            return len(self._takes) + sum(
+            return sum(
+                len(t.tickets) if isinstance(t, _TakeFold) else 1
+                for t in self._takes
+            ) + sum(
                 d.n if isinstance(d, _DeltaChunk) else 1 for d in self._deltas
             )
 
@@ -3780,7 +3828,7 @@ class DeviceEngine:
                 deltas = self._drain_deltas(
                     MAX_MERGE_ROWS * self._commit_blocks
                 )
-                tickets = self._drain(self._takes, MAX_TAKE_ROWS)
+                tickets = self._drain_takes(MAX_TAKE_ROWS)
                 # Clear the re-queue marker at drain time, not in
                 # _group_tickets: if the tick dies before grouping runs, a
                 # stale True from a prior tick would make _fail_tickets skip
@@ -3865,6 +3913,27 @@ class DeviceEngine:
         out = []
         while q and len(out) < limit:
             out.append(q.popleft())
+        return out
+
+    def _drain_takes(self, limit: int) -> List[TakeTicket]:
+        """Pop up to ``limit`` take-queue ENTRIES (caller holds
+        ``_cond``) and return the FLAT ticket list in arrival order. A
+        folded hot-key entry counts ONCE against the limit — it becomes
+        one packed row — so a coalesced tick can serve far more tickets
+        than the row budget; popping an entry closes its fold, and later
+        arrivals for the key open a fresh one."""
+        out: List[TakeTicket] = []
+        q = self._takes
+        n = 0
+        while q and n < limit:
+            item = q.popleft()
+            n += 1
+            if isinstance(item, _TakeFold):
+                if self._open_folds.get(item.key) is item:
+                    del self._open_folds[item.key]
+                out.extend(item.tickets)
+            else:
+                out.append(item)
         return out
 
     def _auto_size_commit_blocks_locked(self) -> None:
@@ -3965,6 +4034,12 @@ class DeviceEngine:
         FIFO service gives N requests), and cannot push an
         already-queued victim back (pinned by
         tests/test_engine.py::TestRateDiversity)."""
+        # PATROL_TAKE_FOLD=0 — the per-ticket replay reference: every
+        # ticket rides its own nreq=1 row, so a row's second ticket
+        # defers to the next tick (the kernel invariant of unique rows
+        # per batch stands either way). This is the pre-coalescing
+        # serving discipline the bench's hot-key leg replays against.
+        per_ticket = not _take_fold_enabled()
         groups: Dict[tuple, List[TakeTicket]] = {}
         row_key: Dict[int, tuple] = {}
         deferred: List[TakeTicket] = []
@@ -3974,7 +4049,7 @@ class DeviceEngine:
             if held is None:
                 row_key[t.row] = key
                 groups[key] = [t]
-            elif held == key:
+            elif held == key and not per_ticket:
                 groups[key].append(t)
             else:
                 deferred.append(t)
@@ -4000,10 +4075,14 @@ class DeviceEngine:
             ts = groups[key]
             c_nt = ts[0].count * NANO
             admitted_nt = 0
-            for idx, t in enumerate(ts):
-                remaining, ok = remaining_for_request(
-                    int(have[i]), int(admitted[i]), c_nt, idx
-                )
+            adm = int(admitted[i])
+            if 0 < adm < len(ts):
+                # A coalesced row whose grant covered only a prefix:
+                # the earliest tickets are admitted, the rest get clean
+                # denies (split_grant's FIFO discipline).
+                profiling.COUNTERS.inc("take_partial_grants")
+            outcomes = split_grant(int(have[i]), adm, c_nt, len(ts))
+            for t, (remaining, ok) in zip(ts, outcomes):
                 if ok:
                     admitted_nt += c_nt
                 if t.complete(remaining, ok):
@@ -4407,8 +4486,26 @@ class DeviceEngine:
             self._ticks += 1
         self._observe_device_commit("merge_scalar", t0, len(deltas))
 
+    @staticmethod
+    def _note_take_coalesce(keys, groups) -> None:
+        """Hot-key coalescing receipt for one tick's take pack (shared
+        with the mesh fused path): rows dispatched as take-n (nreq > 1),
+        with the flight-recorder arg carrying how many tickets rode
+        beyond one-per-row."""
+        multi = sum(1 for key in keys if len(groups[key]) > 1)
+        if multi:
+            profiling.COUNTERS.inc("take_rows_coalesced", multi)
+            tr = trace_mod.TRACE
+            if tr.enabled:
+                tr.record(
+                    trace_mod.EV_TAKE_COALESCE,
+                    0,
+                    sum(len(groups[key]) for key in keys) - len(keys),
+                )
+
     def _apply_takes(self, tickets: Sequence[TakeTicket]) -> None:
         keys, groups = self._group_tickets(tickets)
+        self._note_take_coalesce(keys, groups)
         k = _pad_size(len(keys), hi=MAX_TAKE_ROWS)
         packed = self._staging.lease((TAKE_PACK_ROWS, k))
         packed[:] = 0  # padding rows must stay nreq=0 no-ops

@@ -309,6 +309,8 @@ class MeshEngine(DeviceEngine):
             deltas = DeltaArrays(*(a[~sc] for a in deltas)) if not sc.all() else None
 
         keys, groups = self._group_tickets(tickets) if tickets else ([], {})
+        if keys:
+            self._note_take_coalesce(keys, groups)
         try:
             self._apply_fused(deltas, keys, groups)
         finally:

@@ -63,6 +63,9 @@ _DECLARED: Tuple[Knob, ...] = (
     Knob("PATROL_TICK_FOLD", "1",
          "Fold deltas before the merge tick (default: 0 on cpu, 1 on "
          "accelerators)."),
+    Knob("PATROL_TAKE_FOLD", "1",
+         "Hot-key take coalescing (0 = per-ticket replay; differential/"
+         "debug)."),
     Knob("PATROL_ROW_DENSE_MIN", "0",
          "Min distinct rows before the row-dense merge path engages."),
     Knob("PATROL_FOLD_NATIVE_MAX_DISTINCT", "4096",

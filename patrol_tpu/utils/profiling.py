@@ -325,6 +325,15 @@ class CounterRegistry:
         "scrape_mirror_hits",
         "scrape_device_gathers",
         "scrape_mirror_refreshes",
+        # Hot-key take coalescing (runtime/engine.py): packed rows
+        # dispatched as take-n (nreq > 1), tickets absorbed into an
+        # already-open queue fold at submit time (the rx-side collapse),
+        # and coalesced rows whose grant covered only a FIFO prefix of
+        # their tickets (partial grant → clean denies for the rest).
+        # bench --smoke's hot-key leg gates all three nonzero.
+        "take_rows_coalesced",
+        "take_tickets_folded",
+        "take_partial_grants",
     )
 
     def __init__(self):

@@ -71,6 +71,7 @@ EV_DELTA_RETRANSMIT = 15  # expired intervals re-shipped (arg = intervals)
 EV_DEVICE_READY = 16  # device dispatch→ready observed (arg = work rows)
 EV_AUDIT_TICK = 17  # patrol-audit flush tick (arg = datagrams shipped)
 EV_AUDIT_COMPARE = 18  # read-only divergence compare (arg = divergent buckets)
+EV_TAKE_COALESCE = 19  # hot-key take-n rows in a tick (arg = tickets folded)
 
 EVENT_NAMES = {
     EV_TICK: "engine.tick",
@@ -91,6 +92,7 @@ EVENT_NAMES = {
     EV_DEVICE_READY: "device.ready",
     EV_AUDIT_TICK: "audit.tick",
     EV_AUDIT_COMPARE: "audit.compare",
+    EV_TAKE_COALESCE: "take.coalesce",
 }
 
 AE_PHASES = {"trigger": 1, "digest": 2, "fetch": 3}

@@ -100,6 +100,12 @@ TREND_GATES: Dict[str, dict] = {
     "jit_cache_entries": {
         "direction": "lower", "rel_tol": 0.5, "abs_floor": 16.0,
     },
+    # Hot-key coalescing: the coalesced leg's serving rate over the
+    # seeded Zipf(1.25) crowd. Wall-clock-class on shared CI (wide
+    # band); the correctness content lives in the EXACT fixpoint gate
+    # and the smoke's own hard >= 5x assertion, mirrored by the floor
+    # gate below.
+    "hotkey_takes_per_s": {"direction": "higher", "rel_tol": 0.9},
 }
 
 # Hard boolean/exactness gates: value must equal the expectation.
@@ -169,7 +175,27 @@ EXACT_GATES: Dict[str, object] = {
     # count pins the coverage half: a path silently dropped from
     # WITNESS_PATHS would otherwise weaken the retrace gate unseen.
     "retraces_after_warmup": 0,
-    "dispatch_witness_paths": 15,
+    "dispatch_witness_paths": 16,
+    # Hot-key coalescing (one-dispatch-per-tick serving): the coalesced
+    # leg's per-ticket outcome stream must be BIT-EXACT equal to the
+    # PATROL_TAKE_FOLD=0 replay — coalescing is visible only in the
+    # dispatch count, never in results.
+    "hotkey_fixpoint_equal": True,
+    # The rx-fold collapse factor of the seeded Zipf crowd: 6000 tickets
+    # submitted against a paused feeder fold into exactly 64 open
+    # entries (one per name) = 93.75 tickets per dispatched take row.
+    # Fully deterministic — a drift means the fold keying or the
+    # submission discipline changed.
+    "take_coalesce_ratio": 93.75,
+}
+
+# Hard lower bounds: the current value must be >= the floor regardless
+# of baseline (the smoke asserts these too; gating here keeps a weakened
+# smoke from shipping silently).
+FLOOR_GATES: Dict[str, float] = {
+    # The hot-key tentpole's acceptance bar: coalesced serving must beat
+    # the per-ticket replay by >= 5x takes/s on the same box.
+    "hotkey_speedup_x": 5.0,
 }
 
 # Fields that must be present AND strictly positive (no baseline needed):
@@ -206,6 +232,15 @@ NONZERO_GATES = (
     # gate above would then pass vacuously). Not EXACT: the absolute
     # count varies with which other smoke legs warmed jits first.
     "jit_cache_entries",
+    # Hot-key coalescing liveness: the smoke's Zipf crowd actually
+    # exercised every coalescing seam — rows dispatched as take-n
+    # (nreq > 1), tickets folded rx-side onto open queue entries, and
+    # partial grants split FIFO across a row's waiting tickets. A zero
+    # means the fold path silently disengaged and the fixpoint gate
+    # above is comparing per-ticket against per-ticket.
+    "take_rows_coalesced",
+    "take_tickets_folded",
+    "take_partial_grants",
 )
 
 # Device-stage columns (patrol-fleet device-dispatch timing): the smoke's
@@ -241,6 +276,21 @@ def check_trend(baseline: dict, current: dict) -> Tuple[List[dict], List[str]]:
             report.append(f"FAIL {field}: {got!r} != {expect!r}")
         else:
             report.append(f"ok   {field} = {got!r}")
+
+    for field, floor in FLOOR_GATES.items():
+        got = current.get(field)
+        if not isinstance(got, (int, float)) or isinstance(got, bool):
+            regressions.append(
+                {"field": field, "why": "missing", "floor": floor}
+            )
+            report.append(f"FAIL {field}: {got!r} (must be >= {floor})")
+        elif got < floor:
+            regressions.append(
+                {"field": field, "why": "floor", "got": got, "floor": floor}
+            )
+            report.append(f"FAIL {field}: {got} < floor {floor}")
+        else:
+            report.append(f"ok   {field} = {got} (floor {floor})")
 
     for field in NONZERO_GATES:
         got = current.get(field)
@@ -311,6 +361,7 @@ def verdict_line(regressions: List[dict]) -> str:
     checked = (
         len(TREND_GATES)
         + len(EXACT_GATES)
+        + len(FLOOR_GATES)
         + len(DEVICE_STAGE_FIELDS)
         + len(NONZERO_GATES)
     )

@@ -31,7 +31,13 @@ def healthy_receipts():
             "cert_conc_admitted": 21,
             "cert_quota_admitted": 8,
             "retraces_after_warmup": 0,
-            "dispatch_witness_paths": 15,
+            "dispatch_witness_paths": 16,
+            "hotkey_fixpoint_equal": True,
+            "hotkey_speedup_x": 66.9,
+            "take_coalesce_ratio": 93.75,
+            "take_rows_coalesced": 64,
+            "take_tickets_folded": 5936,
+            "take_partial_grants": 27,
             "ingest_raw_device_dispatches": 25,
             "wire_raw_device_dispatches": 15,
             "metrics_exposition": "parsed",
@@ -247,6 +253,38 @@ class TestSoakGates:
         )
         regressions, _ = bench_gate.check_trend(base, bad)
         assert any(r["field"] == "soak_reclaimed" for r in regressions)
+
+    def test_hotkey_fixpoint_flip_rejected(self):
+        """The hot-key tentpole's hard gate: coalesced outcomes diverging
+        from the per-ticket replay must fail, whatever the speedup."""
+        bad = healthy_receipts()
+        bad["hotkey_fixpoint_equal"] = False
+        regressions, _ = bench_gate.check_trend({}, bad)
+        assert any(r["field"] == "hotkey_fixpoint_equal" for r in regressions)
+
+    def test_hotkey_speedup_floor_is_hard(self):
+        bad = healthy_receipts()
+        bad["hotkey_speedup_x"] = 4.9  # under the 5x acceptance bar
+        regressions, _ = bench_gate.check_trend({}, bad)
+        assert any(r["field"] == "hotkey_speedup_x" for r in regressions)
+        bad.pop("hotkey_speedup_x")  # missing is just as fatal
+        regressions, _ = bench_gate.check_trend({}, bad)
+        assert any(r["field"] == "hotkey_speedup_x" for r in regressions)
+
+    def test_hotkey_coalesce_ratio_drift_rejected(self):
+        bad = healthy_receipts()
+        bad["take_coalesce_ratio"] = 1.0  # fold silently disengaged
+        regressions, _ = bench_gate.check_trend({}, bad)
+        assert any(r["field"] == "take_coalesce_ratio" for r in regressions)
+
+    def test_hotkey_counters_must_be_positive(self):
+        for field in (
+            "take_rows_coalesced", "take_tickets_folded", "take_partial_grants"
+        ):
+            bad = healthy_receipts()
+            bad[field] = 0
+            regressions, _ = bench_gate.check_trend({}, bad)
+            assert any(r["field"] == field for r in regressions), field
 
     def test_mesh_gc_capability_pinned(self):
         bad = healthy_receipts()

@@ -401,6 +401,7 @@ class TestRepoGate:
         names = {s.name for s in specs()}
         assert names == {
             "ops.take.take_batch",
+            "ops.take.take_n_batch",
             "ops.delta.delta_fold",
             "ops.lifecycle.lifecycle_probe",
             "ops.gcra.gcra_take_batch",

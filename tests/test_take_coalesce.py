@@ -1,0 +1,487 @@
+"""Hot-key take coalescing tests — one-dispatch-per-tick serving.
+
+Three layers of the coalescing stack, each pinned against the
+pre-coalescing per-ticket discipline it replaces:
+
+* :func:`patrol_tpu.ops.take.split_grant` — exhaustive small-domain
+  property checks that a partial grant of k across m waiting tickets
+  equals the first-k-of-m sequential outcome BIT-EXACTLY (FIFO by
+  arrival: earliest tickets admitted, the rest clean denies), including
+  the forfeit clamp and zero-available deny storms.
+* :func:`patrol_tpu.ops.take.take_n_batch` — the take-n kernel's n>1
+  greedy grant versus n sequential nreq=1 applications of the same
+  kernel at the same frozen clock.
+* The engine's rx-side fold + feeder pack path — a flood of single
+  takes for one name collapses to ONE queue entry / ONE kernel row, and
+  ``PATROL_TAKE_FOLD=0`` (the per-ticket replay mode the bench's
+  hot-key leg compares against) serves the identical outcomes.
+
+Plus the serving fronts: the multi-take ``POST /take_batch`` request
+(one handler serves both fronts via the native non-/take seam) with the
+memory watermark's PER-ENTRY shed semantics — a batch carrying live
+names never whole-request 429s — and the patrol-race coverage of the
+coalescer's shared fold index (seeded unlocked mutation → PTR003).
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from patrol_tpu.models.limiter import (
+    NANO, LimiterConfig, LimiterState, init_state,
+)
+from patrol_tpu.net.api import API
+from patrol_tpu.ops.rate import Rate
+from patrol_tpu.ops.take import (
+    TAKE_PACK_ROWS, split_grant, take_n_batch,
+)
+from patrol_tpu.runtime import engine as engine_mod
+from patrol_tpu.runtime.engine import DeviceEngine
+from patrol_tpu.runtime.repo import TPURepo
+from patrol_tpu.utils import profiling
+
+CFG = LimiterConfig(buckets=64, nodes=4)
+RATE = Rate(freq=10, per_ns=NANO)  # 10 tokens/s, capacity 10
+
+
+class Clock:
+    def __init__(self, now=1000 * NANO):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+# ===========================================================================
+# split_grant — the host-side FIFO fan-out of one coalesced row's grant.
+
+
+def _sequential_outcomes(have_nt, admitted, count_nt, nreq):
+    """The reference discipline, replayed one ticket at a time: the first
+    ``admitted`` arrivals each commit ``count_nt`` (seeing the balance
+    after their own commit); later arrivals are denied and see the
+    balance after ALL admitted commits (bucket.go:215-224)."""
+    out = []
+    bal = have_nt
+    for i in range(nreq):
+        if i < admitted:
+            bal -= count_nt
+            out.append((max(bal, 0) // NANO, True))
+        else:
+            out.append((max(have_nt - admitted * count_nt, 0) // NANO, False))
+    return out
+
+
+class TestSplitGrantFairness:
+    HAVES = (-NANO, 0, NANO // 2, NANO, 2 * NANO, 3 * NANO, 5 * NANO + 7)
+    COUNTS = (NANO, 2 * NANO, 3 * NANO + 1)
+
+    def test_split_matches_first_k_of_m_sequential_exhaustively(self):
+        checked = 0
+        for have in self.HAVES:
+            for count in self.COUNTS:
+                for nreq in range(6):
+                    for admitted in range(nreq + 1):
+                        assert split_grant(
+                            have, admitted, count, nreq
+                        ) == _sequential_outcomes(have, admitted, count, nreq)
+                        checked += 1
+        assert checked > 300  # non-vacuous
+
+    def test_admission_is_a_fifo_prefix(self):
+        # Partial grants admit the EARLIEST tickets: ok flags form a
+        # prefix, never an interleaving (a LIFO or round-robin split
+        # would fail here and is rejected as PTP002 by the prove model).
+        for admitted in range(5):
+            flags = [ok for _, ok in split_grant(10 * NANO, admitted, NANO, 4)]
+            assert flags == [True] * min(admitted, 4) + [False] * (4 - min(admitted, 4))
+
+    def test_zero_available_deny_storm_is_uniform(self):
+        # admitted == 0: every ticket in the storm gets the SAME clean
+        # deny at the observed balance — no ticket is charged.
+        for have in (0, NANO // 3, 2 * NANO):
+            outcomes = split_grant(have, 0, NANO, 5)
+            assert outcomes == [(have // NANO, False)] * 5
+
+    def test_forfeit_overdraft_clamps_remaining_at_zero(self):
+        # PN merges can drive the balance negative (over-capacity
+        # forfeit); the reported remaining clamps at 0, never negative
+        # (the reference's negative-float→uint64 cast is UB we don't
+        # reproduce).
+        for remaining, ok in split_grant(-3 * NANO, 0, NANO, 3):
+            assert remaining == 0 and not ok
+
+    def test_admitted_see_post_commit_balance(self):
+        outcomes = split_grant(3 * NANO, 3, NANO, 4)
+        assert outcomes == [(2, True), (1, True), (0, True), (0, False)]
+
+
+# ===========================================================================
+# take_n_batch — the coalesced kernel row versus the sequential replay.
+
+
+def _packed(row, now, freq, per, count_nt, nreq, cap_nt, created):
+    p = np.zeros((TAKE_PACK_ROWS, 1), np.int64)
+    p[0, 0] = row
+    p[1, 0] = now
+    p[2, 0] = freq
+    p[3, 0] = per
+    p[4, 0] = count_nt
+    p[5, 0] = nreq
+    p[6, 0] = cap_nt
+    p[7, 0] = created
+    return p
+
+
+def _states_equal(a: LimiterState, b: LimiterState) -> bool:
+    return bool(
+        np.array_equal(np.asarray(a.pn), np.asarray(b.pn))
+        and np.array_equal(np.asarray(a.elapsed), np.asarray(b.elapsed))
+    )
+
+
+class TestTakeNKernel:
+    def test_batched_grant_equals_sequential_replay(self):
+        # One nreq=n row at a frozen clock must commit bit-identically
+        # to n sequential nreq=1 rows: step 1 refills, steps 2..n see
+        # delta=0, and Σ admits = clip(have // count, 0, n).
+        for freq, per in ((10, NANO), (3, NANO), (0, NANO)):
+            for count_nt in (NANO, 2 * NANO):
+                for nreq in range(5):
+                    for now in (1000 * NANO, 1000 * NANO + NANO // 2):
+                        cap = freq * NANO
+                        pk = _packed(2, now, freq, per, count_nt, nreq, cap, 1000 * NANO)
+                        b_state, b_out = take_n_batch(
+                            init_state(CFG), pk, node_slot=1
+                        )
+                        s_state = init_state(CFG)
+                        s_admitted = 0
+                        for _ in range(nreq):
+                            unit = pk.copy()
+                            unit[5, 0] = 1
+                            s_state, s_out = take_n_batch(s_state, unit, 1)
+                            s_admitted += int(s_out[1, 0])
+                        assert _states_equal(b_state, s_state), (
+                            freq, count_nt, nreq, now
+                        )
+                        assert int(b_out[1, 0]) == s_admitted
+
+    def test_deny_is_a_state_fixpoint(self):
+        # freq=0 is the zero Rate (unconditional deny): admitted == 0
+        # and the state moves NOTHING — a denied crowd of any size is a
+        # no-op dispatch.
+        st0 = init_state(CFG)
+        st1, out = take_n_batch(st0, _packed(1, 5 * NANO, 0, NANO, NANO, 7, 0, 0), 0)
+        assert int(out[1, 0]) == 0
+        assert _states_equal(st1, init_state(CFG))
+
+    def test_padding_rows_commit_nothing(self):
+        st1, out = take_n_batch(
+            init_state(CFG), _packed(0, 5 * NANO, 10, NANO, NANO, 0, 10 * NANO, 0), 0
+        )
+        assert int(out[1, 0]) == 0
+        assert _states_equal(st1, init_state(CFG))
+
+
+# ===========================================================================
+# Engine rx-fold + feeder pack path.
+
+
+def _paused_engine(monkeypatch):
+    # The host fast path would serve fresh rows without queueing; pin it
+    # off so every take rides the device queue under test.
+    monkeypatch.setattr(engine_mod, "HOST_FASTPATH", False)
+    clock = Clock()
+    eng = DeviceEngine(CFG, node_slot=0, clock=clock)
+    with eng._cond:
+        eng._tick_paused = True
+    return eng, clock
+
+
+def _resume(eng):
+    with eng._cond:
+        eng._tick_paused = False
+        eng._cond.notify_all()
+
+
+class TestRxFold:
+    def test_single_name_flood_collapses_to_one_entry(self, monkeypatch):
+        eng, _ = _paused_engine(monkeypatch)
+        try:
+            folded0 = profiling.COUNTERS.get("take_tickets_folded")
+            rows0 = profiling.COUNTERS.get("take_rows_coalesced")
+            partial0 = profiling.COUNTERS.get("take_partial_grants")
+            tickets = [
+                eng.submit_take("hot", RATE, 1)[0] for _ in range(20)
+            ]
+            # Rx-side fold: 20 same-key takes ride ONE queue entry (one
+            # row of the per-tick budget), folded at submit time —
+            # before the feeder ever runs.
+            with eng._cond:
+                assert len(eng._takes) == 1
+            assert profiling.COUNTERS.get("take_tickets_folded") - folded0 == 19
+            _resume(eng)
+            for t in tickets:
+                assert t.wait(10)
+            outcomes = [(t.ok, t.remaining) for t in tickets]
+            # FIFO split of the one-dispatch grant: capacity 10 admits
+            # the first 10 arrivals (post-commit balances 9..0), clean
+            # denies for the rest.
+            assert outcomes == [(True, 9 - i) for i in range(10)] + [
+                (False, 0)
+            ] * 10
+            assert profiling.COUNTERS.get("take_rows_coalesced") - rows0 >= 1
+            assert profiling.COUNTERS.get("take_partial_grants") - partial0 >= 1
+        finally:
+            eng.stop()
+
+    def test_fold_off_replay_serves_identical_outcomes(self, monkeypatch):
+        # PATROL_TAKE_FOLD=0 is the per-ticket replay discipline the
+        # bench's hot-key leg compares against: every ticket rides its
+        # own nreq=1 row across many ticks. Outcomes must be bit-equal.
+        def run(fold: bool):
+            monkeypatch.setenv("PATROL_TAKE_FOLD", "1" if fold else "0")
+            eng, _ = _paused_engine(monkeypatch)
+            try:
+                tickets = []
+                for i in range(14):
+                    name = "hot" if i % 3 else "warm"
+                    tickets.append(eng.submit_take(name, RATE, 1)[0])
+                _resume(eng)
+                for t in tickets:
+                    assert t.wait(10)
+                return [(t.ok, t.remaining) for t in tickets]
+            finally:
+                eng.stop()
+
+        assert run(fold=True) == run(fold=False)
+
+    def test_fold_off_queues_per_ticket(self, monkeypatch):
+        monkeypatch.setenv("PATROL_TAKE_FOLD", "0")
+        eng, _ = _paused_engine(monkeypatch)
+        try:
+            folded0 = profiling.COUNTERS.get("take_tickets_folded")
+            for _ in range(5):
+                eng.submit_take("hot", RATE, 1)
+            with eng._cond:
+                assert len(eng._takes) == 5
+            assert profiling.COUNTERS.get("take_tickets_folded") == folded0
+        finally:
+            eng.stop()
+
+    def test_distinct_keys_do_not_fold_together(self, monkeypatch):
+        eng, _ = _paused_engine(monkeypatch)
+        try:
+            eng.submit_take("a", RATE, 1)
+            eng.submit_take("b", RATE, 1)
+            eng.submit_take("a", RATE, 2)  # same row, different count
+            with eng._cond:
+                assert len(eng._takes) == 3
+        finally:
+            eng.stop()
+
+    def test_drained_fold_closes_and_reopens(self, monkeypatch):
+        # Popping an entry closes its fold: arrivals AFTER the feeder
+        # drained the key open a fresh entry instead of appending to a
+        # ticket list the tick already owns (which would strand them).
+        eng, _ = _paused_engine(monkeypatch)
+        try:
+            t1 = eng.submit_take("hot", RATE, 1)[0]
+            with eng._cond:
+                drained = eng._drain_takes(engine_mod.MAX_TAKE_ROWS)
+                assert drained == [t1]
+                assert not eng._open_folds
+            t2 = eng.submit_take("hot", RATE, 1)[0]
+            with eng._cond:
+                assert len(eng._takes) == 1
+            # Hand the drained ticket back so the feeder completes both.
+            with eng._cond:
+                eng._takes.appendleft(t1)
+                eng._cond.notify()
+            _resume(eng)
+            assert t1.wait(10) and t2.wait(10)
+        finally:
+            eng.stop()
+
+
+# ===========================================================================
+# The multi-take HTTP request — one round-trip, one submit_takes_batch,
+# per-entry outcomes. One handler serves both fronts (the C++ front
+# forwards /take_batch via its non-/take seam).
+
+
+def _http(api, query, method="POST", path="/take_batch"):
+    async def run():
+        return await api.handle(method, path, query)
+
+    return asyncio.run(run())
+
+
+class TestTakeBatchHTTP:
+    def _mk(self, monkeypatch, **lifecycle):
+        monkeypatch.setattr(engine_mod, "HOST_FASTPATH", False)
+        clock = Clock()
+        eng = DeviceEngine(CFG, node_slot=0, clock=clock)
+        if lifecycle:
+            eng.configure_lifecycle(**lifecycle)
+        return API(TPURepo(eng)), eng, clock
+
+    def test_per_entry_lines_in_request_order(self, monkeypatch):
+        api, eng, _ = self._mk(monkeypatch)
+        try:
+            q = "&".join(["t=hot,10:1s,1"] * 12 + ["t=cold,10:1s,4"])
+            status, body, ctype = _http(api, q)
+            assert status == 200 and ctype == "text/plain"
+            lines = body.decode().splitlines()
+            assert lines[:10] == [f"200 {9 - i}" for i in range(10)]
+            assert lines[10:12] == ["429 0", "429 0"]
+            assert lines[12] == "200 6"
+        finally:
+            eng.stop()
+
+    def test_defaults_match_single_take_route(self, monkeypatch):
+        # Malformed rate ⇒ zero Rate (unconditional 429 at balance 0);
+        # missing/zero count ⇒ 1 — exactly /take's api.go:60-65 rules.
+        api, eng, _ = self._mk(monkeypatch)
+        try:
+            status, body, _ = _http(api, "t=a,bogus:rate,1&t=b,10:1s&t=b,10:1s,0")
+            assert status == 200
+            assert body.decode().splitlines() == ["429 0", "200 9", "200 8"]
+        finally:
+            eng.stop()
+
+    def test_bad_entries_get_400_lines_not_request_failure(self, monkeypatch):
+        api, eng, _ = self._mk(monkeypatch)
+        try:
+            long = "x" * 232
+            status, body, _ = _http(api, f"t={long},10:1s,1&t=ok,10:1s,1")
+            assert status == 200
+            lines = body.decode().splitlines()
+            assert lines[0].startswith("400 ") and "231" in lines[0]
+            assert lines[1] == "200 9"
+        finally:
+            eng.stop()
+
+    def test_no_entries_and_wrong_method(self, monkeypatch):
+        api, eng, _ = self._mk(monkeypatch)
+        try:
+            status, _, _ = _http(api, "")
+            assert status == 400
+            status, _, _ = _http(api, "t=a,10:1s,1", method="GET")
+            assert status == 405
+        finally:
+            eng.stop()
+
+    def test_watermark_shed_is_per_entry_never_whole_request(self, monkeypatch):
+        # The PR 12 hard watermark regression: a multi-take request
+        # carrying live names alongside a NEW name must serve the live
+        # entries and 429 "overloaded" EXACTLY the shed ones — never
+        # reject the whole request.
+        api, eng, _ = self._mk(monkeypatch, max_buckets=4, window_ms=0)
+        try:
+            for i in range(4):
+                eng.take(f"u{i}", RATE, 5)
+            status, body, _ = _http(
+                api, "t=u0,10:1s,1&t=brand-new,10:1s,1&t=u1,10:1s,1"
+            )
+            assert status == 200
+            lines = body.decode().splitlines()
+            assert lines[0] == "200 4"
+            assert lines[1] == "429 overloaded"
+            assert lines[2] == "200 4"
+        finally:
+            eng.stop()
+
+    def test_nonutf8_names_survive_the_manual_parse(self, monkeypatch):
+        # %FF must stay byte 0xFF end-to-end (parse_qs would corrupt
+        # it); ','/'&' percent-encode inside names.
+        api, eng, _ = self._mk(monkeypatch)
+        try:
+            status, body, _ = _http(api, "t=%FF%2Cx,10:1s,1&t=%FF%2Cx,10:1s,1")
+            assert status == 200
+            assert body.decode().splitlines() == ["200 9", "200 8"]
+            assert eng.directory.lookup("\udcff,x") is not None
+        finally:
+            eng.stop()
+
+
+# ===========================================================================
+# patrol-race coverage of the coalescer's shared fold index.
+
+
+@pytest.mark.race
+class TestCoalesceGuardCoverage:
+    """The hot-key coalescer's shared state (`_open_folds`: submitters
+    fold under the work condvar, the feeder's drain closes folds under
+    the same lock) is registered in GUARDS, the locked helpers are
+    declared HOLDERS, and the discipline demonstrably has teeth: a
+    seeded unlocked fold mutation is rejected as PTR003."""
+
+    _FIX = "patrol_tpu/fixture.py"
+
+    def test_fold_state_registered(self):
+        from patrol_tpu.analysis import race
+
+        g = race.GUARDS["patrol_tpu/runtime/engine.py"]["DeviceEngine"]
+        assert g["_open_folds"].lock == "_cond"
+        assert g["_open_folds"].mode == "rw"
+        holders = race.HOLDERS["patrol_tpu/runtime/engine.py"]
+        assert holders["DeviceEngine._enqueue_take_locked"] == ("_cond",)
+        assert holders["DeviceEngine._drain_takes"] == ("_cond",)
+
+    def test_shipped_fold_accesses_are_nonvacuous(self):
+        import os
+
+        from patrol_tpu.analysis import race
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        src = race.race_sources(root)["patrol_tpu/runtime/engine.py"]
+        assert src.count("_open_folds") >= 3  # fold open, fold hit, drain close
+
+    def test_seeded_unlocked_fold_mutation_rejected(self):
+        # The exact slip a fold-path refactor could make: appending a
+        # ticket to an open fold WITHOUT the condvar — the feeder could
+        # pop the entry concurrently and strand the caller forever.
+        from patrol_tpu.analysis import race
+
+        src = (
+            "import threading\n"
+            "class Eng:\n"
+            "    def __init__(self):\n"
+            "        self._cond = threading.Lock()\n"
+            "        self._open_folds = {}\n"
+            "    def enqueue(self, key, ticket):\n"
+            "        self._open_folds[key] = ticket\n"
+        )
+        guards = {
+            self._FIX: {"Eng": {"_open_folds": race.Guard("_cond", "rw")}}
+        }
+        f = race.race_static(
+            {self._FIX: src}, guards=guards, holders={}, aliases={},
+            retained={}, effects={},
+        )
+        assert sorted({x.check for x in f}) == ["PTR003"]
+        assert "_open_folds" in f[0].message
+
+    def test_locked_fold_mutation_clean(self):
+        from patrol_tpu.analysis import race
+
+        src = (
+            "import threading\n"
+            "class Eng:\n"
+            "    def __init__(self):\n"
+            "        self._cond = threading.Lock()\n"
+            "        self._open_folds = {}\n"
+            "    def enqueue(self, key, ticket):\n"
+            "        with self._cond:\n"
+            "            self._open_folds[key] = ticket\n"
+        )
+        guards = {
+            self._FIX: {"Eng": {"_open_folds": race.Guard("_cond", "rw")}}
+        }
+        assert race.race_static(
+            {self._FIX: src}, guards=guards, holders={}, aliases={},
+            retained={}, effects={},
+        ) == []
